@@ -1,0 +1,28 @@
+"""distributed_pytorch_tpu — a TPU-native distributed training framework.
+
+Brand-new implementation of the capability surface of
+joh-fischer/distributed-pytorch (see SURVEY.md): the 18-function helper API
+(launch, process-group lifecycle, topology queries, collectives, DDP wrap,
+sharded sampling, primary-only printing) plus the workload it serves —
+redesigned for TPUs. The compute path is JAX/XLA: one compiled program per
+training step with gradient all-reduce over ICI, SPMD over a
+``jax.sharding.Mesh``, and shard_map/ppermute-based tensor/sequence
+parallelism for scale-out. The host runtime (rendezvous store, CPU
+collectives for the per-rank-process front door) is native C++ under
+``native/``.
+
+``import distributed_pytorch_tpu as dist`` mirrors the reference's
+``import distributed as dist`` (reference ``min_DDP.py:7``).
+"""
+
+from .api import *  # noqa: F401,F403 — the 18-function surface + extensions
+from .api import __all__ as _api_all
+
+from . import comm, data, models, nn, ops, optim, parallel, runtime, utils  # noqa: F401
+
+__all__ = list(_api_all) + [
+    "comm", "data", "models", "nn", "ops", "optim", "parallel", "runtime",
+    "utils",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+"""Communication: eager collective helpers + in-step primitives."""
+from . import collectives, primitives
+from .collectives import (all_gather, all_reduce, barrier, broadcast, gather,
+                          reduce, sync_params, wait_for_everyone)

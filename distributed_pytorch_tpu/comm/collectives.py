@@ -1,0 +1,184 @@
+"""Eager collective helpers — the sync API of reference ``distributed.py:119-187``.
+
+Semantics model. The reference's collectives act on *per-rank tensors*: each
+of N processes holds its own ``tensor`` and the collective relates them. Under
+single-controller SPMD there are no per-rank processes — the controller holds
+*one* array for all ranks. The mapping used throughout this framework:
+
+    per-rank tensor of shape S  ⇔  "stacked" array of shape (world, *S),
+                                   sharded over the ``dp`` mesh axis on axis 0
+
+(:func:`distributed_pytorch_tpu.parallel.data_parallel` steps return exactly
+this layout for per-rank metrics.) Each helper's world>1 path is a tiny jnp
+program on the stacked array; because the array is dp-sharded, XLA lowers the
+reduction to real cross-device collectives over ICI — that is the entire
+NCCL-replacement story (SURVEY.md §2.3 row 1).
+
+The controller *is* the primary rank, so rooted collectives return the
+primary's view directly:
+
+* ``all_reduce``  — stacked → stacked; every rank row holds the reduced
+  value (reference ``distributed.py:119-133``; same ``sum``/``avg``/ValueError
+  contract).
+* ``reduce``      — stacked → single tensor of shape S: the reduced value as
+  rank 0 sees it (reference ``distributed.py:136-144``; non-root contents
+  are backend-defined there, so collapsing to the root view loses nothing).
+* ``gather``      — stacked → list of per-rank tensors as rank 0 sees them
+  (reference ``distributed.py:147-160``; the reference's
+  zeros-on-non-primary contract is a wart of its allocation strategy — the
+  primary-side values, the only defined ones, are what callers may use).
+* ``sync_params`` / ``broadcast`` — rank-0 row wins
+  (reference ``distributed.py:163-170``).
+* ``barrier`` / ``wait_for_everyone`` — drain outstanding device work
+  (reference ``distributed.py:173-182``).
+
+Every helper short-circuits to the identity at world==1 with the reference's
+exact shapes (``gather`` → ``[x]`` etc.; reference ``distributed.py:122-123,
+139-140,150-151,175-176``).
+
+The true multi-process path (one OS process per rank, native TCP collectives
+— the gloo/c10d equivalent) implements this same signature set in
+:mod:`distributed_pytorch_tpu.comm.host_backend`.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..runtime import context
+
+_VALID_OPS = ("sum", "avg", "max", "min")
+
+
+def _check_stacked(x: jnp.ndarray, fn: str) -> jnp.ndarray:
+    world = context.get_world_size()
+    if x.ndim == 0 or x.shape[0] != world:
+        raise ValueError(
+            f"{fn} expects a stacked (world, ...) array with one row per "
+            f"rank; got shape {x.shape} with world={world}"
+        )
+    return x
+
+
+def _reduce_stacked(x: jnp.ndarray, op: str) -> jnp.ndarray:
+    if op == "sum":
+        return jnp.sum(x, axis=0)
+    if op == "avg":
+        # Reference computes SUM then divides by world (distributed.py:127-129).
+        return jnp.sum(x, axis=0) / context.get_world_size()
+    if op == "max":
+        return jnp.max(x, axis=0)
+    if op == "min":
+        return jnp.min(x, axis=0)
+    raise ValueError(f'"{op}" is an invalid reduce operation!')
+
+
+def all_reduce(tensor, op: str = "sum"):
+    """All-reduce over the rank axis (reference ``distributed.py:119-133``).
+
+    world==1: identity. world>1: ``tensor`` is stacked ``(world, *S)``; the
+    result is stacked with every row equal to the reduction. Invalid ``op``
+    raises ``ValueError`` like the reference (``distributed.py:131``); as
+    there, validation happens only on the distributed path.
+    """
+    if context.get_world_size() == 1:
+        return tensor
+    x = _check_stacked(jnp.asarray(tensor), "all_reduce")
+    reduced = _reduce_stacked(x, op)
+    return jnp.broadcast_to(reduced[None], x.shape)
+
+
+def reduce(tensor, op: str = "sum"):
+    """Rooted reduce to the primary (reference ``distributed.py:136-144``).
+
+    world==1: identity. world>1: input stacked ``(world, *S)``, output the
+    reduced tensor of shape S — the value rank 0 holds in the reference
+    (non-root contents are backend-defined there, §2.1 #13)."""
+    if context.get_world_size() == 1:
+        return tensor
+    return _reduce_stacked(_check_stacked(jnp.asarray(tensor), "reduce"), op)
+
+
+def gather(data) -> List:
+    """Rooted gather to the primary (reference ``distributed.py:147-160``).
+
+    world==1: ``[data]``. world>1: input stacked ``(world, *S)``, output the
+    primary's gather list ``[rank0, rank1, ...]`` (each shape S). As in the
+    reference, equal per-rank shapes are required — guaranteed here by the
+    stacked layout."""
+    world = context.get_world_size()
+    if world == 1:
+        return [data]
+    x = jnp.asarray(data)
+    if x.shape[0] != world:
+        raise ValueError(
+            f"gather expects a stacked (world, ...) array; got shape {x.shape} "
+            f"with world={world}"
+        )
+    return [x[r] for r in range(world)]
+
+
+def all_gather(data):
+    """All-gather: every rank sees the stacked values.
+
+    No direct reference analog (its ``gather`` is rooted); provided because
+    it is the natural TPU primitive the rooted emulations ride on
+    (SURVEY.md §5 'distributed communication backend')."""
+    world = context.get_world_size()
+    if world == 1:
+        return jnp.asarray(data)[None]
+    return jnp.asarray(data)
+
+
+def broadcast(tensor, src: int = 0):
+    """Broadcast the ``src`` rank's value to all ranks.
+
+    world>1: input stacked ``(world, *S)``; output stacked with every row
+    equal to row ``src``. Underlies :func:`sync_params` (reference
+    ``distributed.py:163-170``)."""
+    world = context.get_world_size()
+    if world == 1:
+        return tensor
+    if not (0 <= src < world):
+        raise ValueError(f"broadcast src={src} out of range for world={world}")
+    x = _check_stacked(jnp.asarray(tensor), "broadcast")
+    return jnp.broadcast_to(x[src][None], x.shape)
+
+
+def sync_params(params: Sequence):
+    """Synchronize a sequence of tensors from rank 0 (reference
+    ``distributed.py:163-170``).
+
+    Under SPMD, replicated parameters are *by construction* identical on all
+    devices, so this re-asserts replicated placement (a no-op when already
+    replicated) rather than moving bytes. It exists for the reference's
+    stated use case — non-DDP/EMA params after load — where the input may be
+    host or per-device data."""
+    if not context.is_initialized():
+        return list(params)
+    return [jax.device_put(p, context.replicated_sharding()) for p in params]
+
+
+def barrier():
+    """Wait until all outstanding device work is done (reference
+    ``distributed.py:173-177``).
+
+    A single controller needs no cross-process rendezvous; the observable
+    contract — nothing after the barrier begins until everything before it
+    finished everywhere — is delivered by draining the async dispatch queue.
+    """
+    if context.get_world_size() == 1:
+        return
+    # Enqueue a trivial op on EVERY mesh device and block: per-device FIFO
+    # ordering then guarantees all previously dispatched work on all devices
+    # has completed.
+    token = jax.device_put(jnp.zeros(()), context.replicated_sharding())
+    token.block_until_ready()
+
+
+def wait_for_everyone():
+    """Readability alias for :func:`barrier` (reference ``distributed.py:181-182``)."""
+    barrier()

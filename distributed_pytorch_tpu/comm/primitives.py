@@ -1,0 +1,77 @@
+"""In-step collective primitives for use under ``shard_map``/``jit``.
+
+These are the compiled-program counterparts of the eager helpers in
+:mod:`distributed_pytorch_tpu.comm.collectives`: inside a sharded region each
+device holds its own block and the primitive names the mesh axis to
+communicate over. They lower directly to XLA HLO collectives (all-reduce,
+all-gather, collective-permute, all-to-all, reduce-scatter) riding ICI — the
+NCCL replacement called for by SURVEY.md §2.3 row 1 — and are the building
+blocks for the data/tensor/sequence/pipeline/expert parallel engines in
+:mod:`distributed_pytorch_tpu.parallel`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum(x, axis_name: str):
+    """All-reduce sum over a mesh axis (HLO ``all-reduce``)."""
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str):
+    """All-reduce mean over a mesh axis — DDP's gradient averaging
+    (reference ``distributed.py:112-115``, C++ reducer semantics)."""
+    return lax.pmean(x, axis_name)
+
+def pmax(x, axis_name: str):
+    return lax.pmax(x, axis_name)
+
+
+def pmin(x, axis_name: str):
+    return lax.pmin(x, axis_name)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = False):
+    """All-gather over a mesh axis (HLO ``all-gather``)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, scatter_axis: int = 0):
+    """Reduce-scatter over a mesh axis (HLO ``reduce-scatter``) — the
+    bandwidth-optimal half of an all-reduce; used by ZeRO-style sharded
+    optimizers."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis, tiled=True)
+
+
+def ppermute(x, axis_name: str, perm):
+    """Point-to-point ring/permute (HLO ``collective-permute``) — the
+    transport under ring attention (:mod:`..parallel.sequence`)."""
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def ring_shift(x, axis_name: str, shift: int = 1):
+    """Rotate each device's block ``shift`` hops around the mesh-axis ring."""
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int, tiled: bool = True):
+    """All-to-all (HLO ``all-to-all``) — the transport for Ulysses-style
+    sequence parallelism and MoE token dispatch."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)
+
+
+def axis_index(axis_name: str):
+    """This device's position along a mesh axis (the in-step 'rank')."""
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str):
+    """Size of a mesh axis (the in-step 'world size')."""
+    return lax.psum(1, axis_name)

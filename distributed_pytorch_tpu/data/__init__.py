@@ -1,0 +1,5 @@
+"""Data: sharded sampling, mesh-aware loading, ladder datasets."""
+from . import datasets, loader, sampler
+from .datasets import DummyDataset, SyntheticImages, SyntheticLM
+from .loader import DataLoader
+from .sampler import ShardedSampler, data_sampler

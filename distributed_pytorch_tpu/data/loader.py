@@ -1,0 +1,117 @@
+"""Batch loading onto the mesh — the ``DataLoader`` seam of the workload.
+
+The reference iterates ``DataLoader(dataset, batch_size, sampler)`` per rank
+and moves each batch to its GPU (``min_DDP.py:65-66,96``). Under
+single-controller SPMD one loader produces the *global* batch each step,
+laid out so axis 0 splits into per-rank shards in rank order, and one
+``device_put`` shards it over the ``dp`` mesh axis — N H2D copies become one
+sharded transfer.
+
+Key layout invariant: for world W and per-rank batch B, step t's global
+batch rows ``[r*B:(r+1)*B]`` are exactly what the reference's rank r would
+have loaded at step t from its strided ``DistributedSampler`` shard. The
+data-parallel engine and the stacked collectives rely on this.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from .sampler import ShardedSampler
+
+
+class DataLoader:
+    """Minimal map-style loader: dataset + optional sharded sampler → batches.
+
+    ``dataset`` must support ``len()`` and integer ``__getitem__`` returning
+    a tuple/list of numpy-convertible leaves (the reference's Dataset
+    contract, ``min_DDP.py:27-38``). With a sampler, each yielded batch is
+    the *global* batch: per-rank sub-batches concatenated in rank order
+    (see module docstring). Without one, plain (optionally shuffled)
+    batching — matching the reference quirk that non-distributed runs
+    shuffle while distributed ones don't (``min_DDP.py:64-66``).
+    """
+
+    def __init__(self, dataset, batch_size: int,
+                 sampler: Optional[ShardedSampler] = None,
+                 shuffle: bool = False, seed: int = 0,
+                 drop_last: bool = False,
+                 collate: Optional[Callable] = None):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.sampler = sampler
+        self.shuffle = shuffle and sampler is None
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate = collate or _default_collate
+        self._epoch = 0
+        self._cache_key = None
+        self._cache_rows = None
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
+        if self.sampler is not None:
+            self.sampler.set_epoch(epoch)
+
+    def _index_matrix(self) -> list:
+        """Per-step global-batch index rows for this epoch. ``batch_size``
+        is the *per-rank* batch (the reference's ``--batch-size``,
+        ``min_DDP.py:14``), so with a sampler each row has W*B indices.
+        Cached per (loader epoch, sampler epoch)."""
+        key = (self._epoch,
+               self.sampler.epoch if self.sampler is not None else None)
+        if self._cache_key == key:
+            return self._cache_rows
+        rows = self._build_rows()
+        self._cache_key, self._cache_rows = key, rows
+        return rows
+
+    def _build_rows(self) -> list:
+        if self.sampler is not None:
+            s = self.sampler
+            glob = s.global_indices()
+            # shard r, in rank-strided order, reshaped to (steps, B) then
+            # concatenated along batch axis in rank order
+            per_rank = [glob[r :: s.world_size] for r in range(s.world_size)]
+            n_local = len(per_rank[0])
+            b = self.batch_size
+            steps = n_local // b if self.drop_last else math.ceil(n_local / b)
+            rows = []
+            for t in range(steps):
+                chunk = [pr[t * b : (t + 1) * b] for pr in per_rank]
+                rows.append(np.concatenate(chunk))
+            return rows
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            idx = rng.permutation(n)
+        else:
+            idx = np.arange(n)
+        b = self.batch_size
+        steps = n // b if self.drop_last else math.ceil(n / b)
+        return [idx[t * b : (t + 1) * b] for t in range(steps)]
+
+    def __iter__(self) -> Iterator:
+        for row in self._index_matrix():
+            yield self.collate([self.dataset[int(i)] for i in row])
+
+    def __len__(self) -> int:
+        if self.sampler is not None:
+            n_local = len(self.sampler)
+        else:
+            n_local = len(self.dataset)
+        if self.drop_last:
+            return n_local // self.batch_size
+        return math.ceil(n_local / self.batch_size)
+
+
+def _default_collate(items):
+    """Stack tuple-of-leaves samples into a tuple of batched numpy arrays."""
+    first = items[0]
+    if isinstance(first, (tuple, list)):
+        return tuple(np.stack([np.asarray(it[k]) for it in items])
+                     for k in range(len(first)))
+    return np.stack([np.asarray(it) for it in items])

@@ -1,0 +1,99 @@
+"""Sharded sampling — the ``DistributedSampler`` equivalent.
+
+Reproduces the contract the reference relies on (``distributed.py:105-108``
+wrapping ``torch.utils.data.distributed.DistributedSampler``, exercised with
+``set_epoch`` at ``min_DDP.py:82-83``):
+
+* rank-strided index sharding: rank r gets indices ``r, r+W, r+2W, ...`` of
+  the (optionally shuffled) index list;
+* padding: the index list is extended by wrapping from its own start so every
+  rank gets exactly ``ceil(N / W)`` indices — equal shard sizes, which the
+  stacked-collective layout (comm/collectives.py) also requires;
+* ``set_epoch(e)``: reseeds the shuffle with ``seed + e`` so every rank
+  draws the *same* permutation each epoch but different ones across epochs;
+* ``shuffle=False`` → plain ``arange`` order.
+
+Shuffling uses a deterministic seeded permutation (numpy Generator), the
+analog of the torch sampler's ``g.manual_seed(self.seed + self.epoch)``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class ShardedSampler:
+    """Per-rank view of a dataset's indices, equal-sized via wrap padding."""
+
+    def __init__(self, dataset_size: int, rank: int, world_size: int,
+                 shuffle: bool = True, seed: int = 0, drop_last: bool = False):
+        if world_size < 1:
+            raise ValueError(f"world_size must be >= 1, got {world_size}")
+        if not (0 <= rank < world_size):
+            raise ValueError(f"rank {rank} out of range for world {world_size}")
+        self.dataset_size = int(dataset_size)
+        self.rank = rank
+        self.world_size = world_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.drop_last = drop_last
+        if drop_last and self.dataset_size % world_size != 0:
+            self.num_samples = self.dataset_size // world_size
+        else:
+            self.num_samples = math.ceil(self.dataset_size / world_size)
+        self.total_size = self.num_samples * world_size
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the per-epoch shuffle (contract of ``min_DDP.py:82-83``)."""
+        self.epoch = int(epoch)
+
+    def global_indices(self) -> np.ndarray:
+        """The padded, epoch-shuffled index list shared by all ranks."""
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            idx = rng.permutation(self.dataset_size)
+        else:
+            idx = np.arange(self.dataset_size)
+        if not self.drop_last and self.total_size > len(idx):
+            # wrap-pad from the start, like the torch sampler
+            pad = self.total_size - len(idx)
+            reps = math.ceil(pad / max(len(idx), 1))
+            idx = np.concatenate([idx] + [idx] * reps)[: self.total_size]
+        else:
+            idx = idx[: self.total_size]
+        return idx
+
+    def local_indices(self) -> np.ndarray:
+        """This rank's strided shard: positions rank, rank+W, ... ."""
+        return self.global_indices()[self.rank :: self.world_size]
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.local_indices().tolist())
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+
+def data_sampler(dataset, distributed: bool, shuffle: bool,
+                 rank: Optional[int] = None, world_size: Optional[int] = None,
+                 seed: int = 0) -> Optional[ShardedSampler]:
+    """Return a sampler iff distributed, else ``None`` (reference
+    ``distributed.py:105-108``).
+
+    Like the torch sampler, rank/world default from the live process group.
+    Under single-controller SPMD the controller owns every rank's shard, so
+    the loader (``data/loader.py``) consumes all W strided shards in rank
+    order and the sampler here carries rank 0's view for API parity.
+    """
+    if not distributed:
+        return None
+    from ..runtime import context
+
+    r = context.get_rank() if rank is None else rank
+    w = context.get_world_size() if world_size is None else world_size
+    return ShardedSampler(len(dataset), rank=r, world_size=w,
+                          shuffle=shuffle, seed=seed)

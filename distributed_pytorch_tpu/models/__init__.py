@@ -1,0 +1,4 @@
+"""Model zoo: the reference workload's MLP plus the evaluation-ladder
+models (ResNet, Transformer LM)."""
+from . import mlp
+from .mlp import DummyModel

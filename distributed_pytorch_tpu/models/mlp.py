@@ -1,0 +1,27 @@
+"""The reference workload's model: a 2-layer no-activation MLP
+(``min_DDP.py:41-49``: Linear(in→hidden) → Linear(hidden→classes))."""
+
+from __future__ import annotations
+
+import jax
+
+from ..nn.core import Linear, Module, Params, Sequential
+
+
+class DummyModel(Module):
+    """Linear → Linear, no activation between — exactly the reference's
+    ``DummyModel`` shape (``min_DDP.py:44-48``), in_dim defaulting to the
+    scalar-feature dataset's 1."""
+
+    def __init__(self, in_dim: int = 1, hidden_dim: int = 32,
+                 n_classes: int = 4):
+        self.net = Sequential([
+            ("lin1", Linear(in_dim, hidden_dim)),
+            ("lin2", Linear(hidden_dim, n_classes)),
+        ])
+
+    def init(self, key) -> Params:
+        return self.net.init(key)
+
+    def apply(self, params: Params, x, **kwargs):
+        return self.net.apply(params, x, **kwargs)

@@ -1,0 +1,4 @@
+"""Functional nn modules (pytree params, pure apply)."""
+from . import core
+from .core import (Dropout, Embedding, LayerNorm, Linear, Module, Params,
+                   Sequential, gelu, relu)

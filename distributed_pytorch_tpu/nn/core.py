@@ -1,0 +1,142 @@
+"""Functional neural-net modules: params are pytrees, apply is pure.
+
+The reference builds models from ``torch.nn`` (``min_DDP.py:41-49``). This
+framework's module system is deliberately functional — ``init(key)`` returns
+a params pytree, ``apply(params, x)`` is a pure function — because that is
+what compiles cleanly under ``jit``/``pjit``: parameters are explicit inputs
+the sharding machinery can annotate (replicated for DP, axis-sharded for TP),
+and a whole training step closes over nothing.
+
+Initialization follows the same fan-in uniform scheme torch's ``Linear``
+uses (U(-1/sqrt(fan_in), 1/sqrt(fan_in)) for both weight and bias), so
+model-quality behavior matches the reference workload's.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+class Module:
+    """Base: subclasses define ``init(key) -> params`` and
+    ``apply(params, x, **kw) -> out``."""
+
+    def init(self, key) -> Params:
+        raise NotImplementedError
+
+    def apply(self, params: Params, x, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, params: Params, x, **kwargs):
+        return self.apply(params, x, **kwargs)
+
+
+class Linear(Module):
+    """Affine map ``x @ W + b`` (the reference model's only layer type,
+    ``min_DDP.py:44-45``). Weight stored as (in, out) — the layout the MXU
+    wants for ``x @ W`` without a transpose."""
+
+    def __init__(self, in_dim: int, out_dim: int, bias: bool = True,
+                 dtype=jnp.float32):
+        self.in_dim = in_dim
+        self.out_dim = out_dim
+        self.bias = bias
+        self.dtype = dtype
+
+    def init(self, key) -> Params:
+        kw, kb = jax.random.split(key)
+        bound = 1.0 / math.sqrt(self.in_dim)
+        p = {"w": jax.random.uniform(kw, (self.in_dim, self.out_dim),
+                                     self.dtype, -bound, bound)}
+        if self.bias:
+            p["b"] = jax.random.uniform(kb, (self.out_dim,), self.dtype,
+                                        -bound, bound)
+        return p
+
+    def apply(self, params: Params, x, **_):
+        y = jnp.matmul(x, params["w"])
+        if self.bias:
+            y = y + params["b"]
+        return y
+
+
+class Embedding(Module):
+    def __init__(self, vocab: int, dim: int, dtype=jnp.float32):
+        self.vocab = vocab
+        self.dim = dim
+        self.dtype = dtype
+
+    def init(self, key) -> Params:
+        return {"emb": jax.random.normal(key, (self.vocab, self.dim),
+                                         self.dtype)}
+
+    def apply(self, params: Params, ids, **_):
+        return jnp.take(params["emb"], ids, axis=0)
+
+
+class LayerNorm(Module):
+    def __init__(self, dim: int, eps: float = 1e-5, dtype=jnp.float32):
+        self.dim = dim
+        self.eps = eps
+        self.dtype = dtype
+
+    def init(self, key) -> Params:
+        del key
+        return {"scale": jnp.ones((self.dim,), self.dtype),
+                "bias": jnp.zeros((self.dim,), self.dtype)}
+
+    def apply(self, params: Params, x, **_):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mu) * jax.lax.rsqrt(var + self.eps)
+        return y * params["scale"] + params["bias"]
+
+
+class Dropout(Module):
+    """Stateless dropout: pass ``rng=`` and ``train=True`` to drop."""
+
+    def __init__(self, rate: float):
+        self.rate = rate
+
+    def init(self, key) -> Params:
+        del key
+        return {}
+
+    def apply(self, params: Params, x, *, rng=None, train: bool = False, **_):
+        del params
+        if not train or self.rate <= 0.0 or rng is None:
+            return x
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+class Sequential(Module):
+    """Named chain of modules; params nest under each layer's name."""
+
+    def __init__(self, layers: Sequence[Tuple[str, Module]]):
+        self.layers = list(layers)
+
+    def init(self, key) -> Params:
+        keys = jax.random.split(key, max(len(self.layers), 1))
+        return {name: mod.init(k)
+                for (name, mod), k in zip(self.layers, keys)}
+
+    def apply(self, params: Params, x, **kwargs):
+        for name, mod in self.layers:
+            x = mod.apply(params[name], x, **kwargs)
+        return x
+
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def gelu(x):
+    return jax.nn.gelu(x)

@@ -1,0 +1,3 @@
+"""Ops: losses and TPU (Pallas) kernels with portable fallbacks."""
+from . import losses
+from .losses import cross_entropy, cross_entropy_per_example

@@ -1,0 +1,24 @@
+"""Loss functions (the reference uses ``nn.CrossEntropyLoss()``,
+``min_DDP.py:75``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_per_example(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Per-example softmax cross-entropy, labels as int class ids.
+
+    ``logits``: (..., C); ``labels``: (...). Matches torch
+    ``CrossEntropyLoss(reduction='none')`` numerics (log-softmax gather)."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    true_logit = jnp.take_along_axis(
+        logits, labels[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    return logz - true_logit
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean cross-entropy — torch ``CrossEntropyLoss()`` default reduction."""
+    return jnp.mean(cross_entropy_per_example(logits, labels))

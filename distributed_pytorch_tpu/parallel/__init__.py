@@ -1,0 +1,4 @@
+"""Parallelism engines: data (DDP), tensor, sequence (ring attention),
+pipeline, expert."""
+from . import data_parallel
+from .data_parallel import DataParallel, make_train_step, prepare_ddp_model

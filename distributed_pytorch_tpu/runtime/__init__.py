@@ -1,0 +1,5 @@
+"""Runtime: device/mesh discovery, process-group lifecycle, launch."""
+from . import context, launcher
+from .context import (DATA_AXIS, device_count, get_device, get_mesh, get_rank,
+                      get_world_size, init_process_group, is_initialized)
+from .launcher import find_free_port, launch

@@ -1,0 +1,260 @@
+"""Process-group lifecycle and device/mesh discovery — TPU-native runtime state.
+
+This module is the TPU-native replacement for the reference's process-group
+machinery (c10d init/destroy/is_initialized, reference ``distributed.py:62-101``)
+and its CUDA device model (``torch.cuda.device_count()`` gated by
+``CUDA_VISIBLE_DEVICES``, reference ``distributed.py:41,44`` and
+``README.md:109-119``).
+
+Design (SPMD-first, see SURVEY.md §7 option 2):
+
+* There is no TCP rendezvous and no free-port scramble: the topology comes
+  from the XLA runtime (the TPU slice knows its own mesh). "Initializing the
+  process group" means building a :class:`jax.sharding.Mesh` over the visible
+  devices and flipping the ``initialized`` bit.
+* ``world_size`` is the number of *visible accelerator devices* — the analog
+  of ``torch.cuda.device_count()``. Visibility is gated by the
+  ``DPX_VISIBLE_DEVICES`` env var (comma-separated device indices), the
+  analog of the ``CUDA_VISIBLE_DEVICES`` workflow the reference documents
+  (``README.md:110-114``).
+* On a CPU-only host the visible accelerator count is 0 — matching the
+  reference's CPU branch (``distributed.py:57-58``) — unless
+  ``DPX_CPU_DEVICES=<n>`` is set, which treats up to ``n`` XLA host devices
+  as accelerators. Tests use this together with
+  ``--xla_force_host_platform_device_count=8`` to run an 8-device virtual
+  mesh on CPU.
+* Graceful degradation is preserved exactly: every query below is safe to
+  call before init / without distribution (reference
+  ``distributed.py:69-101``).
+
+The single mesh axis used for data parallelism is named ``"dp"``. Wider
+meshes (tp/sp/pp/ep) are built by :mod:`distributed_pytorch_tpu.parallel`
+on top of the same context.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "dp"
+
+#: Env var restricting which accelerator devices are visible (analog of
+#: ``CUDA_VISIBLE_DEVICES``, reference ``distributed.py:44``).
+VISIBLE_DEVICES_ENV = "DPX_VISIBLE_DEVICES"
+
+#: Env var opting CPU XLA devices in as "accelerators" (virtual mesh testing).
+CPU_DEVICES_ENV = "DPX_CPU_DEVICES"
+
+
+@dataclasses.dataclass
+class _State:
+    initialized: bool = False
+    world_size: int = 1
+    rank: int = 0
+    backend: Optional[str] = None
+    mesh: Optional[Mesh] = None
+    devices: Optional[tuple] = None
+
+
+_state = _State()
+
+
+# ---------------------------------------------------------------------------
+# Device discovery
+# ---------------------------------------------------------------------------
+
+def accelerator_platform() -> str:
+    """The XLA platform backing compute ('tpu', 'cpu', ...)."""
+    return jax.default_backend()
+
+
+def visible_devices() -> list:
+    """Visible accelerator devices, in rank order.
+
+    Analog of CUDA device enumeration under ``CUDA_VISIBLE_DEVICES``
+    (reference ``distributed.py:41,44``): the env var selects a subset, and
+    ranks map to the selected devices in order (rank i owns device i).
+    Returns ``[]`` on a CPU-only host unless ``DPX_CPU_DEVICES`` opts the
+    virtual host devices in.
+    """
+    all_devices = list(jax.devices())
+    platform = jax.default_backend()
+    if platform == "cpu":
+        forced = os.environ.get(CPU_DEVICES_ENV)
+        if forced is None:
+            return []
+        if forced.strip().lower() == "all":
+            return all_devices
+        return all_devices[: int(forced)]
+    spec = os.environ.get(VISIBLE_DEVICES_ENV)
+    if spec is None or spec.strip() == "":
+        return all_devices
+    picked = []
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if tok == "":
+            continue
+        idx = int(tok)
+        if idx < 0 or idx >= len(all_devices):
+            raise ValueError(
+                f"{VISIBLE_DEVICES_ENV} index {idx} out of range "
+                f"(have {len(all_devices)} devices)"
+            )
+        picked.append(all_devices[idx])
+    return picked
+
+
+def device_count() -> int:
+    """Number of visible accelerator devices (the implicit world size).
+
+    Mirrors ``torch.cuda.device_count()`` as used at reference
+    ``distributed.py:41``: 0 on a CPU-only host, N on an accelerator host.
+    """
+    return len(visible_devices())
+
+
+# ---------------------------------------------------------------------------
+# Process-group lifecycle (reference distributed.py:62-79)
+# ---------------------------------------------------------------------------
+
+def init_process_group(rank: int, world_size: int, backend: Optional[str] = None) -> None:
+    """Create the device mesh and mark the group initialized.
+
+    TPU-native analog of ``dist.init_process_group(backend,
+    init_method='env://', ...)`` (reference ``distributed.py:62-66``). There
+    is no network rendezvous: the runtime already knows the topology, so
+    this just builds a 1-D ``Mesh`` over the ``dp`` axis.
+
+    ``backend`` defaults like the reference picks nccl-vs-gloo
+    (``distributed.py:63-64``): ``"ici"`` (XLA collectives over the TPU
+    interconnect) when an accelerator backs compute, ``"xla-cpu"`` for the
+    virtual CPU mesh.
+    """
+    devices = visible_devices()
+    n = len(devices)
+    if world_size > max(n, 1):
+        raise ValueError(
+            f"requested world_size={world_size} but only {n} visible devices"
+        )
+    if backend is None:
+        backend = "ici" if jax.default_backend() != "cpu" else "xla-cpu"
+    use = devices[:world_size] if world_size >= 1 else devices[:1]
+    mesh = Mesh(_as_device_array(use if use else list(jax.devices())[:1]), (DATA_AXIS,))
+    _state.initialized = True
+    _state.world_size = max(world_size, 1)
+    _state.rank = rank
+    _state.backend = backend
+    _state.mesh = mesh
+    _state.devices = tuple(use)
+
+
+def _as_device_array(devices: Sequence[Any]):
+    import numpy as np
+
+    arr = np.empty((len(devices),), dtype=object)
+    for i, d in enumerate(devices):
+        arr[i] = d
+    return arr
+
+
+def is_initialized() -> bool:
+    """Whether the process group exists (reference ``distributed.py:69-74``)."""
+    return _state.initialized
+
+
+def destroy_process_group() -> None:
+    """Tear down group state (reference ``distributed.py:77-79``)."""
+    _state.initialized = False
+    _state.world_size = 1
+    _state.rank = 0
+    _state.backend = None
+    _state.mesh = None
+    _state.devices = None
+
+
+# ---------------------------------------------------------------------------
+# Topology queries (reference distributed.py:82-101)
+# ---------------------------------------------------------------------------
+
+def get_rank() -> int:
+    """Controller rank; 0 when uninitialized (reference ``distributed.py:82-85``).
+
+    Under single-controller SPMD this is the process index (0 on a single
+    host; the per-host index on a multi-host pod)."""
+    if not _state.initialized:
+        return 0
+    return _state.rank
+
+
+def get_world_size() -> int:
+    """World size; 1 when uninitialized (reference ``distributed.py:98-101``)."""
+    if not _state.initialized:
+        return 1
+    return _state.world_size
+
+
+def get_backend() -> Optional[str]:
+    return _state.backend if _state.initialized else None
+
+
+def get_mesh() -> Mesh:
+    """The live 1-D ``dp`` mesh; a trivial 1-device mesh when uninitialized."""
+    if _state.initialized and _state.mesh is not None:
+        return _state.mesh
+    return Mesh(_as_device_array([jax.devices()[0]]), (DATA_AXIS,))
+
+
+def get_device():
+    """The accelerator device owning this controller's computation.
+
+    Analog of ``get_device`` returning ``cuda:{rank}`` or ``cpu`` (reference
+    ``distributed.py:88-91``). Under SPMD the controller owns *all* mesh
+    devices; this returns the first one, which is where unsharded host data
+    lands by default."""
+    devs = _state.devices if _state.initialized and _state.devices else visible_devices()
+    if devs:
+        return devs[0]
+    return jax.devices()[0]
+
+
+# ---------------------------------------------------------------------------
+# Sharding helpers (SPMD placement — no reference analog; this is the
+# TPU-idiomatic replacement for `.to(device)` placement in the workload,
+# reference min_DDP.py:96)
+# ---------------------------------------------------------------------------
+
+def batch_sharding() -> NamedSharding:
+    """Sharding that splits axis 0 of a batch across the ``dp`` axis."""
+    return NamedSharding(get_mesh(), PartitionSpec(DATA_AXIS))
+
+
+def replicated_sharding() -> NamedSharding:
+    """Sharding that replicates a value on every mesh device (DDP params)."""
+    return NamedSharding(get_mesh(), PartitionSpec())
+
+
+def shard_batch(batch):
+    """Place a host batch onto the mesh, sharded over ``dp`` on axis 0.
+
+    TPU-native analog of the per-rank H2D copy ``x.to(device)`` (reference
+    ``min_DDP.py:96``): one call moves every rank's shard."""
+    if get_world_size() == 1:
+        return jax.device_put(batch, get_device())
+    sharding = batch_sharding()
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicate(tree):
+    """Replicate a pytree (e.g. params) onto every mesh device.
+
+    Analog of DDP's construction-time parameter broadcast from rank 0
+    (reference ``distributed.py:112-115`` / the C++ reducer's ctor)."""
+    if get_world_size() == 1:
+        return jax.device_put(tree, get_device())
+    sharding = replicated_sharding()
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)

@@ -1,0 +1,3 @@
+"""Utilities: primary-only logging, metrics, checkpointing, config."""
+from . import logging
+from .logging import MetricsLogger, is_primary, print_primary

@@ -1,0 +1,53 @@
+"""Primary-only output (reference ``distributed.py:185-187``) plus a small
+step-metrics logger (the reference's whole observability story is prints;
+ours keeps that surface and adds an optional structured logger)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Dict, Optional
+
+from ..runtime import context
+
+
+def is_primary() -> bool:
+    """True on rank 0 (reference ``distributed.py:94-95``)."""
+    return context.get_rank() == 0
+
+
+def print_primary(*args, **kwargs) -> None:
+    """``print`` only on the primary (reference ``distributed.py:185-187``)."""
+    if is_primary():
+        print(*args, **kwargs)
+
+
+class MetricsLogger:
+    """Primary-only structured metrics: line-JSON to a file and/or stdout.
+
+    No reference analog (SURVEY.md §5: observability is print-based there);
+    this is the minimal upgrade a real training run needs."""
+
+    def __init__(self, path: Optional[str] = None, echo: bool = False):
+        self.path = path
+        self.echo = echo
+        self._fh = None
+        if path is not None and is_primary():
+            self._fh = open(path, "a")
+
+    def log(self, step: int, **metrics: Any) -> None:
+        if not is_primary():
+            return
+        rec: Dict[str, Any] = {"step": step, "time": time.time(), **metrics}
+        line = json.dumps(rec, default=float)
+        if self._fh is not None:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        if self.echo:
+            print(line, file=sys.stdout)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

@@ -1,0 +1,104 @@
+"""Unit tests for the 18-function API's single-process identity paths —
+the graceful-degradation contract of reference distributed.py:54-58,69-101,
+122-123,139-140,150-151,175-176 (SURVEY.md §4 'unit tests')."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_pytorch_tpu as dist
+
+
+def test_uninitialized_defaults():
+    assert not dist.is_dist_avail_and_initialized()
+    assert dist.get_rank() == 0
+    assert dist.get_world_size() == 1
+    assert dist.is_primary()
+    assert dist.get_backend() is None
+
+
+def test_cleanup_safe_when_uninitialized():
+    dist.cleanup()  # must not raise (reference distributed.py:77-79)
+    assert not dist.is_dist_avail_and_initialized()
+
+
+def test_init_and_destroy_lifecycle():
+    dist.init_process_group(rank=0, world_size=8)
+    assert dist.is_dist_avail_and_initialized()
+    assert dist.get_world_size() == 8
+    assert dist.get_rank() == 0
+    assert dist.get_backend() == "xla-cpu"
+    dist.cleanup()
+    assert not dist.is_dist_avail_and_initialized()
+    assert dist.get_world_size() == 1
+
+
+def test_world_size_validation():
+    with pytest.raises(ValueError):
+        dist.init_process_group(rank=0, world_size=64)
+
+
+def test_all_reduce_identity_world1():
+    x = jnp.ones((4,))
+    assert dist.all_reduce(x, op="sum") is x
+    assert dist.all_reduce(x, op="avg") is x  # no validation at world==1,
+    # matching the reference's short-circuit before op checking (:122-123)
+
+
+def test_reduce_identity_world1():
+    x = jnp.arange(3.0)
+    assert dist.reduce(x) is x
+
+
+def test_gather_identity_world1():
+    x = jnp.arange(3.0)
+    out = dist.gather(x)
+    assert isinstance(out, list) and len(out) == 1 and out[0] is x
+
+
+def test_barrier_noop_world1():
+    dist.barrier()
+    dist.wait_for_everyone()
+
+
+def test_sync_params_uninitialized_passthrough():
+    ps = [jnp.ones((2,)), jnp.zeros((3,))]
+    out = dist.sync_params(ps)
+    assert len(out) == 2
+    np.testing.assert_array_equal(np.asarray(out[0]), np.ones((2,)))
+
+
+def test_print_primary(capsys):
+    dist.print_primary("hello", 42)
+    assert capsys.readouterr().out == "hello 42\n"
+
+
+def test_find_free_port_is_bindable():
+    import socket
+    port = dist.find_free_port()
+    assert 0 < port < 65536
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("", port))
+    s.close()
+
+
+def test_device_count_reports_virtual_mesh():
+    assert dist.device_count() == 8
+
+
+def test_launch_world_branches(monkeypatch):
+    """launch must call worker(0, 1) at world==1 and worker(0, 0) at
+    world==0 (reference distributed.py:54-58)."""
+    calls = []
+
+    def worker(rank, world, tag):
+        calls.append((rank, world, tag))
+
+    monkeypatch.setenv("DPX_CPU_DEVICES", "1")
+    dist.launch(worker, "one")
+    monkeypatch.delenv("DPX_CPU_DEVICES")
+    dist.launch(worker, "zero")
+    monkeypatch.setenv("DPX_CPU_DEVICES", "8")
+    dist.launch(worker, "many")
+    assert calls == [(0, 1, "one"), (0, 0, "zero"), (0, 8, "many")]

@@ -1,0 +1,93 @@
+"""Sharded sampler + loader contracts (reference DistributedSampler
+behavior: rank-striding, wrap padding, set_epoch reshuffle — SURVEY.md §2.3
+row 6; loader layout invariant from data/loader.py)."""
+
+import numpy as np
+import pytest
+
+import distributed_pytorch_tpu as dist
+from distributed_pytorch_tpu.data import (DataLoader, DummyDataset,
+                                          ShardedSampler, data_sampler)
+
+
+def test_data_sampler_none_when_not_distributed():
+    ds = DummyDataset(32, 4)
+    assert data_sampler(ds, distributed=False, shuffle=False) is None
+
+
+def test_shards_are_disjoint_and_cover():
+    s = [ShardedSampler(32, rank=r, world_size=4, shuffle=False)
+         for r in range(4)]
+    locals_ = [set(x.local_indices().tolist()) for x in s]
+    assert all(len(a) == 8 for a in locals_)
+    union = set().union(*locals_)
+    assert union == set(range(32))
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert locals_[i].isdisjoint(locals_[j])
+
+
+def test_rank_striding_matches_torch_sampler_contract():
+    s = ShardedSampler(16, rank=1, world_size=4, shuffle=False)
+    np.testing.assert_array_equal(s.local_indices(), [1, 5, 9, 13])
+
+
+def test_padding_wraps_to_equal_shards():
+    # 10 samples over 4 ranks -> ceil = 3 each, padded from the front
+    samplers = [ShardedSampler(10, rank=r, world_size=4, shuffle=False)
+                for r in range(4)]
+    assert all(len(s) == 3 for s in samplers)
+    all_idx = np.concatenate([s.local_indices() for s in samplers])
+    assert sorted(all_idx.tolist()) == sorted(
+        list(range(10)) + [0, 1])  # wrap-pad repeats the start
+
+
+def test_set_epoch_reshuffles_consistently():
+    a = ShardedSampler(32, rank=0, world_size=4, shuffle=True, seed=7)
+    b = ShardedSampler(32, rank=2, world_size=4, shuffle=True, seed=7)
+    a.set_epoch(1)
+    b.set_epoch(1)
+    # same epoch -> same global permutation on every rank
+    np.testing.assert_array_equal(a.global_indices(), b.global_indices())
+    e1 = a.global_indices().copy()
+    a.set_epoch(2)
+    assert not np.array_equal(e1, a.global_indices())
+
+
+def test_shuffle_false_is_arange_order():
+    s = ShardedSampler(8, rank=0, world_size=2, shuffle=True)
+    t = ShardedSampler(8, rank=0, world_size=2, shuffle=False)
+    np.testing.assert_array_equal(t.global_indices(), np.arange(8))
+    assert not np.array_equal(s.global_indices(), t.global_indices())
+
+
+def test_loader_global_batch_layout(group8):
+    """Step t's global batch rows [r*B:(r+1)*B] must equal what rank r's
+    per-process loader would have produced (the layout invariant the DP
+    engine relies on)."""
+    ds = DummyDataset(32, 4)
+    sampler = data_sampler(ds, distributed=True, shuffle=False)
+    loader = DataLoader(ds, batch_size=2, sampler=sampler)
+    batches = list(loader)
+    assert len(loader) == len(batches) == 2  # 32/(8 ranks)/2 per rank
+    x0, y0 = batches[0]
+    assert x0.shape == (16, 1)
+    for r in range(8):
+        # rank r, strided shard: indices r, r+8, ... ; first batch = first 2
+        np.testing.assert_array_equal(
+            x0[2 * r: 2 * r + 2, 0], [r, r + 8])
+
+
+def test_loader_non_distributed_shuffles():
+    ds = DummyDataset(32, 4)
+    loader = DataLoader(ds, batch_size=8, sampler=None, shuffle=True)
+    xs = np.concatenate([b[0] for b in loader])
+    assert xs.shape == (32, 1)
+    assert not np.array_equal(xs[:, 0], np.arange(32))  # shuffled
+    assert sorted(xs[:, 0].tolist()) == list(range(32))
+
+
+def test_dummy_dataset_deterministic():
+    a, b = DummyDataset(32, 4), DummyDataset(32, 4)
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_array_equal(a.data[:, 0], np.arange(32))

@@ -1,0 +1,156 @@
+"""DP engine correctness: the loss-parity integration test of SURVEY.md §4 —
+N-device training must reproduce the single-device loss trajectory exactly
+(same global batch), operationalizing BASELINE.json's 'loss-curve parity'.
+Also checks prepare_ddp_model's wrap-iff-distributed contract
+(reference distributed.py:112-115)."""
+
+import importlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_pytorch_tpu as dist
+from distributed_pytorch_tpu import models, optim
+from distributed_pytorch_tpu.ops.losses import cross_entropy_per_example
+from distributed_pytorch_tpu.parallel import (DataParallel, make_train_step,
+                                              prepare_ddp_model)
+
+
+def _loss_fn(model):
+    def loss_fn(p, batch):
+        x, y = batch
+        logits = model.apply(p, x)
+        per_ex = cross_entropy_per_example(logits, y)
+        return per_ex.mean(), {"correct": jnp.argmax(logits, -1) == y}
+    return loss_fn
+
+
+def _run(world_size, steps=8, global_batch=32):
+    """Train DummyModel on a fixed global batch stream; return losses."""
+    if world_size > 1:
+        dist.init_process_group(0, world_size)
+    model = models.DummyModel(in_dim=1, hidden_dim=16, n_classes=4)
+    params = dist.replicate(model.init(jax.random.PRNGKey(0)))
+    optimizer = optim.adamw(1e-3)
+    opt_state = dist.replicate(optimizer.init(params))
+    step = make_train_step(_loss_fn(model), optimizer)
+
+    rng = np.random.default_rng(0)
+    losses = []
+    for t in range(steps):
+        x = rng.random((global_batch, 1), dtype=np.float32)
+        y = rng.integers(0, 4, size=(global_batch,)).astype(np.int32)
+        batch = dist.shard_batch((x, y))
+        params, opt_state, loss, metrics = step(params, opt_state, batch)
+        # global mean loss = mean of per-rank means (equal shards)
+        losses.append(float(np.asarray(loss).mean()))
+    dist.cleanup()
+    return losses
+
+
+def test_loss_parity_1_vs_8_devices():
+    """Same global batches, 1 vs 8 devices: identical trajectories."""
+    ref = _run(world_size=1)
+    dpp = _run(world_size=8)
+    np.testing.assert_allclose(ref, dpp, rtol=2e-5, atol=2e-6)
+
+
+def test_loss_decreases():
+    losses = _run(world_size=8, steps=16)
+    assert losses[-1] < losses[0]
+
+
+def test_per_rank_losses_stacked_layout(group8):
+    model = models.DummyModel(in_dim=1, hidden_dim=8, n_classes=4)
+    params = dist.replicate(model.init(jax.random.PRNGKey(0)))
+    optimizer = optim.sgd(0.1)
+    opt_state = dist.replicate(optimizer.init(params))
+    step = make_train_step(_loss_fn(model), optimizer)
+    x = np.arange(16, dtype=np.float32)[:, None]
+    y = np.zeros((16,), dtype=np.int32)
+    out = step(params, opt_state, dist.shard_batch((x, y)))
+    assert out.loss.shape == (8,)
+    assert np.asarray(out.metrics["correct"]).shape == (16,)
+    # stacked per-rank losses feed the eager collectives directly
+    total = dist.reduce(out.loss)
+    np.testing.assert_allclose(float(total), float(np.asarray(out.loss).sum()),
+                               rtol=1e-6)
+
+
+def test_grad_sync_keeps_params_replicated(group8):
+    """After a step, every device's param copy must be identical — DDP's
+    invariant (ctor broadcast + synchronized updates)."""
+    model = models.DummyModel(in_dim=1, hidden_dim=8, n_classes=4)
+    params = dist.replicate(model.init(jax.random.PRNGKey(0)))
+    optimizer = optim.adamw(1e-2)
+    opt_state = dist.replicate(optimizer.init(params))
+    step = make_train_step(_loss_fn(model), optimizer)
+    rng = np.random.default_rng(1)
+    x = rng.random((16, 1), dtype=np.float32)
+    y = rng.integers(0, 4, size=(16,)).astype(np.int32)
+    params, _, _, _ = step(params, opt_state, dist.shard_batch((x, y)))
+    w = params["lin1"]["w"]
+    shards = [np.asarray(s.data) for s in w.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+
+
+def test_prepare_ddp_model_identity_world1():
+    model = models.DummyModel()
+    assert prepare_ddp_model(model, device_ids=[0]) is model
+
+
+def test_prepare_ddp_model_wraps_when_distributed(group8):
+    model = models.DummyModel()
+    params = model.init(jax.random.PRNGKey(0))
+    wrapped = prepare_ddp_model(model, device_ids=[0], params=params)
+    assert isinstance(wrapped, DataParallel)
+    x = jnp.ones((8, 1))
+    out = wrapped(wrapped.params, x)
+    assert out.shape == (8, 4)
+
+
+def test_example_min_ddp_parity_0_1_8_devices(monkeypatch, capsys):
+    """The workload runs unmodified on 0, 1, and 8 devices with identical
+    loss trajectories (graceful degradation + loss parity end to end).
+    World 0/1 use global batch 8 (= the default per-rank batch); the 8-rank
+    run uses per-rank batch 1 for the same global batch."""
+    import examples.min_ddp as example
+
+    histories = {}
+    for world, argv in [
+        (0, ["--epochs", "2", "--batch-size", "8"]),
+        (1, ["--epochs", "2", "--batch-size", "8"]),
+        (8, ["--epochs", "2", "--batch-size", "1"]),
+    ]:
+        hist = []
+        monkeypatch.setenv("DPX_CPU_DEVICES", str(max(world, 1)) if world else "")
+        if world == 0:
+            monkeypatch.delenv("DPX_CPU_DEVICES", raising=False)
+        example.main_worker(0, world, argv=argv, quiet=True, history=hist)
+        histories[world] = hist
+
+    assert len(histories[0]) == len(histories[1]) == len(histories[8]) == 8
+    np.testing.assert_allclose(histories[0], histories[1], rtol=1e-6)
+
+    # The single-process run shuffles while the distributed one doesn't
+    # (reference quirk, min_DDP.py:64-66), so for stepwise parity compare
+    # the 8-rank run against an *unshuffled* single-device run: same global
+    # batches in the same order.
+    orig_loader = example.DataLoader
+
+    def no_shuffle_loader(*a, **kw):
+        kw["shuffle"] = False
+        return orig_loader(*a, **kw)
+
+    monkeypatch.setattr(example, "DataLoader", no_shuffle_loader)
+    monkeypatch.setenv("DPX_CPU_DEVICES", "1")
+    ref_ns = []
+    example.main_worker(0, 1, argv=["--epochs", "2", "--batch-size", "8"],
+                        quiet=True, history=ref_ns)
+    # 8-rank reduce is SUM of per-rank mean losses (the reference's
+    # sum-not-avg quirk); per-rank batch 1 makes that 8x the global mean.
+    dpp = [v / 8.0 for v in histories[8]]
+    np.testing.assert_allclose(ref_ns, dpp, rtol=2e-4, atol=1e-5)
