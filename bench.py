@@ -1,0 +1,142 @@
+"""Benchmark: min_ddp steps/sec/chip on DummyModel (BASELINE.json metric).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "steps/s", "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md), so the baseline is
+*measured* here: the same workload (MLP 1->hidden->classes, batch 8,
+CrossEntropy, AdamW lr 1e-4) in eager torch on this host's CPU — the
+reference's actual single-process execution model (its world<=1 branch,
+reference distributed.py:54-58, runs plain eager torch with no process
+group). value = this framework's steps/sec on the accelerator using its
+fast path (scan-fused steps: N train steps compiled into one XLA program,
+parallel/data_parallel.py make_scan_train_steps; numerics proven equal to
+per-step execution in tests/test_models.py).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import distributed_pytorch_tpu as dist
+from distributed_pytorch_tpu import models, optim
+from distributed_pytorch_tpu.data import DummyDataset
+from distributed_pytorch_tpu.ops.losses import cross_entropy
+from distributed_pytorch_tpu.parallel import (make_scan_train_steps,
+                                              make_train_step)
+
+BATCH = 8
+HIDDEN = 32
+N_CLASSES = 4
+DATA_SIZE = 32
+
+
+def _batches(n_steps: int, seed: int = 0):
+    """Cycle the seeded DummyDataset in loader order, batch 8 (the
+    reference's implicit benchmark config, BASELINE.md)."""
+    ds = DummyDataset(DATA_SIZE, N_CLASSES, seed=seed)
+    xs, ys = [], []
+    for t in range(n_steps):
+        idx = np.arange(t * BATCH, (t + 1) * BATCH) % DATA_SIZE
+        xs.append(ds.data[idx])
+        ys.append(ds.labels[idx])
+    return np.stack(xs), np.stack(ys)
+
+
+def bench_ours(n_steps: int = 2000, fused_chunk: int = 100):
+    model = models.DummyModel(in_dim=1, hidden_dim=HIDDEN, n_classes=N_CLASSES)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = optim.adamw(1e-4)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return cross_entropy(model.apply(p, x), y), {}
+
+    xs, ys = _batches(fused_chunk)
+    xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+
+    # --- fused path: fused_chunk steps per XLA call
+    run = make_scan_train_steps(loss_fn, opt, n_steps=fused_chunk)
+    params2, opt2, losses = run(params, opt_state, (xs, ys))  # compile
+    jax.block_until_ready(losses)
+    n_calls = max(n_steps // fused_chunk, 1)
+    t0 = time.perf_counter()
+    p, o = params2, opt2
+    for _ in range(n_calls):
+        p, o, losses = run(p, o, (xs, ys))
+    jax.block_until_ready(losses)
+    fused_sps = n_calls * fused_chunk / (time.perf_counter() - t0)
+
+    # --- per-step path (one jitted call per step, like the eager loop);
+    # fresh params: the fused path donated (and thus deleted) the originals
+    params = model.init(jax.random.PRNGKey(0))
+    opt_state = opt.init(params)
+    step = make_train_step(loss_fn, opt, donate=False)
+    b0 = (xs[0], ys[0])
+    out = step(params, opt_state, b0)  # compile
+    jax.block_until_ready(out.loss)
+    m = min(n_steps, 500)
+    t0 = time.perf_counter()
+    for _ in range(m):
+        out = step(out.params, out.opt_state, b0)
+    jax.block_until_ready(out.loss)
+    per_step_sps = m / (time.perf_counter() - t0)
+
+    return fused_sps, per_step_sps
+
+
+def bench_torch_cpu(n_steps: int = 500):
+    """The measured baseline: the reference's workload in eager torch CPU."""
+    import torch
+    import torch.nn as nn
+
+    torch.manual_seed(0)
+    model = nn.Sequential(nn.Linear(1, HIDDEN), nn.Linear(HIDDEN, N_CLASSES))
+    opt = torch.optim.AdamW(model.parameters(), 1e-4)
+    crit = nn.CrossEntropyLoss()
+    ds = DummyDataset(DATA_SIZE, N_CLASSES)
+    x = torch.tensor(ds.data[:BATCH])
+    y = torch.tensor(ds.labels[:BATCH]).long()
+    # warmup
+    for _ in range(20):
+        opt.zero_grad(); crit(model(x), y).backward(); opt.step()
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        opt.zero_grad()
+        loss = crit(model(x), y)
+        loss.backward()
+        opt.step()
+    return n_steps / (time.perf_counter() - t0)
+
+
+def main():
+    fused, per_step, baseline = None, None, None
+    fused, per_step = bench_ours()
+    try:
+        baseline = bench_torch_cpu()
+    except Exception:
+        baseline = None
+
+    value = fused
+    rec = {
+        "metric": "min_ddp_dummymodel_steps_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "steps/s",
+        "vs_baseline": round(value / baseline, 2) if baseline else None,
+        "per_step_path_steps_per_sec": round(per_step, 1),
+        "torch_cpu_baseline_steps_per_sec": round(baseline, 1) if baseline else None,
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

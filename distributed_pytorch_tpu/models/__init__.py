@@ -1,4 +1,6 @@
 """Model zoo: the reference workload's MLP plus the evaluation-ladder
-models (ResNet, Transformer LM)."""
-from . import mlp
+models (ResNet-18, Transformer LM)."""
+from . import mlp, resnet, transformer
 from .mlp import DummyModel
+from .resnet import ResNet18
+from .transformer import TransformerLM

@@ -1,0 +1,66 @@
+"""Transformer language model — the framework's flagship model.
+
+Covers the reference ladder's 'nn.TransformerEncoder LM on WikiText-2' rung
+(BASELINE.md) as a decoder-only causal LM (the modern equivalent of the
+masked-encoder LM setup). Designed mesh-first: every parameter has a
+tensor-parallel PartitionSpec (``parallel/tensor.py``), attention takes a
+pluggable core so sequence parallelism (ring attention) drops in, and the
+forward is pure static-shape jnp — one XLA program per step at any mesh
+shape.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.attention import TransformerBlock
+from ..nn.core import Embedding, LayerNorm, Linear, Module, Params
+
+
+class TransformerLM(Module):
+    """Decoder-only causal LM: tok+pos embed → N pre-norm blocks → LN →
+    vocab projection."""
+
+    def __init__(self, vocab: int = 256, dim: int = 128, n_layers: int = 2,
+                 n_heads: int = 4, max_seq: int = 512, mlp_ratio: int = 4,
+                 dropout: float = 0.0, attn_fn: Optional[Callable] = None,
+                 dtype=jnp.float32):
+        self.vocab = vocab
+        self.dim = dim
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.max_seq = max_seq
+        self.dtype = dtype
+        self.tok = Embedding(vocab, dim, dtype=dtype)
+        self.pos = Embedding(max_seq, dim, dtype=dtype)
+        self.blocks = [
+            TransformerBlock(dim, n_heads, mlp_ratio, causal=True,
+                             dropout=dropout, attn_fn=attn_fn, dtype=dtype)
+            for _ in range(n_layers)
+        ]
+        self.ln_f = LayerNorm(dim, dtype=dtype)
+        self.head = Linear(dim, vocab, bias=False, dtype=dtype)
+
+    def init(self, key) -> Params:
+        ks = jax.random.split(key, self.n_layers + 3)
+        return {
+            "tok": self.tok.init(ks[0]),
+            "pos": self.pos.init(ks[1]),
+            "blocks": [b.init(k) for b, k in zip(self.blocks, ks[2:-1])],
+            "ln_f": self.ln_f.init(ks[-1]),
+            "head": self.head.init(ks[-1]),
+        }
+
+    def apply(self, params: Params, tokens, *, rng=None, train: bool = False, **_):
+        """tokens: (B, S) int32 → logits (B, S, vocab)."""
+        b, s = tokens.shape
+        x = self.tok.apply(params["tok"], tokens)
+        x = x + self.pos.apply(params["pos"], jnp.arange(s))
+        for i, blk in enumerate(self.blocks):
+            r = jax.random.fold_in(rng, i) if rng is not None else None
+            x = blk.apply(params["blocks"][i], x, rng=r, train=train)
+        x = self.ln_f.apply(params["ln_f"], x)
+        return self.head.apply(params["head"], x)

@@ -1,4 +1,6 @@
 """Functional nn modules (pytree params, pure apply)."""
-from . import core
+from . import attention, conv, core
+from .attention import (MultiHeadAttention, TransformerBlock, dense_attention)
+from .conv import BatchNorm2d, Conv2d, global_avg_pool, max_pool
 from .core import (Dropout, Embedding, LayerNorm, Linear, Module, Params,
                    Sequential, gelu, relu)

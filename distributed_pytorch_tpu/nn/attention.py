@@ -1,0 +1,103 @@
+"""Attention and transformer blocks — the LM rung of the ladder
+(BASELINE.md: TransformerEncoder LM) and the substrate for long-context
+sequence parallelism (ring attention lives in ``parallel/sequence.py`` and
+plugs in here via the ``attn_fn`` hook).
+
+Compute shapes are chosen for the MXU: projections are single fused
+matmuls over (B*S, D); attention is batched (B, H, S, S) einsums XLA tiles
+onto the systolic array. bfloat16-friendly: pass ``dtype=jnp.bfloat16`` for
+activations/params while softmax runs in float32 for stability.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .core import Dropout, LayerNorm, Linear, Module, Params, gelu
+
+
+def dense_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
+    """Reference attention: softmax(q k^T / sqrt(d)) v.
+
+    q,k,v: (B, H, S, Dh). Softmax in float32 regardless of input dtype.
+    This is the single-device path; ``parallel.sequence.ring_attention``
+    computes the same function with K/V sharded around the mesh ring.
+    """
+    *_, s_q, dh = q.shape
+    s_k = k.shape[-2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(dh)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+class MultiHeadAttention(Module):
+    """Multi-head self-attention with a pluggable core.
+
+    ``attn_fn(q, k, v, causal=...)`` defaults to :func:`dense_attention`;
+    the sequence-parallel engine substitutes ring attention without
+    touching this module's parameters or callers.
+    """
+
+    def __init__(self, dim: int, n_heads: int, *, causal: bool = False,
+                 attn_fn: Optional[Callable] = None, dtype=jnp.float32):
+        if dim % n_heads:
+            raise ValueError(f"dim {dim} not divisible by n_heads {n_heads}")
+        self.dim = dim
+        self.n_heads = n_heads
+        self.head_dim = dim // n_heads
+        self.causal = causal
+        self.attn_fn = attn_fn or dense_attention
+        self.qkv = Linear(dim, 3 * dim, dtype=dtype)
+        self.out = Linear(dim, dim, dtype=dtype)
+
+    def init(self, key) -> Params:
+        k1, k2 = jax.random.split(key)
+        return {"qkv": self.qkv.init(k1), "out": self.out.init(k2)}
+
+    def apply(self, params: Params, x, **kwargs):
+        b, s, d = x.shape
+        qkv = self.qkv.apply(params["qkv"], x)           # (B, S, 3D) one matmul
+        qkv = qkv.reshape(b, s, 3, self.n_heads, self.head_dim)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        o = self.attn_fn(q, k, v, causal=self.causal)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+        return self.out.apply(params["out"], o)
+
+
+class TransformerBlock(Module):
+    """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x)), GELU MLP."""
+
+    def __init__(self, dim: int, n_heads: int, mlp_ratio: int = 4, *,
+                 causal: bool = False, dropout: float = 0.0,
+                 attn_fn: Optional[Callable] = None, dtype=jnp.float32):
+        self.ln1 = LayerNorm(dim, dtype=dtype)
+        self.attn = MultiHeadAttention(dim, n_heads, causal=causal,
+                                       attn_fn=attn_fn, dtype=dtype)
+        self.ln2 = LayerNorm(dim, dtype=dtype)
+        self.fc1 = Linear(dim, mlp_ratio * dim, dtype=dtype)
+        self.fc2 = Linear(mlp_ratio * dim, dim, dtype=dtype)
+        self.drop = Dropout(dropout)
+
+    def init(self, key) -> Params:
+        ks = jax.random.split(key, 4)
+        return {"ln1": self.ln1.init(ks[0]), "attn": self.attn.init(ks[1]),
+                "ln2": self.ln2.init(ks[2]),
+                "fc1": self.fc1.init(ks[3]),
+                "fc2": self.fc2.init(jax.random.fold_in(ks[3], 1))}
+
+    def apply(self, params: Params, x, *, rng=None, train: bool = False, **_):
+        r1, r2 = (jax.random.split(rng) if rng is not None else (None, None))
+        h = self.attn.apply(params["attn"], self.ln1.apply(params["ln1"], x))
+        x = x + self.drop.apply({}, h, rng=r1, train=train)
+        h = self.fc2.apply(params["fc2"],
+                           gelu(self.fc1.apply(params["fc1"],
+                                               self.ln2.apply(params["ln2"], x))))
+        return x + self.drop.apply({}, h, rng=r2, train=train)

@@ -1,4 +1,6 @@
 """Parallelism engines: data (DDP), tensor, sequence (ring attention),
 pipeline, expert."""
 from . import data_parallel
-from .data_parallel import DataParallel, make_train_step, prepare_ddp_model
+from .data_parallel import (DataParallel, make_scan_train_steps,
+                            make_stateful_train_step, make_train_step,
+                            prepare_ddp_model, stack_state)

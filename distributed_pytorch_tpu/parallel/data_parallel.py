@@ -45,6 +45,14 @@ class StepOutput(NamedTuple):
     metrics: Any             # pytree of (world*B, ...) per-example values
 
 
+class StatefulStepOutput(NamedTuple):
+    params: Any
+    state: Any               # model state (e.g. BatchNorm running stats)
+    opt_state: Any
+    loss: jnp.ndarray
+    metrics: Any
+
+
 def make_train_step(loss_fn: Callable, optimizer: Optimizer,
                     donate: bool = True) -> Callable:
     """Compile a data-parallel training step.
@@ -84,6 +92,105 @@ def make_train_step(loss_fn: Callable, optimizer: Optimizer,
         return StepOutput(*sharded(params, opt_state, batch))
 
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def make_stateful_train_step(loss_fn: Callable, optimizer: Optimizer,
+                             donate: bool = True) -> Callable:
+    """Like :func:`make_train_step` for models with non-trained state
+    (BatchNorm running stats): ``loss_fn(params, state, batch) ->
+    (loss, (new_state, metrics))``. Returns
+    ``step(params, state, opt_state, batch) -> StatefulStepOutput``.
+
+    State follows torch-DDP BatchNorm semantics: each device updates stats
+    from its *local* shard (no cross-device sync); the returned state is
+    the per-device state (kept sharded per rank under world>1).
+    """
+    world = context.get_world_size()
+
+    def local_step(params, state, opt_state, batch):
+        (loss, (new_state, metrics)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, state, batch)
+        if world > 1:
+            grads = jax.lax.pmean(grads, DATA_AXIS)
+        params, opt_state = optimizer.update(grads, opt_state, params)
+        return params, new_state, opt_state, loss[None], metrics
+
+    if world == 1:
+        def step(params, state, opt_state, batch):
+            return StatefulStepOutput(*local_step(params, state, opt_state,
+                                                  batch))
+        return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+
+    mesh = context.get_mesh()
+    # state in/out spec: each device keeps its own running stats. The state
+    # arrives replicated (same init everywhere) but diverges per device; we
+    # shard-map it as per-device local values stacked on a leading axis.
+    sharded = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(), P(DATA_AXIS)),
+        out_specs=(P(), P(DATA_AXIS), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False,
+    )
+
+    def step(params, state, opt_state, batch):
+        return StatefulStepOutput(*sharded(params, state, opt_state, batch))
+
+    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+
+
+def stack_state(state, world: Optional[int] = None):
+    """Stack a single model-state pytree to the per-rank layout the
+    stateful step expects (leading axis = world)."""
+    w = world or context.get_world_size()
+    return jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(jnp.asarray(x)[None],
+                                   (w,) + jnp.shape(x)), state)
+
+
+def make_scan_train_steps(loss_fn: Callable, optimizer: Optimizer,
+                          n_steps: int, donate: bool = True) -> Callable:
+    """Fuse ``n_steps`` training steps into ONE compiled XLA program via
+    ``lax.scan`` over pre-staged batches.
+
+    This is the TPU-idiomatic answer to per-step dispatch overhead (the
+    reference pays Python + NCCL launch latency every iteration;
+    SURVEY.md §3.3): the scanned program keeps params/opt state resident
+    on-device and runs F/B/all-reduce/update n_steps times per host
+    round-trip. Returns
+    ``run(params, opt_state, batches) -> (params, opt_state, losses)`` with
+    ``batches`` a pytree whose leaves are stacked (n_steps, global_batch,
+    ...) and ``losses`` shaped (n_steps, world).
+    """
+    world = context.get_world_size()
+
+    def local_scan(params, opt_state, batches):
+        def body(carry, batch):
+            params, opt_state = carry
+            (loss, _), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            if world > 1:
+                grads = jax.lax.pmean(grads, DATA_AXIS)
+            params, opt_state = optimizer.update(grads, opt_state, params)
+            return (params, opt_state), loss
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), batches)
+        return params, opt_state, losses[:, None]
+
+    if world == 1:
+        def run(params, opt_state, batches):
+            p, o, l = local_scan(params, opt_state, batches)
+            return p, o, l
+        return jax.jit(run, donate_argnums=(0, 1) if donate else ())
+
+    mesh = context.get_mesh()
+    # batches: (n_steps, global_batch, ...) — shard axis 1 over dp
+    sharded = shard_map(
+        local_scan, mesh=mesh,
+        in_specs=(P(), P(), P(None, DATA_AXIS)),
+        out_specs=(P(), P(), P(None, DATA_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
 
 
 class DataParallel:
