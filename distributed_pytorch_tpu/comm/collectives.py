@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 
 from ..runtime import context
+from . import host_backend
 
 _VALID_OPS = ("sum", "avg", "max", "min")
 
@@ -84,6 +85,9 @@ def all_reduce(tensor, op: str = "sum"):
     raises ``ValueError`` like the reference (``distributed.py:131``); as
     there, validation happens only on the distributed path.
     """
+    comm = context.get_host_comm()
+    if comm is not None:
+        return host_backend.all_reduce(comm, tensor, op)
     if context.get_world_size() == 1:
         return tensor
     x = _check_stacked(jnp.asarray(tensor), "all_reduce")
@@ -97,6 +101,9 @@ def reduce(tensor, op: str = "sum"):
     world==1: identity. world>1: input stacked ``(world, *S)``, output the
     reduced tensor of shape S — the value rank 0 holds in the reference
     (non-root contents are backend-defined there, §2.1 #13)."""
+    comm = context.get_host_comm()
+    if comm is not None:
+        return host_backend.reduce(comm, tensor, op)
     if context.get_world_size() == 1:
         return tensor
     return _reduce_stacked(_check_stacked(jnp.asarray(tensor), "reduce"), op)
@@ -109,6 +116,9 @@ def gather(data) -> List:
     primary's gather list ``[rank0, rank1, ...]`` (each shape S). As in the
     reference, equal per-rank shapes are required — guaranteed here by the
     stacked layout."""
+    comm = context.get_host_comm()
+    if comm is not None:
+        return host_backend.gather(comm, data)
     world = context.get_world_size()
     if world == 1:
         return [data]
@@ -127,6 +137,9 @@ def all_gather(data):
     No direct reference analog (its ``gather`` is rooted); provided because
     it is the natural TPU primitive the rooted emulations ride on
     (SURVEY.md §5 'distributed communication backend')."""
+    comm = context.get_host_comm()
+    if comm is not None:
+        return host_backend.all_gather(comm, data)
     world = context.get_world_size()
     if world == 1:
         return jnp.asarray(data)[None]
@@ -139,6 +152,9 @@ def broadcast(tensor, src: int = 0):
     world>1: input stacked ``(world, *S)``; output stacked with every row
     equal to row ``src``. Underlies :func:`sync_params` (reference
     ``distributed.py:163-170``)."""
+    comm = context.get_host_comm()
+    if comm is not None:
+        return host_backend.broadcast(comm, tensor, src)
     world = context.get_world_size()
     if world == 1:
         return tensor
@@ -157,6 +173,9 @@ def sync_params(params: Sequence):
     replicated) rather than moving bytes. It exists for the reference's
     stated use case — non-DDP/EMA params after load — where the input may be
     host or per-device data."""
+    comm = context.get_host_comm()
+    if comm is not None:
+        return host_backend.sync_params(comm, params)
     if not context.is_initialized():
         return list(params)
     return [jax.device_put(p, context.replicated_sharding()) for p in params]
@@ -169,7 +188,12 @@ def barrier():
     A single controller needs no cross-process rendezvous; the observable
     contract — nothing after the barrier begins until everything before it
     finished everywhere — is delivered by draining the async dispatch queue.
+    In host mode (per-rank processes) it is a true cross-process rendezvous
+    on the native group.
     """
+    comm = context.get_host_comm()
+    if comm is not None:
+        return host_backend.barrier(comm)
     if context.get_world_size() == 1:
         return
     # Enqueue a trivial op on EVERY mesh device and block: per-device FIFO

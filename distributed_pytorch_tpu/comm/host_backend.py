@@ -1,0 +1,92 @@
+"""Host-mode collectives: reference-exact per-rank semantics over the
+native TCP process group.
+
+When the current process is a spawned per-rank worker
+(runtime/multiprocess.py), each rank holds its OWN tensor — the reference's
+execution model — and these implementations reproduce reference
+``distributed.py:119-177`` semantics bit-for-bit, including the
+warts: ``reduce`` leaves non-root buffers untouched, ``gather`` returns a
+list of ZEROS on non-primary ranks (reference ``distributed.py:153-160``).
+The transport is native ring-allreduce / hub rooted ops
+(native/dpxhost.cpp), the gloo replacement.
+
+All functions take/return numpy arrays (host-resident data; accelerator
+arrays are converted in, which is exactly what torch's gloo path does with
+CPU staging).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def _to_np(tensor) -> np.ndarray:
+    return np.asarray(tensor)
+
+
+def all_reduce(comm, tensor, op: str = "sum"):
+    """Reference distributed.py:119-133: sum or sum/world, in every rank.
+    (max/min supported too, matching the SPMD front door's extension.)"""
+    x = _to_np(tensor)
+    if op not in ("sum", "avg", "max", "min"):
+        raise ValueError(f'"{op}" is an invalid reduce operation!')
+    orig_dtype = x.dtype
+    if op in ("max", "min"):
+        stacked = comm.all_gather(np.ascontiguousarray(x))
+        return (stacked.max(axis=0) if op == "max"
+                else stacked.min(axis=0))
+    work = x.astype(np.float64) if x.dtype.kind in "iub" else x.copy()
+    comm.allreduce(work)
+    if op == "avg":
+        work = work / comm.world
+    return work.astype(orig_dtype) if x.dtype.kind in "iub" else work
+
+
+def reduce(comm, tensor, op: str = "sum"):
+    """Reference distributed.py:136-144: rooted sum to rank 0; non-root
+    buffers returned unchanged (their contents backend-defined there).
+    Dtype is preserved (integer inputs reduce exactly via float64)."""
+    if op != "sum":
+        raise ValueError(f'"{op}" is an invalid reduce operation!')
+    x = _to_np(tensor)
+    orig_dtype = x.dtype
+    if orig_dtype == np.float32:
+        return comm.reduce(x.copy())
+    # other dtypes: exact sum in f64 via the ring, root casts back,
+    # non-root returns its buffer unchanged (the reference contract)
+    work = x.astype(np.float64)
+    comm.allreduce(work)
+    if comm.rank == 0:
+        return work.astype(orig_dtype)
+    return x.copy()
+
+
+def all_gather(comm, tensor) -> np.ndarray:
+    """Every rank gets the stacked (world, *S) values."""
+    return comm.all_gather(np.ascontiguousarray(_to_np(tensor)))
+
+
+def gather(comm, tensor) -> List[np.ndarray]:
+    """Reference distributed.py:147-160: the primary gets the real values;
+    every other rank gets the zeros it allocated."""
+    x = _to_np(tensor)
+    out = comm.gather(x)
+    if out is not None:
+        return out
+    return [np.zeros_like(x) for _ in range(comm.world)]
+
+
+def broadcast(comm, tensor, src: int = 0):
+    x = _to_np(tensor).copy()
+    return comm.broadcast(x, src=src)
+
+
+def sync_params(comm, params: Sequence) -> list:
+    """Reference distributed.py:163-170: broadcast each tensor from 0."""
+    return [comm.broadcast(_to_np(p).copy(), src=0) for p in params]
+
+
+def barrier(comm):
+    comm.barrier()

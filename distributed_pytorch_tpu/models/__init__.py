@@ -1,6 +1,7 @@
 """Model zoo: the reference workload's MLP plus the evaluation-ladder
-models (ResNet-18, Transformer LM)."""
-from . import mlp, resnet, transformer
+models (ResNet-18, Transformer LM, MoE Transformer LM)."""
+from . import mlp, moe_lm, resnet, transformer
 from .mlp import DummyModel
+from .moe_lm import MoETransformerLM
 from .resnet import ResNet18
 from .transformer import TransformerLM

@@ -1,0 +1,108 @@
+"""Mixture-of-experts Transformer LM — the expert-parallel flagship
+variant: TransformerLM blocks with the dense MLP swapped for a Switch
+MoE layer (parallel/moe.py), experts sharded over the ``ep`` mesh axis."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.attention import MultiHeadAttention
+from ..nn.core import Embedding, LayerNorm, Linear, Module, Params
+from ..parallel.moe import MoELayer, moe_param_specs
+from jax.sharding import PartitionSpec as P
+
+
+class MoEBlock(Module):
+    """Pre-norm block with MoE MLP: x + MHA(LN(x)); x + MoE(LN(x))."""
+
+    def __init__(self, dim: int, n_heads: int, n_experts: int,
+                 mlp_ratio: int = 4, *, causal: bool = True,
+                 capacity_factor: float = 2.0,
+                 attn_fn: Optional[Callable] = None, dtype=jnp.float32):
+        self.ln1 = LayerNorm(dim, dtype=dtype)
+        self.attn = MultiHeadAttention(dim, n_heads, causal=causal,
+                                       attn_fn=attn_fn, dtype=dtype)
+        self.ln2 = LayerNorm(dim, dtype=dtype)
+        self.moe = MoELayer(dim, n_experts, mlp_ratio,
+                            capacity_factor=capacity_factor, dtype=dtype)
+
+    def init(self, key) -> Params:
+        ks = jax.random.split(key, 3)
+        return {"ln1": self.ln1.init(ks[0]), "attn": self.attn.init(ks[1]),
+                "ln2": self.ln2.init(ks[2]), "moe": self.moe.init(ks[2])}
+
+    def apply(self, params: Params, x, **_):
+        x = x + self.attn.apply(params["attn"],
+                                self.ln1.apply(params["ln1"], x))
+        h, aux = self.moe.apply(params["moe"],
+                                self.ln2.apply(params["ln2"], x))
+        return x + h, aux
+
+
+class MoETransformerLM(Module):
+    """Decoder-only LM with MoE MLPs; apply returns (logits, aux_loss)."""
+
+    def __init__(self, vocab: int = 256, dim: int = 128, n_layers: int = 2,
+                 n_heads: int = 4, n_experts: int = 4, max_seq: int = 512,
+                 mlp_ratio: int = 4, capacity_factor: float = 2.0,
+                 attn_fn: Optional[Callable] = None, dtype=jnp.float32):
+        self.vocab = vocab
+        self.dim = dim
+        self.n_layers = n_layers
+        self.n_experts = n_experts
+        self.tok = Embedding(vocab, dim, dtype=dtype)
+        self.pos = Embedding(max_seq, dim, dtype=dtype)
+        self.blocks = [
+            MoEBlock(dim, n_heads, n_experts, mlp_ratio,
+                     capacity_factor=capacity_factor, attn_fn=attn_fn,
+                     dtype=dtype)
+            for _ in range(n_layers)
+        ]
+        self.ln_f = LayerNorm(dim, dtype=dtype)
+        self.head = Linear(dim, vocab, bias=False, dtype=dtype)
+
+    def init(self, key) -> Params:
+        ks = jax.random.split(key, self.n_layers + 3)
+        return {
+            "tok": self.tok.init(ks[0]),
+            "pos": self.pos.init(ks[1]),
+            "blocks": [b.init(k) for b, k in zip(self.blocks, ks[2:-1])],
+            "ln_f": self.ln_f.init(ks[-1]),
+            "head": self.head.init(ks[-1]),
+        }
+
+    def apply(self, params: Params, tokens, *, pos_offset=0, **_):
+        b, s = tokens.shape
+        x = self.tok.apply(params["tok"], tokens)
+        x = x + self.pos.apply(params["pos"], pos_offset + jnp.arange(s))
+        aux_total = 0.0
+        for i, blk in enumerate(self.blocks):
+            x, aux = blk.apply(params["blocks"][i], x)
+            aux_total = aux_total + aux
+        x = self.ln_f.apply(params["ln_f"], x)
+        return self.head.apply(params["head"], x), aux_total / self.n_layers
+
+    def param_specs(self, ep_axis: str = "ep", tp_axis: str = "tp"):
+        """PartitionSpec tree: attention tensor-parallel over ``tp``,
+        experts over ``ep``."""
+        t = tp_axis
+
+        def block_specs():
+            return {
+                "ln1": {"scale": P(), "bias": P()},
+                "attn": {"qkv": {"w": P(None, t), "b": P(t)},
+                         "out": {"w": P(t, None), "b": P()}},
+                "ln2": {"scale": P(), "bias": P()},
+                "moe": moe_param_specs(ep_axis=ep_axis),
+            }
+
+        return {
+            "tok": {"emb": P()},
+            "pos": {"emb": P()},
+            "blocks": [block_specs() for _ in range(self.n_layers)],
+            "ln_f": {"scale": P(), "bias": P()},
+            "head": {"w": P(None, t)},
+        }

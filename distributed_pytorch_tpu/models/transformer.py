@@ -54,11 +54,16 @@ class TransformerLM(Module):
             "head": self.head.init(ks[-1]),
         }
 
-    def apply(self, params: Params, tokens, *, rng=None, train: bool = False, **_):
-        """tokens: (B, S) int32 → logits (B, S, vocab)."""
+    def apply(self, params: Params, tokens, *, rng=None, train: bool = False,
+              pos_offset=0, **_):
+        """tokens: (B, S) int32 → logits (B, S, vocab).
+
+        ``pos_offset`` shifts position ids — under sequence parallelism each
+        device holds a local block whose global positions start at
+        ``axis_index(sp) * S_local``."""
         b, s = tokens.shape
         x = self.tok.apply(params["tok"], tokens)
-        x = x + self.pos.apply(params["pos"], jnp.arange(s))
+        x = x + self.pos.apply(params["pos"], pos_offset + jnp.arange(s))
         for i, blk in enumerate(self.blocks):
             r = jax.random.fold_in(rng, i) if rng is not None else None
             x = blk.apply(params["blocks"][i], x, rng=r, train=train)

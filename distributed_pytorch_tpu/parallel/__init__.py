@@ -1,6 +1,15 @@
 """Parallelism engines: data (DDP), tensor, sequence (ring attention),
-pipeline, expert."""
-from . import data_parallel
+pipeline (GPipe over pp), expert (Switch MoE over ep), and the composed
+GSPMD mesh trainer."""
+from . import data_parallel, moe, pipeline, sequence, spmd, tensor
 from .data_parallel import (DataParallel, make_scan_train_steps,
                             make_stateful_train_step, make_train_step,
                             prepare_ddp_model, stack_state)
+from .moe import MoELayer, moe_param_specs
+from .pipeline import (make_gspmd_pipeline_fn, pipeline_apply,
+                       stack_layer_params)
+from .sequence import make_ring_attn_fn, ring_attention
+from .spmd import (make_gspmd_ring_attn_fn, make_spmd_train_step,
+                   shard_batch_spec)
+from .tensor import (replicated_specs, shard_params,
+                     transformer_lm_param_specs)

@@ -66,6 +66,8 @@ def make_train_step(loss_fn: Callable, optimizer: Optimizer,
     reference's graceful-degradation contract (``distributed.py:54-58``).
     """
     world = context.get_world_size()
+    if context.get_host_comm() is not None:
+        return _make_host_train_step(loss_fn, optimizer)
 
     def local_step(params, opt_state, batch):
         (loss, metrics), grads = jax.value_and_grad(
@@ -92,6 +94,45 @@ def make_train_step(loss_fn: Callable, optimizer: Optimizer,
         return StepOutput(*sharded(params, opt_state, batch))
 
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def _make_host_train_step(loss_fn: Callable, optimizer: Optimizer) -> Callable:
+    """Per-rank-process DDP step (host front door): compiled local
+    forward/backward, then ONE native ring allreduce over a single flat
+    gradient bucket (the reference DDP reducer's bucketed gradient
+    averaging, SURVEY.md §2.3 row 4), then compiled optimizer update.
+
+    Same ``step(params, opt_state, batch) -> StepOutput`` signature as the
+    SPMD path, but ``batch`` is this rank's LOCAL batch and ``loss`` has
+    shape (1,) (this rank's mean loss) — each process holds only its own
+    view, exactly like the reference's workers.
+    """
+    import numpy as np
+
+    comm = context.get_host_comm()
+    world = comm.world
+
+    vg = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+    upd = jax.jit(optimizer.update)
+
+    def step(params, opt_state, batch):
+        (loss, metrics), grads = vg(params, batch)
+        leaves, tree = jax.tree_util.tree_flatten(grads)
+        flat = np.concatenate(
+            [np.asarray(l, dtype=np.float32).ravel() for l in leaves])
+        comm.allreduce(flat)
+        flat /= world  # DDP averages gradients
+        out, off = [], 0
+        for l in leaves:
+            n = l.size
+            out.append(jnp.asarray(flat[off:off + n].reshape(l.shape),
+                                   dtype=l.dtype))
+            off += n
+        grads = jax.tree_util.tree_unflatten(tree, out)
+        params, opt_state = upd(grads, opt_state, params)
+        return StepOutput(params, opt_state, jnp.asarray(loss)[None], metrics)
+
+    return step
 
 
 def make_stateful_train_step(loss_fn: Callable, optimizer: Optimizer,

@@ -1,0 +1,100 @@
+"""Expert parallelism: Switch/GShard-style mixture-of-experts.
+
+No reference analog (SURVEY.md §2.4: EP absent). TPU-native design
+(GShard): routing is *dense tensor algebra* — one-hot dispatch/combine
+einsums with a fixed per-expert capacity — so shapes stay static and the
+whole layer is three einsums XLA maps onto the MXU. Expert weights carry a
+``P('ep', ...)`` spec; the SPMD partitioner turns the dispatch einsum into
+the all-to-all over the ``ep`` mesh axis (the same program a hand-written
+MPI alltoall would compute, derived from layout instead of code).
+
+Top-1 (Switch) routing with capacity factor; overflow tokens are dropped
+(contribute zero — the transformer's residual path carries them), the
+standard Switch behavior. The load-balancing auxiliary loss (Switch
+Transformer eq. 4: E * sum_e f_e * P_e) is returned for the trainer to add.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..nn.core import Linear, Module, Params, gelu
+
+
+class MoELayer(Module):
+    """Token-routed expert FFN bank: x (..., D) -> (y (..., D), aux_loss)."""
+
+    def __init__(self, dim: int, n_experts: int, mlp_ratio: int = 4,
+                 capacity_factor: float = 1.25, dtype=jnp.float32):
+        self.dim = dim
+        self.n_experts = n_experts
+        self.hidden = mlp_ratio * dim
+        self.capacity_factor = capacity_factor
+        self.dtype = dtype
+
+    def init(self, key) -> Params:
+        kg, k1, k2 = jax.random.split(key, 3)
+        bound1 = 1.0 / math.sqrt(self.dim)
+        bound2 = 1.0 / math.sqrt(self.hidden)
+        e, d, h = self.n_experts, self.dim, self.hidden
+        return {
+            "gate": {"w": jax.random.uniform(kg, (d, e), self.dtype,
+                                             -bound1, bound1)},
+            "fc1": {"w": jax.random.uniform(k1, (e, d, h), self.dtype,
+                                            -bound1, bound1),
+                    "b": jnp.zeros((e, h), self.dtype)},
+            "fc2": {"w": jax.random.uniform(k2, (e, h, d), self.dtype,
+                                            -bound2, bound2),
+                    "b": jnp.zeros((e, d), self.dtype)},
+        }
+
+    def apply(self, params: Params, x, **_) -> Tuple[Any, Any]:
+        orig_shape = x.shape
+        n = math.prod(orig_shape[:-1])
+        xt = x.reshape(n, self.dim)
+        e = self.n_experts
+        cap = max(int(self.capacity_factor * n / e), 1)
+
+        logits = xt @ params["gate"]["w"]                     # (N, E)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        expert = jnp.argmax(probs, axis=-1)                   # (N,)
+        gate_val = jnp.max(probs, axis=-1)                    # (N,)
+
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (N, E)
+        # position of each token within its expert's queue
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0        # (N, E)
+        keep = (pos >= 0) & (pos < cap)
+        dispatch = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                                  dtype=jnp.float32) * keep[..., None]
+        # dispatch: (N, E, C) one-hot; combine adds the gate weight
+        combine = dispatch * gate_val[:, None, None]
+
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch,
+                               xt.astype(jnp.float32))          # (E, C, D)
+        h = gelu(jnp.einsum("ecd,edh->ech", expert_in, params["fc1"]["w"])
+                 + params["fc1"]["b"][:, None, :])
+        expert_out = (jnp.einsum("ech,ehd->ecd", h, params["fc2"]["w"])
+                      + params["fc2"]["b"][:, None, :])          # (E, C, D)
+        y = jnp.einsum("nec,ecd->nd", combine, expert_out)
+
+        # Switch aux loss: E * sum_e (fraction routed to e) * (mean prob e)
+        frac = onehot.mean(axis=0)
+        mean_prob = probs.mean(axis=0)
+        aux = e * jnp.sum(frac * mean_prob)
+        return y.reshape(orig_shape).astype(x.dtype), aux
+
+
+def moe_param_specs(ep_axis: str = "ep", tp_axis: Optional[str] = None):
+    """PartitionSpecs for MoELayer params: experts sharded over ``ep``
+    (optionally expert-internal hidden over ``tp``)."""
+    t = tp_axis
+    return {
+        "gate": {"w": P()},
+        "fc1": {"w": P(ep_axis, None, t), "b": P(ep_axis, t)},
+        "fc2": {"w": P(ep_axis, t, None), "b": P(ep_axis, None)},
+    }

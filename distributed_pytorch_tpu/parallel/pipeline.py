@@ -1,0 +1,125 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the ``pp``
+mesh axis, as a differentiable shard_map island.
+
+The reference has no pipeline concept (SURVEY.md §2.4). TPU-native design:
+
+* Stage s holds its slice of the (homogeneous) layer stack — stacked layer
+  params sharded over ``pp`` on the leading axis. Heterogeneous ends
+  (embedding, LM head) stay *outside* the island in the surrounding GSPMD
+  program, so the pipelined middle is uniform.
+* The schedule is a ``lax.scan`` over T + S - 1 ticks: each tick every
+  stage computes its current microbatch and hands its activation to the
+  next stage via ``collective-permute`` (one ICI neighbor hop). No
+  data-dependent control flow — validity is handled by masking, keeping
+  the whole schedule one static XLA program.
+* **Backward is free**: the schedule is ordinary traceable code, so
+  ``jax.grad`` through the island yields the reverse pipeline (cotangents
+  ppermute backwards through the ring) without any hand-written schedule.
+
+This trades bubble overhead (T/(T+S-1) utilization, standard GPipe) for
+zero scheduling machinery; 1F1B can replace the scan body later without
+changing the API.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_params, microbatches, stage_fn, *,
+                   axis_name: str = "pp"):
+    """Run the GPipe schedule inside ``shard_map``.
+
+    stage_params: this stage's params (leading singleton stage axis already
+    stripped by the caller's spec). microbatches: (T, mb, ...) — replicated
+    on every stage; only stage 0 reads them. Returns (T, mb, ...) outputs,
+    valid on the LAST stage (zeros elsewhere); callers psum-mask to
+    replicate.
+    """
+    n_stages = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    t_total = microbatches.shape[0] + n_stages - 1
+    n_micro = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+
+    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(carry, t):
+        recv, outputs = carry
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        first_in = lax.dynamic_index_in_dim(microbatches, mb_idx, axis=0,
+                                            keepdims=False)
+        x = jnp.where(my == 0, first_in, recv)
+        y = stage_fn(stage_params, x)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        valid = (t >= n_stages - 1) & (my == n_stages - 1)
+        prev = lax.dynamic_index_in_dim(outputs, out_idx, axis=0,
+                                        keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(valid, y, prev), out_idx, axis=0)
+        send = lax.ppermute(y, axis_name, fwd_perm)
+        return (send, outputs), None
+
+    recv0 = jnp.zeros(mb_shape, microbatches.dtype)
+    out0 = jnp.zeros_like(microbatches)
+    (_, outputs), _ = lax.scan(tick, (recv0, out0), jnp.arange(t_total))
+    return outputs
+
+
+def make_gspmd_pipeline_fn(mesh: Mesh, stage_fn: Callable,
+                           n_microbatches: int, *, axis_name: str = "pp",
+                           param_axis_spec: P = None):
+    """A GSPMD-island pipeline: ``fn(stacked_stage_params, x) -> y`` for use
+    inside a jitted program.
+
+    stacked_stage_params: pytree with leading axis = n_stages on every leaf
+    (sharded P('pp', ...)). x: (B, ...) activations; B must divide by
+    n_microbatches. stage_fn(stage_params, x_mb) maps one microbatch
+    through one stage's layers. ``param_axis_spec`` overrides the default
+    ``P(axis_name)`` leaf spec (e.g. ``P('pp', 'tp')`` to co-shard stage
+    params over tensor parallelism).
+    """
+    def fn(stacked_params, x):
+        b = x.shape[0]
+        if b % n_microbatches:
+            raise ValueError(
+                f"batch {b} not divisible by n_microbatches={n_microbatches}")
+        mb = b // n_microbatches
+        micro = x.reshape(n_microbatches, mb, *x.shape[1:])
+
+        def island(stacked_params, micro):
+            # P('pp') on the leading (layer) axis leaves each stage holding
+            # its (layers_per_stage, ...) slice — exactly stage_fn's input.
+            outs = pipeline_apply(stacked_params, micro, stage_fn,
+                                  axis_name=axis_name)
+            n_stages = lax.psum(1, axis_name)
+            my = lax.axis_index(axis_name)
+            # replicate the last stage's outputs to every stage
+            mask = (my == n_stages - 1).astype(outs.dtype)
+            return lax.psum(outs * mask, axis_name)
+
+        leaf_spec = param_axis_spec if param_axis_spec is not None \
+            else P(axis_name)
+        param_specs = jax.tree_util.tree_map(
+            lambda _: leaf_spec, stacked_params)
+        y = jax.shard_map(
+            island, mesh=mesh,
+            in_specs=(param_specs, P()),
+            out_specs=P(),
+            check_vma=False,
+        )(stacked_params, micro)
+        return y.reshape(b, *y.shape[2:])
+    return fn
+
+
+def stack_layer_params(layer_params_list):
+    """Stack per-layer param pytrees (a list of identical-structure trees)
+    into one tree with leading axis = n_layers — the layout the pipeline
+    shards over ``pp``."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *layer_params_list)

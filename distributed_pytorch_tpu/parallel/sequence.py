@@ -1,0 +1,100 @@
+"""Sequence/context parallelism: ring attention over a mesh axis.
+
+Long-context scaling the reference cannot do at all (SURVEY.md §2.4: no
+attention, no sequence dimension). Design (Ring Attention / blockwise
+attention): the sequence axis is sharded over the ``sp`` mesh axis — each
+device holds a (B, H, S/n, Dh) block of q/k/v. K/V blocks rotate around the
+ring via ``collective-permute`` (ICI neighbor hops, bandwidth-optimal) while
+each device accumulates its queries' attention over every block with an
+online-softmax (running max / normalizer), so the full S×S score matrix is
+never materialized on any chip: memory is O(S/n · S/n) per step and the
+ppermute overlaps with the block computation in XLA's schedule.
+
+Numerics: softmax statistics in float32 with a finite mask value (no -inf,
+which would NaN on fully-masked rows); exact equality with dense attention
+is asserted in tests/test_sequence_parallel.py.
+
+Causality across blocks: device i's queries own global positions
+[i·S_loc, (i+1)·S_loc); a k/v block with ring index j is fully visible when
+j < i, fully masked when j > i, and lower-triangular when j == i. The
+fully-masked blocks still compute (masked to zero weight) — static shapes
+beat data-dependent control flow on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30  # finite mask value: keeps online softmax NaN-free
+
+
+def _block_update(q, k, v, o, m, l, scale, mask):
+    """One blockwise-attention accumulation step (online softmax).
+
+    q: (B,H,Sq,D); k,v: (B,H,Sk,D); o,m,l running accumulators.
+    mask: (Sq, Sk) boolean of *allowed* positions.
+    """
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    logits = jnp.where(mask, logits, _NEG)
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    p = jnp.exp(logits - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None):
+    """Attention with q/k/v sequence-sharded over ``axis_name``.
+
+    Call inside ``shard_map``: q,k,v are local blocks (B, H, S_local, Dh).
+    Returns the local (B, H, S_local, Dh) output block. Exact (not
+    approximate): identical to dense attention on the gathered sequence.
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, s_loc, dh = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(dh)
+
+    o0 = jnp.zeros((b, h, s_loc, dh), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+
+    # send k/v to the NEXT rank each step => at step t we hold block (my - t)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    tri = jnp.tril(jnp.ones((s_loc, s_loc), bool))
+    full = jnp.ones((s_loc, s_loc), bool)
+
+    def body(t, carry):
+        o, m, l, kt, vt = carry
+        src = (my - t) % n  # global block index currently held
+        if causal:
+            # block fully visible if src < my, diagonal if equal, else masked
+            mask = jnp.where(src == my, tri, jnp.where(src < my, full, ~full))
+        else:
+            mask = full
+        o, m, l = _block_update(q, kt, vt, o, m, l, scale, mask)
+        kt = lax.ppermute(kt, axis_name, perm)
+        vt = lax.ppermute(vt, axis_name, perm)
+        return o, m, l, kt, vt
+
+    o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def make_ring_attn_fn(axis_name: str = "sp"):
+    """An ``attn_fn`` drop-in for :class:`..nn.attention.MultiHeadAttention`
+    that runs ring attention over ``axis_name`` — models switch from dense
+    to sequence-parallel attention without any parameter change."""
+    def attn_fn(q, k, v, *, causal: bool = False, scale=None):
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
+                              scale=scale)
+    return attn_fn

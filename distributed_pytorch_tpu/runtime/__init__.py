@@ -1,5 +1,8 @@
-"""Runtime: device/mesh discovery, process-group lifecycle, launch."""
-from . import context, launcher
-from .context import (DATA_AXIS, device_count, get_device, get_mesh, get_rank,
-                      get_world_size, init_process_group, is_initialized)
+"""Runtime: device/mesh discovery, process-group lifecycle, launchers
+(SPMD single-controller + native per-rank multiprocess)."""
+from . import context, launcher, multiprocess, native
+from .context import (DATA_AXIS, MESH_AXES, device_count, get_device,
+                      get_host_comm, get_mesh, get_rank, get_world_size,
+                      init_mesh, init_process_group, is_initialized)
 from .launcher import find_free_port, launch
+from .multiprocess import launch_multiprocess

@@ -42,6 +42,12 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 DATA_AXIS = "dp"
+TENSOR_AXIS = "tp"
+SEQUENCE_AXIS = "sp"
+PIPELINE_AXIS = "pp"
+EXPERT_AXIS = "ep"
+MESH_AXES = (DATA_AXIS, TENSOR_AXIS, SEQUENCE_AXIS, PIPELINE_AXIS,
+             EXPERT_AXIS)
 
 #: Env var restricting which accelerator devices are visible (analog of
 #: ``CUDA_VISIBLE_DEVICES``, reference ``distributed.py:44``).
@@ -59,6 +65,7 @@ class _State:
     backend: Optional[str] = None
     mesh: Optional[Mesh] = None
     devices: Optional[tuple] = None
+    host_comm: Optional[Any] = None  # native per-rank-process communicator
 
 
 _state = _State()
@@ -133,8 +140,29 @@ def init_process_group(rank: int, world_size: int, backend: Optional[str] = None
     ``backend`` defaults like the reference picks nccl-vs-gloo
     (``distributed.py:63-64``): ``"ici"`` (XLA collectives over the TPU
     interconnect) when an accelerator backs compute, ``"xla-cpu"`` for the
-    virtual CPU mesh.
+    virtual CPU mesh — or ``"host"`` when this process is a spawned
+    per-rank worker (runtime/multiprocess.py), in which case the group is
+    the NATIVE TCP process group (native/dpxhost.cpp), the gloo/c10d
+    equivalent.
     """
+    if backend is None and os.environ.get("DPX_BACKEND") == "host":
+        backend = "host"
+    if backend == "host":
+        from .native import HostComm
+
+        comm = HostComm(
+            os.environ.get("DPX_MASTER_ADDR", "127.0.0.1"),
+            int(os.environ["DPX_MASTER_PORT"]),
+            rank, world_size)
+        _state.initialized = True
+        _state.world_size = world_size
+        _state.rank = rank
+        _state.backend = "host"
+        _state.mesh = None
+        _state.devices = None
+        _state.host_comm = comm
+        return
+
     devices = visible_devices()
     n = len(devices)
     if world_size > max(n, 1):
@@ -162,6 +190,37 @@ def _as_device_array(devices: Sequence[Any]):
     return arr
 
 
+def init_mesh(dp: int = 1, tp: int = 1, sp: int = 1, pp: int = 1,
+              ep: int = 1, backend: Optional[str] = None) -> Mesh:
+    """Initialize a multi-axis device mesh (dp, tp, sp, pp, ep).
+
+    The generalization of :func:`init_process_group` beyond pure data
+    parallelism — the reference has no analog (SURVEY.md §2.4: DP is its
+    only strategy). The 18-function facade keeps working on top: its
+    'world size' is the ``dp`` axis (per-rank data shards), while the
+    tensor/sequence/pipeline/expert engines use the other axes of the same
+    mesh. Axis sizes must multiply to the visible device count.
+    """
+    devices = visible_devices()
+    need = dp * tp * sp * pp * ep
+    if need != max(len(devices), 1):
+        raise ValueError(
+            f"mesh {dp}x{tp}x{sp}x{pp}x{ep}={need} does not match "
+            f"{len(devices)} visible devices")
+    if backend is None:
+        backend = "ici" if jax.default_backend() != "cpu" else "xla-cpu"
+    use = devices if devices else list(jax.devices())[:1]
+    arr = _as_device_array(use).reshape(dp, tp, sp, pp, ep)
+    mesh = Mesh(arr, MESH_AXES)
+    _state.initialized = True
+    _state.world_size = dp
+    _state.rank = 0
+    _state.backend = backend
+    _state.mesh = mesh
+    _state.devices = tuple(use)
+    return mesh
+
+
 def is_initialized() -> bool:
     """Whether the process group exists (reference ``distributed.py:69-74``)."""
     return _state.initialized
@@ -169,12 +228,20 @@ def is_initialized() -> bool:
 
 def destroy_process_group() -> None:
     """Tear down group state (reference ``distributed.py:77-79``)."""
+    if _state.host_comm is not None:
+        _state.host_comm.close()
     _state.initialized = False
     _state.world_size = 1
     _state.rank = 0
     _state.backend = None
     _state.mesh = None
     _state.devices = None
+    _state.host_comm = None
+
+
+def get_host_comm():
+    """The native per-rank-process communicator, or None under SPMD."""
+    return _state.host_comm if _state.initialized else None
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,90 @@
+"""Per-rank-process front door — the ``mp.spawn`` equivalent.
+
+The reference's execution model is one OS process per device with rank
+injection and join-based error propagation (``mp.spawn(worker_fn,
+args=(world_size, *args), nprocs=world_size, join=True)``, reference
+``distributed.py:51-52``). The SPMD path doesn't need it (one controller
+drives all chips), but the capability is part of the surface: this module
+spawns ``worker_fn(rank, world_size, *args)`` in ``nprocs`` OS processes,
+wired to the NATIVE host process group (native/dpxhost.cpp) for
+collectives — the c10d/gloo replacement — and propagates child failures to
+the parent like ``join=True``.
+
+Children are forced onto the CPU XLA backend (the accelerator is owned by
+the SPMD controller path; per-rank host processes are the CPU-fallback
+execution model, reference ``distributed.py:57-58``/gloo).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import sys
+import traceback
+from typing import Callable
+
+from .launcher import find_free_port
+
+_CHILD_ENV = {
+    # keep children off the TPU plugin: host processes are CPU-backed
+    "JAX_PLATFORMS": "cpu",
+    "PALLAS_AXON_POOL_IPS": "",
+}
+
+
+def _worker_shim(rank: int, world_size: int, master_port: int,
+                 worker_fn: Callable, args: tuple, err_q) -> None:
+    try:
+        os.environ["DPX_BACKEND"] = "host"
+        os.environ["DPX_MASTER_PORT"] = str(master_port)
+        os.environ["DPX_MASTER_ADDR"] = "127.0.0.1"
+        worker_fn(rank, world_size, *args)
+    except Exception:
+        err_q.put((rank, traceback.format_exc()))
+        raise
+
+
+def launch_multiprocess(worker_fn: Callable, nprocs: int, *args,
+                        master_port: int = None) -> None:
+    """Spawn ``worker_fn(rank, nprocs, *args)`` in ``nprocs`` processes.
+
+    Worker functions must be picklable (module-level), as with torch's
+    ``mp.spawn``. Raises ``RuntimeError`` carrying the first failing
+    child's traceback (the ``join=True`` contract)."""
+    if nprocs < 1:
+        raise ValueError(f"nprocs must be >= 1, got {nprocs}")
+    port = master_port if master_port is not None else find_free_port()
+
+    ctx = mp.get_context("spawn")
+    err_q = ctx.Queue()
+    saved = {k: os.environ.get(k) for k in _CHILD_ENV}
+    procs = []
+    try:
+        os.environ.update(_CHILD_ENV)
+        for rank in range(nprocs):
+            p = ctx.Process(
+                target=_worker_shim,
+                args=(rank, nprocs, port, worker_fn, args, err_q),
+                daemon=False)
+            p.start()
+            procs.append(p)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    for p in procs:
+        p.join()
+
+    failures = []
+    while not err_q.empty():
+        failures.append(err_q.get())
+    bad = [p.exitcode for p in procs if p.exitcode != 0]
+    if failures:
+        rank, tb = failures[0]
+        raise RuntimeError(
+            f"worker process (rank {rank}) failed:\n{tb}")
+    if bad:
+        raise RuntimeError(f"worker process exited with codes {bad}")

@@ -1,0 +1,180 @@
+"""ctypes bindings for the native host runtime (native/dpxhost.cpp) —
+the c10d-TCPStore/Gloo replacement (SURVEY.md §2.3 rows 2-3).
+
+Auto-builds ``libdpxhost.so`` with g++ on first use if the Makefile output
+is missing (no pip/pybind dependency; pure C ABI + ctypes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libdpxhost.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> None:
+    # Build to a per-pid temp path and rename atomically: concurrently
+    # spawned rank processes may all see the .so missing, and a partially
+    # written file must never be dlopen'd.
+    src = os.path.join(_NATIVE_DIR, "dpxhost.cpp")
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    subprocess.run(
+        ["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-o", tmp, src],
+        check=True, capture_output=True)
+    os.replace(tmp, _LIB_PATH)
+
+
+def load_library():
+    """Load (building if needed) the native library; idempotent."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            _build()
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.dpx_comm_init.restype = ctypes.c_void_p
+        lib.dpx_comm_init.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                      ctypes.c_int, ctypes.c_int,
+                                      ctypes.c_int]
+        lib.dpx_comm_destroy.argtypes = [ctypes.c_void_p]
+        lib.dpx_rank.argtypes = [ctypes.c_void_p]
+        lib.dpx_rank.restype = ctypes.c_int
+        lib.dpx_world.argtypes = [ctypes.c_void_p]
+        lib.dpx_world.restype = ctypes.c_int
+        lib.dpx_allreduce_f32.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(ctypes.c_float),
+                                          ctypes.c_int64]
+        lib.dpx_allreduce_f32.restype = ctypes.c_int
+        lib.dpx_allreduce_f64.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(ctypes.c_double),
+                                          ctypes.c_int64]
+        lib.dpx_allreduce_f64.restype = ctypes.c_int
+        lib.dpx_reduce_f32.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_float),
+                                       ctypes.c_int64]
+        lib.dpx_reduce_f32.restype = ctypes.c_int
+        lib.dpx_gather.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int64, ctypes.c_char_p]
+        lib.dpx_gather.restype = ctypes.c_int
+        lib.dpx_broadcast.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int64, ctypes.c_int]
+        lib.dpx_broadcast.restype = ctypes.c_int
+        lib.dpx_barrier.argtypes = [ctypes.c_void_p]
+        lib.dpx_barrier.restype = ctypes.c_int
+        _lib = lib
+        return lib
+
+
+class HostComm:
+    """A native per-process communicator (one per rank OS process).
+
+    The process-group object of the per-rank-process front door: ring
+    allreduce + hub rooted collectives over localhost TCP, rendezvoused on
+    ``base_port`` (the MASTER_PORT analog, reference distributed.py:48-49).
+    """
+
+    def __init__(self, master_addr: str, base_port: int, rank: int,
+                 world: int, timeout_ms: int = 30000):
+        import socket as _socket
+
+        self._lib = load_library()
+        # the native layer takes dotted-quad only; resolve hostnames (e.g.
+        # 'localhost', the reference's MASTER_ADDR default) here
+        addr = _socket.gethostbyname(master_addr)
+        self._h = self._lib.dpx_comm_init(
+            addr.encode(), base_port, rank, world, timeout_ms)
+        if not self._h:
+            raise RuntimeError(
+                f"native rendezvous failed (rank {rank}/{world} on "
+                f"{master_addr}:{base_port})")
+        self.rank = rank
+        self.world = world
+
+    def close(self):
+        if self._h:
+            self._lib.dpx_comm_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _check(self, rc: int, what: str):
+        if rc != 0:
+            raise RuntimeError(f"native {what} failed (rank {self.rank})")
+
+    def allreduce(self, arr: np.ndarray) -> np.ndarray:
+        """In-place ring allreduce (sum) on a float32/float64 array."""
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype == np.float32:
+            rc = self._lib.dpx_allreduce_f32(
+                self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                arr.size)
+        elif arr.dtype == np.float64:
+            rc = self._lib.dpx_allreduce_f64(
+                self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                arr.size)
+        else:
+            raise TypeError(f"allreduce supports f32/f64, got {arr.dtype}")
+        self._check(rc, "allreduce")
+        return arr
+
+    def reduce(self, arr: np.ndarray) -> np.ndarray:
+        """Rooted sum to rank 0 (non-root buffers unchanged)."""
+        arr = np.ascontiguousarray(arr, dtype=np.float32)
+        rc = self._lib.dpx_reduce_f32(
+            self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            arr.size)
+        self._check(rc, "reduce")
+        return arr
+
+    def gather(self, arr: np.ndarray) -> Optional[list]:
+        """Rooted gather to rank 0: returns the list there, None elsewhere."""
+        arr = np.ascontiguousarray(arr)
+        nbytes = arr.nbytes
+        if self.rank == 0:
+            recv = np.zeros((self.world,) + arr.shape, dtype=arr.dtype)
+            rc = self._lib.dpx_gather(
+                self._h, arr.tobytes(), nbytes,
+                recv.ctypes.data_as(ctypes.c_char_p))
+            self._check(rc, "gather")
+            return [recv[r] for r in range(self.world)]
+        rc = self._lib.dpx_gather(self._h, arr.tobytes(), nbytes, None)
+        self._check(rc, "gather")
+        return None
+
+    def all_gather(self, arr: np.ndarray) -> np.ndarray:
+        """Every rank gets the stacked (world, *shape) values (gather to
+        the hub + broadcast)."""
+        arr = np.ascontiguousarray(arr)
+        if self.rank == 0:
+            stacked = np.stack(self.gather(arr))
+        else:
+            self.gather(arr)
+            stacked = np.zeros((self.world,) + arr.shape, dtype=arr.dtype)
+        return self.broadcast(stacked, src=0)
+
+    def broadcast(self, arr: np.ndarray, src: int = 0) -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        rc = self._lib.dpx_broadcast(
+            self._h, arr.ctypes.data_as(ctypes.c_char_p), arr.nbytes, src)
+        self._check(rc, "broadcast")
+        return arr
+
+    def barrier(self):
+        self._check(self._lib.dpx_barrier(self._h), "barrier")

@@ -1,0 +1,402 @@
+// dpxhost — native host-side process group: rendezvous + CPU collectives.
+//
+// TPU-native replacement for the reference's external native stack on the
+// host side (SURVEY.md §2.3): c10d's TCPStore rendezvous + Gloo's CPU
+// collectives, as used via dist.init_process_group(backend="gloo",
+// init_method="env://") (reference distributed.py:62-66) and the collective
+// calls (reference distributed.py:119-177). The TPU data plane runs XLA
+// collectives over ICI; THIS library serves the per-rank-process front door
+// (one OS process per rank, the reference's execution model) and any
+// host-side tensor sync.
+//
+// Topology (single node, matching the reference's localhost-only scope,
+// reference distributed.py:48):
+//   * every rank r listens on base_port + r
+//   * hub links: rank r>0 <-> rank 0      (rooted ops, barrier)
+//   * ring links: rank r -> rank (r+1)%W  (ring allreduce)
+// Handshake word identifies link purpose + peer rank. Connect retries give
+// the same out-of-order-start tolerance as a TCPStore rendezvous.
+//
+// Collectives:
+//   * allreduce (f32/f64, sum): ring reduce-scatter + ring all-gather —
+//     the bandwidth-optimal Gloo/NCCL algorithm (2*(W-1)/W * bytes moved
+//     per rank).
+//   * reduce (to 0), gather (to 0), broadcast (from src), barrier: hub.
+//
+// C ABI only (ctypes-friendly); no exceptions cross the boundary.
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <poll.h>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xD17C0DE5u;
+constexpr uint32_t kPurposeHub = 1;
+constexpr uint32_t kPurposeRing = 2;
+
+struct Handshake {
+  uint32_t magic;
+  uint32_t purpose;
+  uint32_t rank;
+};
+
+int write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::write(fd, p, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return 0;
+}
+
+int read_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (r == 0) return -1;  // peer closed
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+int set_nodelay(int fd) {
+  int one = 1;
+  return setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+int connect_with_retry(const char* addr, int port, int timeout_ms) {
+  for (int waited = 0; waited <= timeout_ms; waited += 50) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, addr, &sa.sin_addr) != 1) {
+      ::close(fd);
+      return -1;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0) {
+      set_nodelay(fd);
+      return fd;
+    }
+    ::close(fd);
+    ::usleep(50 * 1000);
+  }
+  return -1;
+}
+
+struct Comm {
+  int rank = 0;
+  int world = 1;
+  int listen_fd = -1;
+  std::vector<int> hub_fds;  // rank 0: fd per peer rank (index = rank, [0] unused)
+  int hub_fd = -1;           // rank > 0: link to rank 0
+  int ring_send_fd = -1;     // to (rank+1) % world
+  int ring_recv_fd = -1;     // from (rank-1+world) % world
+};
+
+// Full-duplex bounded exchange: send `sn` bytes while receiving `rn` bytes,
+// interleaved via poll, so simultaneous ring sends can never deadlock on
+// full kernel buffers.
+int send_recv(int send_fd, const char* sbuf, size_t sn, int recv_fd,
+              char* rbuf, size_t rn) {
+  size_t so = 0, ro = 0;
+  while (so < sn || ro < rn) {
+    pollfd fds[2];
+    int nf = 0;
+    int si = -1, ri = -1;
+    if (so < sn) {
+      fds[nf] = {send_fd, POLLOUT, 0};
+      si = nf++;
+    }
+    if (ro < rn) {
+      fds[nf] = {recv_fd, POLLIN, 0};
+      ri = nf++;
+    }
+    if (::poll(fds, static_cast<nfds_t>(nf), -1) < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
+      ssize_t w = ::send(send_fd, sbuf + so, sn - so, MSG_DONTWAIT);
+      if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+        return -1;
+      if (w > 0) so += static_cast<size_t>(w);
+    }
+    if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t r = ::recv(recv_fd, rbuf + ro, rn - ro, MSG_DONTWAIT);
+      if (r == 0) return -1;
+      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+        return -1;
+      if (r > 0) ro += static_cast<size_t>(r);
+    }
+  }
+  return 0;
+}
+
+int listen_on(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_ANY);
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
+      ::listen(fd, 64) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque comm handle, or null on failure. All ranks call this
+// concurrently; it blocks until the hub and ring links are up.
+void* dpx_comm_init(const char* master_addr, int base_port, int rank,
+                    int world, int timeout_ms) {
+  if (world < 1 || rank < 0 || rank >= world) return nullptr;
+  Comm* c = new Comm();
+  c->rank = rank;
+  c->world = world;
+  if (world == 1) return c;
+
+  c->listen_fd = listen_on(base_port + rank);
+  if (c->listen_fd < 0) {
+    delete c;
+    return nullptr;
+  }
+
+  // Outbound links (retry until peers are listening):
+  if (rank != 0) {
+    c->hub_fd = connect_with_retry(master_addr, base_port, timeout_ms);
+    if (c->hub_fd < 0) goto fail;
+    Handshake h{kMagic, kPurposeHub, static_cast<uint32_t>(rank)};
+    if (write_all(c->hub_fd, &h, sizeof(h)) != 0) goto fail;
+  }
+  {
+    int next = (rank + 1) % world;
+    c->ring_send_fd = connect_with_retry(master_addr, base_port + next,
+                                         timeout_ms);
+    if (c->ring_send_fd < 0) goto fail;
+    Handshake h{kMagic, kPurposeRing, static_cast<uint32_t>(rank)};
+    if (write_all(c->ring_send_fd, &h, sizeof(h)) != 0) goto fail;
+  }
+
+  // Inbound links: rank 0 expects world-1 hub conns; everyone expects one
+  // ring conn from the previous rank.
+  {
+    int expect = (rank == 0) ? world - 1 + 1 : 1;
+    c->hub_fds.assign(static_cast<size_t>(world), -1);
+    for (int i = 0; i < expect; i++) {
+      int fd = ::accept(c->listen_fd, nullptr, nullptr);
+      if (fd < 0) goto fail;
+      set_nodelay(fd);
+      Handshake h{};
+      if (read_all(fd, &h, sizeof(h)) != 0 || h.magic != kMagic) {
+        ::close(fd);
+        goto fail;
+      }
+      if (h.purpose == kPurposeHub && rank == 0) {
+        c->hub_fds[h.rank] = fd;
+      } else if (h.purpose == kPurposeRing) {
+        c->ring_recv_fd = fd;
+      } else {
+        ::close(fd);
+        goto fail;
+      }
+    }
+  }
+  return c;
+
+fail:
+  if (c->listen_fd >= 0) ::close(c->listen_fd);
+  if (c->hub_fd >= 0) ::close(c->hub_fd);
+  if (c->ring_send_fd >= 0) ::close(c->ring_send_fd);
+  if (c->ring_recv_fd >= 0) ::close(c->ring_recv_fd);
+  delete c;
+  return nullptr;
+}
+
+void dpx_comm_destroy(void* handle) {
+  if (!handle) return;
+  Comm* c = static_cast<Comm*>(handle);
+  if (c->listen_fd >= 0) ::close(c->listen_fd);
+  if (c->hub_fd >= 0) ::close(c->hub_fd);
+  if (c->ring_send_fd >= 0) ::close(c->ring_send_fd);
+  if (c->ring_recv_fd >= 0) ::close(c->ring_recv_fd);
+  for (int fd : c->hub_fds)
+    if (fd >= 0) ::close(fd);
+  delete c;
+}
+
+int dpx_rank(void* handle) { return static_cast<Comm*>(handle)->rank; }
+int dpx_world(void* handle) { return static_cast<Comm*>(handle)->world; }
+
+// Ring allreduce, sum, element type selected by elem_size (4=f32, 8=f64).
+// Bandwidth-optimal: reduce-scatter then all-gather, each W-1 hops of
+// n/W elements.
+static int ring_allreduce(Comm* c, char* data, int64_t n, int elem_size) {
+  if (c->world == 1) return 0;
+  const int w = c->world;
+  const int64_t chunk = (n + w - 1) / w;  // elements per segment (last ragged)
+  std::vector<char> recv_buf(static_cast<size_t>(chunk) * elem_size);
+
+  auto seg_ptr = [&](int seg) { return data + (chunk * seg) * elem_size; };
+  auto seg_len = [&](int seg) -> int64_t {
+    int64_t lo = chunk * seg;
+    if (lo >= n) return 0;
+    int64_t hi = lo + chunk;
+    return ((hi > n) ? n - lo : chunk);
+  };
+
+  // reduce-scatter: after w-1 steps, rank r owns the full sum of segment
+  // (r+1)%w
+  for (int step = 0; step < w - 1; step++) {
+    int send_seg = (c->rank - step + w) % w;
+    int recv_seg = (c->rank - step - 1 + w) % w;
+    int64_t slen = seg_len(send_seg), rlen = seg_len(recv_seg);
+    if (send_recv(c->ring_send_fd, seg_ptr(send_seg),
+                  static_cast<size_t>(slen) * elem_size, c->ring_recv_fd,
+                  recv_buf.data(), static_cast<size_t>(rlen) * elem_size) != 0)
+      return -1;
+    if (elem_size == 4) {
+      float* d = reinterpret_cast<float*>(seg_ptr(recv_seg));
+      const float* s = reinterpret_cast<const float*>(recv_buf.data());
+      for (int64_t i = 0; i < rlen; i++) d[i] += s[i];
+    } else {
+      double* d = reinterpret_cast<double*>(seg_ptr(recv_seg));
+      const double* s = reinterpret_cast<const double*>(recv_buf.data());
+      for (int64_t i = 0; i < rlen; i++) d[i] += s[i];
+    }
+  }
+  // all-gather the reduced segments around the ring
+  for (int step = 0; step < w - 1; step++) {
+    int send_seg = (c->rank + 1 - step + w) % w;
+    int recv_seg = (c->rank - step + w) % w;
+    int64_t slen = seg_len(send_seg), rlen = seg_len(recv_seg);
+    if (send_recv(c->ring_send_fd, seg_ptr(send_seg),
+                  static_cast<size_t>(slen) * elem_size, c->ring_recv_fd,
+                  seg_ptr(recv_seg),
+                  static_cast<size_t>(rlen) * elem_size) != 0)
+      return -1;
+  }
+  return 0;
+}
+
+int dpx_allreduce_f32(void* handle, float* data, int64_t n) {
+  return ring_allreduce(static_cast<Comm*>(handle),
+                        reinterpret_cast<char*>(data), n, 4);
+}
+
+int dpx_allreduce_f64(void* handle, double* data, int64_t n) {
+  return ring_allreduce(static_cast<Comm*>(handle),
+                        reinterpret_cast<char*>(data), n, 8);
+}
+
+// Rooted reduce (sum) to rank 0 via the hub. Non-root buffers unchanged
+// (matching the reference's "non-root contents are backend-defined"
+// contract, reference distributed.py:136-144).
+int dpx_reduce_f32(void* handle, float* data, int64_t n) {
+  Comm* c = static_cast<Comm*>(handle);
+  if (c->world == 1) return 0;
+  if (c->rank == 0) {
+    std::vector<float> buf(static_cast<size_t>(n));
+    for (int r = 1; r < c->world; r++) {
+      if (read_all(c->hub_fds[r], buf.data(), sizeof(float) * n) != 0)
+        return -1;
+      for (int64_t i = 0; i < n; i++) data[i] += buf[i];
+    }
+    return 0;
+  }
+  return write_all(c->hub_fd, data, sizeof(float) * n);
+}
+
+// Rooted gather to rank 0: recv must hold world*nbytes on rank 0 (its own
+// slot pre-filled by the caller); ignored elsewhere.
+int dpx_gather(void* handle, const char* send, int64_t nbytes, char* recv) {
+  Comm* c = static_cast<Comm*>(handle);
+  if (c->world == 1) {
+    if (recv && recv != send) memcpy(recv, send, static_cast<size_t>(nbytes));
+    return 0;
+  }
+  if (c->rank == 0) {
+    memcpy(recv, send, static_cast<size_t>(nbytes));
+    for (int r = 1; r < c->world; r++) {
+      if (read_all(c->hub_fds[r], recv + nbytes * r,
+                   static_cast<size_t>(nbytes)) != 0)
+        return -1;
+    }
+    return 0;
+  }
+  return write_all(c->hub_fd, send, static_cast<size_t>(nbytes));
+}
+
+// Broadcast from src: relayed through rank 0 when src != 0.
+int dpx_broadcast(void* handle, char* data, int64_t nbytes, int src) {
+  Comm* c = static_cast<Comm*>(handle);
+  if (c->world == 1) return 0;
+  if (src != 0) {
+    if (c->rank == src) {
+      if (write_all(c->hub_fd, data, static_cast<size_t>(nbytes)) != 0)
+        return -1;
+    }
+    if (c->rank == 0) {
+      if (read_all(c->hub_fds[src], data, static_cast<size_t>(nbytes)) != 0)
+        return -1;
+    }
+  }
+  if (c->rank == 0) {
+    for (int r = 1; r < c->world; r++) {
+      if (r == src) continue;  // src already has the data
+      if (write_all(c->hub_fds[r], data, static_cast<size_t>(nbytes)) != 0)
+        return -1;
+    }
+    return 0;
+  }
+  if (c->rank == src) return 0;
+  return read_all(c->hub_fd, data, static_cast<size_t>(nbytes));
+}
+
+// Barrier: hub collects a token from every rank, then releases them.
+int dpx_barrier(void* handle) {
+  Comm* c = static_cast<Comm*>(handle);
+  if (c->world == 1) return 0;
+  uint32_t tok = kMagic;
+  if (c->rank == 0) {
+    for (int r = 1; r < c->world; r++)
+      if (read_all(c->hub_fds[r], &tok, sizeof(tok)) != 0) return -1;
+    for (int r = 1; r < c->world; r++)
+      if (write_all(c->hub_fds[r], &tok, sizeof(tok)) != 0) return -1;
+    return 0;
+  }
+  if (write_all(c->hub_fd, &tok, sizeof(tok)) != 0) return -1;
+  return read_all(c->hub_fd, &tok, sizeof(tok));
+}
+
+}  // extern "C"

@@ -1,0 +1,210 @@
+"""Native host process group: true multi-process collectives (SURVEY.md §4
+'multi-process CPU tests') — ring allreduce, rooted reduce/gather (incl.
+the zeros-on-non-primary gather contract), broadcast, barrier ordering,
+and spawn error propagation (the join=True contract)."""
+
+import multiprocessing as mp
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_pytorch_tpu.runtime.multiprocess import launch_multiprocess
+
+WORLD = 4
+
+
+def _collectives_worker(rank, world, q):
+    """Runs in a spawned process: exercises every collective through the
+    public API (init_process_group routes to the native group via
+    DPX_BACKEND=host set by the launcher)."""
+    import numpy as np
+    import distributed_pytorch_tpu as dist
+
+    dist.init_process_group(rank, world)
+    try:
+        assert dist.get_rank() == rank
+        assert dist.get_world_size() == world
+        assert dist.is_primary() == (rank == 0)
+        assert dist.get_backend() == "host"
+
+        # all_reduce sum + avg (ring)
+        x = np.full((5,), float(rank + 1), np.float32)
+        s = dist.all_reduce(x.copy(), op="sum")
+        a = dist.all_reduce(x.copy(), op="avg")
+
+        # big buffer: crosses socket-buffer sizes (deadlock regression)
+        big = np.full((300_000,), float(rank + 1), np.float32)
+        bigsum = dist.all_reduce(big, op="sum")
+
+        # rooted reduce: only rank 0 must hold the sum
+        r = dist.reduce(np.full((3,), float(rank + 1), np.float32))
+
+        # rooted gather: zeros on non-primary (reference wart, exact)
+        g = dist.gather(np.full((2,), float(rank), np.float32))
+
+        # all_gather: every rank sees the stacked values
+        ag = dist.all_gather(np.full((2,), float(rank), np.float32))
+
+        # max all_reduce (SPMD-parity extension)
+        mx = dist.all_reduce(np.full((2,), float(rank), np.float32), op="max")
+
+        # integer reduce must preserve dtype exactly
+        ir = dist.reduce(np.full((2,), rank + 1, np.int64))
+
+        # broadcast from rank 2
+        b = dist.broadcast(np.full((4,), float(rank), np.float32), src=2)
+
+        # sync_params from rank 0
+        p = dist.sync_params([np.full((2,), float(rank), np.float32)])[0]
+
+        dist.barrier()
+        dist.wait_for_everyone()
+
+        q.put((rank, {
+            "sum": s.tolist(), "avg": a.tolist(),
+            "bigsum0": float(bigsum[0]), "bigsum_last": float(bigsum[-1]),
+            "reduce": r.tolist(),
+            "gather": [t.tolist() for t in g],
+            "all_gather": np.asarray(ag).tolist(),
+            "max": mx.tolist(),
+            "int_reduce": ir.tolist(), "int_reduce_dtype": str(ir.dtype),
+            "bcast": b.tolist(), "sync": p.tolist(),
+        }))
+    finally:
+        dist.cleanup()
+
+
+def test_native_collectives_multiprocess():
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    launch_multiprocess(_collectives_worker, WORLD, q)
+    results = {}
+    while len(results) < WORLD:
+        rank, data = q.get(timeout=60)
+        results[rank] = data
+
+    expect_sum = float(sum(range(1, WORLD + 1)))
+    for rank in range(WORLD):
+        d = results[rank]
+        assert d["sum"] == [expect_sum] * 5
+        assert d["avg"] == [expect_sum / WORLD] * 5
+        assert d["bigsum0"] == expect_sum and d["bigsum_last"] == expect_sum
+        assert d["bcast"] == [2.0] * 4          # src rank 2's value
+        assert d["sync"] == [0.0, 0.0]           # rank 0's value
+        assert d["all_gather"] == [[float(r)] * 2 for r in range(WORLD)]
+        assert d["max"] == [float(WORLD - 1)] * 2
+        assert d["int_reduce_dtype"] == "int64"
+        if rank == 0:
+            assert d["int_reduce"] == [int(expect_sum)] * 2
+        else:
+            assert d["int_reduce"] == [rank + 1] * 2
+        if rank == 0:
+            assert d["reduce"] == [expect_sum] * 3
+            assert d["gather"] == [[float(r)] * 2 for r in range(WORLD)]
+        else:
+            # non-root reduce buffer unchanged; gather list all zeros
+            assert d["reduce"] == [float(rank + 1)] * 3
+            assert d["gather"] == [[0.0, 0.0] for _ in range(WORLD)]
+
+
+def _failing_worker(rank, world):
+    import distributed_pytorch_tpu as dist
+    dist.init_process_group(rank, world)
+    try:
+        if rank == 1:
+            raise RuntimeError("boom on rank 1")
+        dist.barrier()  # others would wait; rank 1 dies first
+    finally:
+        dist.cleanup()
+
+
+def test_spawn_propagates_child_failure():
+    """join=True contract (reference distributed.py:51-52): a failing
+    child surfaces in the parent as an exception naming the rank."""
+    with pytest.raises(RuntimeError, match="rank 1"):
+        launch_multiprocess(_failing_worker, 2)
+
+
+def _invalid_op_worker(rank, world):
+    import numpy as np
+    import distributed_pytorch_tpu as dist
+    dist.init_process_group(rank, world)
+    try:
+        try:
+            dist.all_reduce(np.ones(2, np.float32), op="product")
+        except ValueError:
+            return  # expected — reference distributed.py:131
+        raise AssertionError("invalid op did not raise")
+    finally:
+        dist.cleanup()
+
+
+def test_invalid_op_raises_in_host_mode():
+    launch_multiprocess(_invalid_op_worker, 2)
+
+
+def _ddp_worker(rank, world, q):
+    """Fixed global batch split across ranks; host-mode DDP step (native
+    bucketed grad allreduce). Reports the loss trajectory."""
+    import jax
+    import numpy as np
+    import distributed_pytorch_tpu as dist
+    from distributed_pytorch_tpu import models, optim
+    from distributed_pytorch_tpu.ops.losses import cross_entropy_per_example
+    from distributed_pytorch_tpu.parallel import make_train_step
+
+    if world > 1:
+        dist.init_process_group(rank, world)
+    try:
+        model = models.DummyModel(in_dim=1, hidden_dim=8, n_classes=4)
+        params = model.init(jax.random.PRNGKey(0))
+        opt = optim.adamw(1e-2)
+        opt_state = opt.init(params)
+
+        def loss_fn(p, batch):
+            x, y = batch
+            logits = model.apply(p, x)
+            return cross_entropy_per_example(logits, y).mean(), {}
+
+        step = make_train_step(loss_fn, opt)
+        rng = np.random.default_rng(0)
+        losses = []
+        for _ in range(4):
+            x = rng.random((8, 1), dtype=np.float32)
+            y = rng.integers(0, 4, (8,)).astype(np.int32)
+            lo = rank * (8 // max(world, 1))
+            hi = lo + (8 // max(world, 1))
+            out = step(params, opt_state, (x[lo:hi], y[lo:hi]))
+            params, opt_state = out.params, out.opt_state
+            # global mean loss = avg of per-rank means (equal shards)
+            l = dist.all_reduce(
+                np.asarray(out.loss, np.float32), op="avg") \
+                if world > 1 else np.asarray(out.loss)
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+        q.put((rank, losses))
+    finally:
+        dist.cleanup()
+
+
+def test_host_ddp_loss_parity_vs_single_process():
+    """2-process native-DDP training reproduces the single-process loss
+    trajectory on the same global batches (BASELINE loss-curve parity,
+    host front door)."""
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    launch_multiprocess(_ddp_worker, 1, q)
+    _, ref = q.get(timeout=60)
+
+    q2 = ctx.Queue()
+    launch_multiprocess(_ddp_worker, 2, q2)
+    results = {}
+    while len(results) < 2:
+        rank, losses = q2.get(timeout=60)
+        results[rank] = losses
+
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-6)
+    np.testing.assert_allclose(ref, results[0], rtol=2e-5, atol=1e-6)

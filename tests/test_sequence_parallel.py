@@ -1,0 +1,118 @@
+"""Ring attention (sequence parallelism): exactness vs dense attention,
+causal correctness, and the full dp x tp x sp mesh-composed training step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import distributed_pytorch_tpu as dist
+from distributed_pytorch_tpu import models, optim
+from distributed_pytorch_tpu.nn.attention import dense_attention
+from distributed_pytorch_tpu.ops.losses import cross_entropy_per_example
+from distributed_pytorch_tpu.parallel.sequence import ring_attention
+from distributed_pytorch_tpu.parallel.spmd import (make_gspmd_ring_attn_fn,
+                                                   make_spmd_train_step,
+                                                   shard_batch_spec)
+from distributed_pytorch_tpu.parallel.tensor import (
+    replicated_specs, shard_params, transformer_lm_param_specs)
+from distributed_pytorch_tpu.runtime import context
+
+
+@pytest.fixture
+def sp_mesh8():
+    mesh = context.init_mesh(sp=8)
+    yield mesh
+    dist.cleanup()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(sp_mesh8, causal):
+    """Ring attention over 8 sequence shards == dense attention, exactly."""
+    rng = np.random.default_rng(0)
+    b, h, s, d = 2, 3, 32, 8
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+
+    want = dense_attention(q, k, v, causal=causal)
+
+    spec = P(None, None, "sp", None)
+    f = jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="sp",
+                                       causal=causal),
+        mesh=sp_mesh8,
+        in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    got = jax.jit(f)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_gspmd_ring_attn_island(sp_mesh8):
+    """The shard_map island composes inside a jitted GSPMD program."""
+    attn = make_gspmd_ring_attn_fn(sp_mesh8)
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((1, 2, 16, 4)), jnp.float32)
+    got = jax.jit(lambda q: attn(q, q, q, causal=True))(q)
+    want = dense_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def _lm_loss(model):
+    def loss_fn(p, batch):
+        x, y = batch
+        logits = model.apply(p, x)
+        per_tok = cross_entropy_per_example(logits, y)
+        return per_tok.mean(), {}
+    return loss_fn
+
+
+def test_dp_tp_sp_mesh_train_step():
+    """Full composition: batch over dp=2, heads/mlp over tp=2, sequence
+    over sp=2 — one jitted train step, loss matches the single-device
+    run of the same model/batch."""
+    mesh = context.init_mesh(dp=2, tp=2, sp=2)
+    try:
+        model = models.TransformerLM(
+            vocab=32, dim=16, n_layers=2, n_heads=2, max_seq=8,
+            attn_fn=make_gspmd_ring_attn_fn(mesh))
+        ref_model = models.TransformerLM(
+            vocab=32, dim=16, n_layers=2, n_heads=2, max_seq=8)
+
+        params0 = ref_model.init(jax.random.PRNGKey(0))
+        specs = transformer_lm_param_specs(model)
+        params = shard_params(params0, specs, mesh)
+        opt = optim.adamw(1e-3)
+        opt_state = opt.init(params)
+
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, 32, (4, 8)).astype(np.int32)
+        batch = shard_batch_spec((toks, toks), mesh, P("dp", "sp"))
+
+        step = make_spmd_train_step(_lm_loss(model), opt, donate=False)
+        out = step(params, opt_state, batch)
+
+        # single-device reference: same params, same batch
+        ref_loss, _ = _lm_loss(ref_model)(params0, (jnp.asarray(toks),
+                                                    jnp.asarray(toks)))
+        np.testing.assert_allclose(float(out.loss), float(ref_loss),
+                                   rtol=2e-5)
+        # params stay sharded per spec after the update
+        qkv_w = out.params["blocks"][0]["attn"]["qkv"]["w"]
+        assert qkv_w.sharding.spec == P(None, "tp")
+
+        # and training actually progresses under the full mesh
+        losses = [float(out.loss)]
+        for _ in range(3):
+            out = step(out.params, out.opt_state, batch)
+            losses.append(float(out.loss))
+        assert losses[-1] < losses[0]
+    finally:
+        dist.cleanup()
+
+
+def test_init_mesh_validation():
+    with pytest.raises(ValueError):
+        context.init_mesh(dp=3, tp=2)  # 6 != 8 devices
