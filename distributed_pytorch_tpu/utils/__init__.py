@@ -1,3 +1,5 @@
 """Utilities: primary-only logging, metrics, checkpointing, config."""
-from . import logging
+from . import checkpoint, logging
+from .checkpoint import (Checkpoint, CheckpointManager, available_steps,
+                         latest_step, restore_checkpoint, save_checkpoint)
 from .logging import MetricsLogger, is_primary, print_primary

@@ -1,0 +1,403 @@
+"""Checkpoint / resume — durable training state for long runs.
+
+The reference has **no** checkpointing (SURVEY.md §5: "Checkpoint / resume:
+ABSENT" — its only state-sync utility is ``sync_params``, reference
+``distributed.py:163-170``, which nothing calls). A real framework needs it,
+so this subsystem provides it TPU-natively:
+
+* A checkpoint is a directory ``step_<N>/`` holding one ``.npz`` of pytree
+  leaves per saved tree (params, opt_state, ...) plus a JSON manifest.
+  No pickle anywhere — restores are safe on untrusted files and stable
+  across refactors.
+* Writes are **atomic** (write to a temp dir, ``os.replace`` into place):
+  a crash mid-save can never corrupt the latest checkpoint — the failure
+  hygiene the reference lacks entirely (its recovery story is a manual
+  ``kill`` command, reference ``README.md:121-125``).
+* **Primary-only write, every-rank read** under the per-rank-process front
+  door (the DDP invariant: replicated state is identical on all ranks, so
+  rank 0's copy is THE checkpoint); a barrier brackets save/restore so
+  non-primary ranks never read a half-written directory. Restoring then
+  re-replicating is the resume-consistency role the reference reserved for
+  ``sync_params`` (SURVEY.md §5).
+* Restore takes an optional ``like=`` template pytree: with it, the exact
+  structure (NamedTuples, custom nodes) is rebuilt via ``tree_unflatten``;
+  without it, nested dict/list structure is reconstructed from the stored
+  key paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import shutil
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .logging import is_primary
+
+_STEP_DIR_RE = re.compile(r"^step_(\d+)$")
+_MANIFEST = "manifest.json"
+
+
+# ---------------------------------------------------------------------------
+# Pytree <-> flat arrays
+# ---------------------------------------------------------------------------
+
+def _escape(part: str) -> str:
+    return part.replace("\\", "\\\\").replace("/", "\\/")
+
+
+def _split_escaped(key: str) -> List[str]:
+    """Split on unescaped '/' only, keeping components escaped."""
+    parts, cur, i = [], [], 0
+    while i < len(key):
+        c = key[i]
+        if c == "\\" and i + 1 < len(key):
+            cur.append(c)
+            cur.append(key[i + 1])
+            i += 2
+        elif c == "/":
+            parts.append("".join(cur))
+            cur = []
+            i += 1
+        else:
+            cur.append(c)
+            i += 1
+    parts.append("".join(cur))
+    return parts
+
+
+def _unescape(part: str) -> str:
+    out, i = [], 0
+    while i < len(part):
+        if part[i] == "\\" and i + 1 < len(part):
+            out.append(part[i + 1])
+            i += 2
+        else:
+            out.append(part[i])
+            i += 1
+    return "".join(out)
+
+
+def _path_parts(path) -> Tuple[List[str], List[bool]]:
+    """String components of a key path + which are sequence indices."""
+    parts, is_seq = [], []
+    for k in path:
+        if hasattr(k, "key"):        # DictKey
+            parts.append(str(k.key)); is_seq.append(False)
+        elif hasattr(k, "idx"):      # SequenceKey (list/tuple)
+            parts.append(str(k.idx)); is_seq.append(True)
+        elif hasattr(k, "name"):     # GetAttrKey (NamedTuple/dataclass)
+            parts.append(str(k.name)); is_seq.append(False)
+        else:
+            parts.append(str(k)); is_seq.append(False)
+    return parts, is_seq
+
+
+def _flatten(tree) -> Tuple[List[str], List[np.ndarray], List[str]]:
+    """Leaf key paths ('/'-joined, components escaped), leaf arrays, and
+    the set of internal-node paths that are sequences (lists/tuples) — so
+    template-free restore can tell a list from a digit-keyed dict."""
+    leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(tree)
+    keys, arrs, seq_prefixes = [], [], set()
+    for path, leaf in leaves_with_path:
+        parts, is_seq = _path_parts(path)
+        esc = [_escape(p) for p in parts]
+        keys.append("/".join(esc))
+        arrs.append(np.asarray(leaf))
+        for i, s in enumerate(is_seq):
+            if s:
+                seq_prefixes.add("/".join(esc[:i]))
+    return keys, arrs, sorted(seq_prefixes)
+
+
+def _save_tree(path: str, tree) -> Dict[str, Any]:
+    """Save one pytree's leaves to ``path`` (.npz). Returns leaf metadata.
+
+    Extension dtypes (ml_dtypes: bfloat16, fp8 — numpy kind 'V') don't
+    survive the npy format, so those leaves are stored as raw uint8 bytes
+    with dtype+shape recorded in the manifest and reassembled on load.
+    """
+    keys, arrs, seq_prefixes = _flatten(tree)
+    # npz member names must be unique and filesystem-safe; use positional
+    # names and keep the human-readable key paths in the manifest.
+    out, dtypes, shapes = {}, [], []
+    for i, a in enumerate(arrs):
+        if a.dtype.kind == "V":
+            dtypes.append(a.dtype.name)
+            shapes.append(list(a.shape))
+            out[f"leaf_{i}"] = np.frombuffer(
+                np.ascontiguousarray(a).tobytes(), np.uint8)
+        else:
+            dtypes.append(None)
+            shapes.append(None)
+            out[f"leaf_{i}"] = a
+    np.savez(path, **out)
+    return {"keys": keys, "count": len(arrs), "raw_dtypes": dtypes,
+            "raw_shapes": shapes, "seq_prefixes": seq_prefixes}
+
+
+def _load_leaves(path: str, meta: Dict[str, Any]) -> List[np.ndarray]:
+    dtypes = meta.get("raw_dtypes") or [None] * meta["count"]
+    shapes = meta.get("raw_shapes") or [None] * meta["count"]
+    leaves = []
+    with np.load(path) as z:
+        for i in range(meta["count"]):
+            a = z[f"leaf_{i}"]
+            if dtypes[i] is not None:
+                a = np.frombuffer(a.tobytes(), np.dtype(dtypes[i])) \
+                    .reshape(shapes[i])
+            leaves.append(a)
+    return leaves
+
+
+def _nest(keys: Sequence[str], leaves: Sequence[np.ndarray],
+          seq_prefixes: Sequence[str]):
+    """Rebuild nested dicts/lists from key paths (template-free restore).
+
+    ``seq_prefixes`` marks which internal nodes were lists/tuples in the
+    saved tree (digit-keyed dicts stay dicts). A single unnamed leaf
+    (empty key) restores as the bare leaf.
+    """
+    if len(keys) == 1 and keys[0] == "":
+        return leaves[0]
+    seq = set(seq_prefixes)
+    root: Dict[str, Any] = {}
+    for key, leaf in zip(keys, leaves):
+        node = root
+        parts = _split_escaped(key)  # components stay escaped; unescape at use
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = leaf
+    return _listify(root, "", seq)
+
+
+def _listify(node, prefix: str, seq: set):
+    """Recursively convert the dict nodes recorded in ``seq`` into lists
+    (escaped-prefix addressing), unescaping dict keys."""
+    if not isinstance(node, dict):
+        return node
+    if prefix in seq:
+        idxs = sorted(int(k) for k in node)
+        return [_listify(node[str(i)],
+                         f"{prefix}/{i}" if prefix else str(i), seq)
+                for i in idxs]
+    return {_unescape(k): _listify(v, f"{prefix}/{k}" if prefix else k, seq)
+            for k, v in node.items()}
+
+
+# ---------------------------------------------------------------------------
+# Directory layout / discovery
+# ---------------------------------------------------------------------------
+
+def _step_dir(ckpt_dir: str, step: int) -> str:
+    return os.path.join(ckpt_dir, f"step_{step}")
+
+
+def available_steps(ckpt_dir: str) -> List[int]:
+    """Steps with a complete (manifest-bearing) checkpoint, ascending."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    steps = []
+    for name in os.listdir(ckpt_dir):
+        m = _STEP_DIR_RE.match(name)
+        if m and os.path.exists(os.path.join(ckpt_dir, name, _MANIFEST)):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    """Most recent checkpointed step, or None."""
+    steps = available_steps(ckpt_dir)
+    return steps[-1] if steps else None
+
+
+# ---------------------------------------------------------------------------
+# Save / restore
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Checkpoint:
+    step: int
+    params: Any
+    opt_state: Any = None
+    extra: Optional[Dict[str, Any]] = None
+
+
+def save_checkpoint(ckpt_dir: str, step: int, params,
+                    opt_state=None, extra: Optional[Dict[str, Any]] = None,
+                    keep: Optional[int] = None) -> str:
+    """Atomically write ``step_<step>/`` under ``ckpt_dir``.
+
+    Primary-only under a live process group (other ranks no-op); a barrier
+    on both sides makes the checkpoint visible to every rank before anyone
+    proceeds. ``extra`` must be JSON-serializable (e.g. epoch, rng seed).
+    ``keep``: retain only the newest ``keep`` checkpoints after a save.
+    """
+    from ..comm.collectives import barrier
+
+    final = _step_dir(ckpt_dir, step)
+    try:
+        if is_primary():
+            os.makedirs(ckpt_dir, exist_ok=True)
+            tmp = final + f".tmp.{os.getpid()}"
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+            manifest: Dict[str, Any] = {"step": step, "format": 1,
+                                        "extra": extra or {}, "trees": {}}
+            manifest["trees"]["params"] = _save_tree(
+                os.path.join(tmp, "params.npz"), params)
+            if opt_state is not None:
+                manifest["trees"]["opt_state"] = _save_tree(
+                    os.path.join(tmp, "opt_state.npz"), opt_state)
+            with open(os.path.join(tmp, _MANIFEST), "w") as f:
+                json.dump(manifest, f)
+            if os.path.exists(final):
+                # Never rmtree the live checkpoint before the replacement
+                # lands: rename it aside first so a crash between the two
+                # renames still leaves one valid copy.
+                aside = final + f".old.{os.getpid()}"
+                if os.path.exists(aside):
+                    shutil.rmtree(aside)
+                os.replace(final, aside)
+                os.replace(tmp, final)
+                shutil.rmtree(aside, ignore_errors=True)
+            else:
+                os.replace(tmp, final)
+            if keep is not None:
+                for old in available_steps(ckpt_dir)[:-keep]:
+                    if old != step:  # never evict what was just written
+                        shutil.rmtree(_step_dir(ckpt_dir, old),
+                                      ignore_errors=True)
+    finally:
+        # Non-primary ranks wait here; the finally keeps them from hanging
+        # forever when the primary's write raises (they proceed and the
+        # primary's exception propagates on its own rank).
+        barrier()
+    return final
+
+
+def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
+                       like_params=None, like_opt_state=None) -> Checkpoint:
+    """Read ``step_<step>/`` (default: latest) back into host pytrees.
+
+    With ``like_*`` templates the restored trees have exactly the template's
+    structure (tree_unflatten); otherwise nested dict/list structure is
+    rebuilt from stored key paths. Raises FileNotFoundError when nothing is
+    checkpointed.
+    """
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {ckpt_dir!r}")
+    d = _step_dir(ckpt_dir, step)
+    with open(os.path.join(d, _MANIFEST)) as f:
+        manifest = json.load(f)
+
+    def load(name, like):
+        meta = manifest["trees"].get(name)
+        if meta is None:
+            return None
+        leaves = _load_leaves(os.path.join(d, f"{name}.npz"), meta)
+        if like is not None:
+            treedef = jax.tree_util.tree_structure(like)
+            if treedef.num_leaves != len(leaves):
+                raise ValueError(
+                    f"checkpoint tree {name!r} has {len(leaves)} leaves but "
+                    f"template has {treedef.num_leaves}")
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+        return _nest(meta["keys"], leaves, meta.get("seq_prefixes") or [])
+
+    return Checkpoint(step=manifest["step"],
+                      params=load("params", like_params),
+                      opt_state=load("opt_state", like_opt_state),
+                      extra=manifest.get("extra") or {})
+
+
+# ---------------------------------------------------------------------------
+# Manager: interval + retention + async save
+# ---------------------------------------------------------------------------
+
+class CheckpointManager:
+    """Policy wrapper: save every ``interval`` steps, keep the newest
+    ``keep``, optionally in a background thread so the device stays busy
+    (the save cost is host-side serialization; overlap it with compute).
+
+    ``wait()`` (or context-manager exit) joins any in-flight async save —
+    call it before reading the checkpoint back or exiting the process.
+    """
+
+    def __init__(self, ckpt_dir: str, interval: int = 1,
+                 keep: Optional[int] = 3, async_save: bool = False):
+        self.ckpt_dir = ckpt_dir
+        self.interval = max(int(interval), 1)
+        self.keep = keep
+        self.async_save = async_save
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def should_save(self, step: int) -> bool:
+        return step % self.interval == 0
+
+    def save(self, step: int, params, opt_state=None,
+             extra: Optional[Dict[str, Any]] = None, force: bool = False
+             ) -> bool:
+        """Save if the policy says so. Returns True iff a save happened."""
+        if not force and not self.should_save(step):
+            return False
+        self.wait()
+        # Materialize device values on the host *before* handing off to a
+        # thread: the caller may donate/overwrite the arrays next step.
+        params = jax.tree_util.tree_map(np.asarray, params)
+        if opt_state is not None:
+            opt_state = jax.tree_util.tree_map(np.asarray, opt_state)
+        # Async save is single-controller-only: under the per-rank-process
+        # front door the save's barrier would run on a background thread
+        # concurrently with training collectives, breaking the cross-rank
+        # collective ordering the native group requires. Degrade to sync.
+        from ..runtime import context
+        use_async = self.async_save and context.get_host_comm() is None
+        if use_async:
+            def run():
+                try:
+                    save_checkpoint(self.ckpt_dir, step, params, opt_state,
+                                    extra, keep=self.keep)
+                except BaseException as e:  # surfaced by wait()
+                    self._error = e
+            self._thread = threading.Thread(target=run, daemon=True)
+            self._thread.start()
+        else:
+            save_checkpoint(self.ckpt_dir, step, params, opt_state, extra,
+                            keep=self.keep)
+        return True
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def restore_latest(self, like_params=None, like_opt_state=None
+                       ) -> Optional[Checkpoint]:
+        """Latest checkpoint, or None when the directory is empty — the
+        resume-or-fresh-start branch every training script wants."""
+        self.wait()
+        if latest_step(self.ckpt_dir) is None:
+            return None
+        return restore_checkpoint(self.ckpt_dir, like_params=like_params,
+                                  like_opt_state=like_opt_state)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.wait()
+        return False

@@ -1,0 +1,210 @@
+"""Checkpoint/resume subsystem (utils/checkpoint.py) — a capability the
+reference lacks entirely (SURVEY.md §5 'Checkpoint / resume: ABSENT'):
+roundtrip fidelity, template-free restore, retention, atomicity, and the
+train → save → restore → train-equivalence property that defines resume."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_pytorch_tpu as dist
+from distributed_pytorch_tpu import models, optim
+from distributed_pytorch_tpu.ops.losses import cross_entropy_per_example
+from distributed_pytorch_tpu.parallel import make_train_step
+from distributed_pytorch_tpu.utils.checkpoint import (
+    Checkpoint, CheckpointManager, available_steps, latest_step,
+    restore_checkpoint, save_checkpoint)
+
+
+def _tree_eq(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_roundtrip_with_templates(tmp_path):
+    model = models.DummyModel(in_dim=1, hidden_dim=8, n_classes=4)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = optim.adamw(1e-3)
+    opt_state = opt.init(params)
+
+    save_checkpoint(str(tmp_path), 7, params, opt_state,
+                    extra={"epoch": 2})
+    ck = restore_checkpoint(str(tmp_path), like_params=params,
+                            like_opt_state=opt_state)
+    assert ck.step == 7
+    assert ck.extra == {"epoch": 2}
+    _tree_eq(ck.params, params)
+    _tree_eq(ck.opt_state, opt_state)
+    # exact structure (NamedTuple state etc.) preserved via template
+    assert jax.tree_util.tree_structure(ck.opt_state) == \
+        jax.tree_util.tree_structure(opt_state)
+
+
+def test_template_free_restore_nested_dicts(tmp_path):
+    params = {"blocks": [{"w": np.ones((2, 3), np.float32),
+                          "b": np.zeros((3,), np.float32)},
+                         {"w": np.full((3, 1), 2.0, np.float32),
+                          "b": np.ones((1,), np.float32)}],
+              "scale": np.asarray(0.5, np.float32)}
+    save_checkpoint(str(tmp_path), 1, params)
+    ck = restore_checkpoint(str(tmp_path))
+    assert isinstance(ck.params["blocks"], list)
+    _tree_eq(ck.params, params)
+
+
+def test_bfloat16_leaves_roundtrip(tmp_path):
+    params = {"w": jnp.ones((4, 4), jnp.bfloat16) * 1.5}
+    save_checkpoint(str(tmp_path), 1, params)
+    ck = restore_checkpoint(str(tmp_path), like_params=params)
+    assert ck.params["w"].dtype == jnp.bfloat16
+    _tree_eq(ck.params, params)
+
+
+def test_latest_and_retention(tmp_path):
+    p = {"w": np.zeros((1,), np.float32)}
+    for s in (1, 5, 3):
+        save_checkpoint(str(tmp_path), s, p)
+    assert available_steps(str(tmp_path)) == [1, 3, 5]
+    assert latest_step(str(tmp_path)) == 5
+    save_checkpoint(str(tmp_path), 9, p, keep=2)
+    assert available_steps(str(tmp_path)) == [5, 9]
+    # default restore = latest
+    assert restore_checkpoint(str(tmp_path)).step == 9
+
+
+def test_missing_checkpoint_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(str(tmp_path))
+    assert latest_step(str(tmp_path)) is None
+
+
+def test_incomplete_dir_ignored(tmp_path):
+    """A step dir without a manifest (crash mid-write of a non-atomic
+    copy) is invisible to discovery."""
+    os.makedirs(tmp_path / "step_4")
+    p = {"w": np.zeros((1,), np.float32)}
+    save_checkpoint(str(tmp_path), 2, p)
+    assert available_steps(str(tmp_path)) == [2]
+
+
+def _loss_fn(model):
+    def loss_fn(p, batch):
+        x, y = batch
+        return cross_entropy_per_example(model.apply(p, x), y).mean(), {}
+    return loss_fn
+
+
+def _batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.random((8, 1), dtype=np.float32),
+             rng.integers(0, 4, size=(8,)).astype(np.int32))
+            for _ in range(n)]
+
+
+def test_resume_equivalence(tmp_path):
+    """8 straight steps == 4 steps + checkpoint + restore + 4 steps."""
+    model = models.DummyModel(in_dim=1, hidden_dim=8, n_classes=4)
+    opt = optim.adamw(1e-2)
+    step = make_train_step(_loss_fn(model), opt, donate=False)
+    batches = _batches(8)
+
+    params = model.init(jax.random.PRNGKey(0))
+    st = opt.init(params)
+    for b in batches:
+        params, st, loss, _ = step(params, st, b)
+    ref_params = params
+
+    params = model.init(jax.random.PRNGKey(0))
+    st = opt.init(params)
+    for b in batches[:4]:
+        params, st, loss, _ = step(params, st, b)
+    save_checkpoint(str(tmp_path), 4, params, st)
+
+    ck = restore_checkpoint(str(tmp_path), like_params=params,
+                            like_opt_state=st)
+    params, st = ck.params, ck.opt_state
+    for b in batches[4:]:
+        params, st, loss, _ = step(params, st, b)
+    _tree_eq(params, ref_params)
+
+
+def test_resume_under_8way_group(tmp_path, group8):
+    """Primary-only write + barrier under a live group; restored replicated
+    state continues training identically on the mesh."""
+    model = models.DummyModel(in_dim=1, hidden_dim=8, n_classes=4)
+    opt = optim.sgd(0.1)
+    step = make_train_step(_loss_fn(model), opt, donate=False)
+    params = dist.replicate(model.init(jax.random.PRNGKey(0)))
+    st = dist.replicate(opt.init(params))
+    batches = _batches(4, seed=3)
+    for b in batches[:2]:
+        params, st, loss, _ = step(params, st, dist.shard_batch(b))
+    save_checkpoint(str(tmp_path), 2, params, st)
+    ck = restore_checkpoint(str(tmp_path), like_params=params,
+                            like_opt_state=st)
+    p2, s2 = dist.replicate(ck.params), dist.replicate(ck.opt_state)
+    for b in batches[2:]:
+        params, st, loss, _ = step(params, st, dist.shard_batch(b))
+        p2, s2, loss2, _ = step(p2, s2, dist.shard_batch(b))
+        np.testing.assert_allclose(np.asarray(loss), np.asarray(loss2),
+                                   rtol=1e-6)
+    _tree_eq(params, p2)
+
+
+def test_manager_interval_retention_async(tmp_path):
+    p = {"w": np.arange(4, dtype=np.float32)}
+    with CheckpointManager(str(tmp_path), interval=2, keep=2,
+                           async_save=True) as mgr:
+        for s in range(1, 8):
+            saved = mgr.save(s, {"w": p["w"] + s})
+            assert saved == (s % 2 == 0)
+        mgr.wait()
+    assert available_steps(str(tmp_path)) == [4, 6]
+    ck = mgr.restore_latest(like_params=p)
+    assert ck.step == 6
+    np.testing.assert_array_equal(ck.params["w"], p["w"] + 6)
+
+
+def test_manager_restore_latest_empty(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.restore_latest() is None
+
+
+def test_slash_and_digit_dict_keys_roundtrip(tmp_path):
+    """Template-free restore must not mangle '/'-bearing dict keys or
+    digit-keyed dicts (they are legal pytrees, distinct from lists)."""
+    params = {"conv/1": np.ones((2,), np.float32),
+              "heads": {"0": np.zeros((1,), np.float32),
+                        "1": np.ones((1,), np.float32)},
+              "stack": [np.full((1,), 2.0, np.float32),
+                        np.full((1,), 3.0, np.float32)]}
+    save_checkpoint(str(tmp_path), 1, params)
+    ck = restore_checkpoint(str(tmp_path))
+    assert set(ck.params) == {"conv/1", "heads", "stack"}
+    assert isinstance(ck.params["heads"], dict)
+    assert isinstance(ck.params["stack"], list)
+    _tree_eq(ck.params, params)
+
+
+def test_resave_same_step_keeps_valid_checkpoint(tmp_path):
+    p1 = {"w": np.zeros((2,), np.float32)}
+    p2 = {"w": np.ones((2,), np.float32)}
+    save_checkpoint(str(tmp_path), 3, p1)
+    save_checkpoint(str(tmp_path), 3, p2)
+    ck = restore_checkpoint(str(tmp_path), like_params=p2)
+    np.testing.assert_array_equal(ck.params["w"], p2["w"])
+    assert available_steps(str(tmp_path)) == [3]
+
+
+def test_retention_never_evicts_just_saved_step(tmp_path):
+    p = {"w": np.zeros((1,), np.float32)}
+    for s in (5, 9):
+        save_checkpoint(str(tmp_path), s, p)
+    save_checkpoint(str(tmp_path), 1, p, keep=2)
+    assert 1 in available_steps(str(tmp_path))
