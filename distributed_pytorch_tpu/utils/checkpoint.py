@@ -41,6 +41,8 @@ import numpy as np
 from .logging import is_primary
 
 _STEP_DIR_RE = re.compile(r"^step_(\d+)$")
+_OLD_DIR_RE = re.compile(r"^step_(\d+)\.old\.\d+$")
+_TMP_DIR_RE = re.compile(r"^step_(\d+)\.tmp\.\d+$")
 _MANIFEST = "manifest.json"
 
 
@@ -150,8 +152,10 @@ def _load_leaves(path: str, meta: Dict[str, Any]) -> List[np.ndarray]:
         for i in range(meta["count"]):
             a = z[f"leaf_{i}"]
             if dtypes[i] is not None:
+                # copy(): frombuffer returns a read-only view; restored
+                # leaves must all be writable like the np.load ones.
                 a = np.frombuffer(a.tobytes(), np.dtype(dtypes[i])) \
-                    .reshape(shapes[i])
+                    .reshape(shapes[i]).copy()
             leaves.append(a)
     return leaves
 
@@ -199,22 +203,82 @@ def _step_dir(ckpt_dir: str, step: int) -> str:
     return os.path.join(ckpt_dir, f"step_{step}")
 
 
+def _is_complete(ckpt_dir: str, name: str) -> bool:
+    return os.path.exists(os.path.join(ckpt_dir, name, _MANIFEST))
+
+
+def _resolve_step_dir(ckpt_dir: str, step: int) -> Optional[str]:
+    """Directory holding a complete checkpoint for ``step``, or None.
+
+    ``step_<N>`` normally; a complete ``step_<N>.old.<pid>`` as fallback —
+    that dir exists exactly when a re-save of the same step crashed between
+    renaming the previous copy aside and renaming the new one into place
+    (save_checkpoint), and it is guaranteed complete (it WAS the live
+    checkpoint). This keeps every committed step discoverable through the
+    crash window.
+    """
+    if _is_complete(ckpt_dir, f"step_{step}"):
+        return _step_dir(ckpt_dir, step)
+    if not os.path.isdir(ckpt_dir):
+        return None
+    for name in sorted(os.listdir(ckpt_dir)):
+        m = _OLD_DIR_RE.match(name)
+        if m and int(m.group(1)) == step and _is_complete(ckpt_dir, name):
+            return os.path.join(ckpt_dir, name)
+    return None
+
+
 def available_steps(ckpt_dir: str) -> List[int]:
-    """Steps with a complete (manifest-bearing) checkpoint, ascending."""
+    """Steps with a complete (manifest-bearing) checkpoint, ascending.
+
+    Includes steps whose only complete copy is a crash-window ``.old`` dir
+    (see ``_resolve_step_dir``).
+    """
     if not os.path.isdir(ckpt_dir):
         return []
-    steps = []
+    steps = set()
     for name in os.listdir(ckpt_dir):
-        m = _STEP_DIR_RE.match(name)
-        if m and os.path.exists(os.path.join(ckpt_dir, name, _MANIFEST)):
-            steps.append(int(m.group(1)))
+        m = _STEP_DIR_RE.match(name) or _OLD_DIR_RE.match(name)
+        if m and _is_complete(ckpt_dir, name):
+            steps.add(int(m.group(1)))
     return sorted(steps)
+
+
+def _sweep_stale(ckpt_dir: str, keep_old_for: Optional[int] = None) -> None:
+    """Remove leftover ``.tmp``/``.old`` dirs from crashed saves (any pid).
+
+    An ``.old`` dir is preserved when it is the only complete copy of its
+    step (crash-window fallback) or when it belongs to ``keep_old_for``
+    (the step currently being re-saved — its dance manages its own aside).
+    ``.tmp`` dirs are never trusted (possibly partial) and always removed.
+    """
+    if not os.path.isdir(ckpt_dir):
+        return
+    for name in os.listdir(ckpt_dir):
+        p = os.path.join(ckpt_dir, name)
+        if _TMP_DIR_RE.match(name):
+            shutil.rmtree(p, ignore_errors=True)
+            continue
+        m = _OLD_DIR_RE.match(name)
+        if m:
+            s = int(m.group(1))
+            if s != keep_old_for and _is_complete(ckpt_dir, f"step_{s}"):
+                shutil.rmtree(p, ignore_errors=True)
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
     """Most recent checkpointed step, or None."""
     steps = available_steps(ckpt_dir)
     return steps[-1] if steps else None
+
+
+def _remove_step(ckpt_dir: str, step: int) -> None:
+    """Remove every on-disk form of ``step`` (live, .old, .tmp)."""
+    shutil.rmtree(_step_dir(ckpt_dir, step), ignore_errors=True)
+    for name in os.listdir(ckpt_dir):
+        m = _OLD_DIR_RE.match(name) or _TMP_DIR_RE.match(name)
+        if m and int(m.group(1)) == step:
+            shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
 
 
 # ---------------------------------------------------------------------------
@@ -241,10 +305,15 @@ def save_checkpoint(ckpt_dir: str, step: int, params,
     """
     from ..comm.collectives import barrier
 
+    if keep is not None and keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
     final = _step_dir(ckpt_dir, step)
     try:
         if is_primary():
+            # Reject non-serializable extras before any file is touched.
+            json.dumps(extra or {})
             os.makedirs(ckpt_dir, exist_ok=True)
+            _sweep_stale(ckpt_dir, keep_old_for=step)
             tmp = final + f".tmp.{os.getpid()}"
             if os.path.exists(tmp):
                 shutil.rmtree(tmp)
@@ -273,8 +342,7 @@ def save_checkpoint(ckpt_dir: str, step: int, params,
             if keep is not None:
                 for old in available_steps(ckpt_dir)[:-keep]:
                     if old != step:  # never evict what was just written
-                        shutil.rmtree(_step_dir(ckpt_dir, old),
-                                      ignore_errors=True)
+                        _remove_step(ckpt_dir, old)
     finally:
         # Non-primary ranks wait here; the finally keeps them from hanging
         # forever when the primary's write raises (they proceed and the
@@ -290,34 +358,46 @@ def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
     With ``like_*`` templates the restored trees have exactly the template's
     structure (tree_unflatten); otherwise nested dict/list structure is
     rebuilt from stored key paths. Raises FileNotFoundError when nothing is
-    checkpointed.
+    checkpointed. A closing barrier keeps a fast rank from racing ahead and
+    (via a later save's retention) deleting the step dir a slower rank is
+    still reading.
     """
-    if step is None:
-        step = latest_step(ckpt_dir)
+    from ..comm.collectives import barrier
+
+    try:
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {ckpt_dir!r}")
-    d = _step_dir(ckpt_dir, step)
-    with open(os.path.join(d, _MANIFEST)) as f:
-        manifest = json.load(f)
+            step = latest_step(ckpt_dir)
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {ckpt_dir!r}")
+        d = _resolve_step_dir(ckpt_dir, step)
+        if d is None:
+            raise FileNotFoundError(
+                f"no complete checkpoint for step {step} under {ckpt_dir!r}")
+        with open(os.path.join(d, _MANIFEST)) as f:
+            manifest = json.load(f)
 
-    def load(name, like):
-        meta = manifest["trees"].get(name)
-        if meta is None:
-            return None
-        leaves = _load_leaves(os.path.join(d, f"{name}.npz"), meta)
-        if like is not None:
-            treedef = jax.tree_util.tree_structure(like)
-            if treedef.num_leaves != len(leaves):
-                raise ValueError(
-                    f"checkpoint tree {name!r} has {len(leaves)} leaves but "
-                    f"template has {treedef.num_leaves}")
-            return jax.tree_util.tree_unflatten(treedef, leaves)
-        return _nest(meta["keys"], leaves, meta.get("seq_prefixes") or [])
+        def load(name, like):
+            meta = manifest["trees"].get(name)
+            if meta is None:
+                return None
+            leaves = _load_leaves(os.path.join(d, f"{name}.npz"), meta)
+            if like is not None:
+                treedef = jax.tree_util.tree_structure(like)
+                if treedef.num_leaves != len(leaves):
+                    raise ValueError(
+                        f"checkpoint tree {name!r} has {len(leaves)} leaves "
+                        f"but template has {treedef.num_leaves}")
+                return jax.tree_util.tree_unflatten(treedef, leaves)
+            return _nest(meta["keys"], leaves, meta.get("seq_prefixes") or [])
 
-    return Checkpoint(step=manifest["step"],
-                      params=load("params", like_params),
-                      opt_state=load("opt_state", like_opt_state),
-                      extra=manifest.get("extra") or {})
+        return Checkpoint(step=manifest["step"],
+                          params=load("params", like_params),
+                          opt_state=load("opt_state", like_opt_state),
+                          extra=manifest.get("extra") or {})
+    finally:
+        # All ranks leave restore together (and together with any rank that
+        # raised — the finally runs on every exit path, so no deadlock).
+        barrier()
 
 
 # ---------------------------------------------------------------------------
@@ -335,6 +415,8 @@ class CheckpointManager:
 
     def __init__(self, ckpt_dir: str, interval: int = 1,
                  keep: Optional[int] = 3, async_save: bool = False):
+        if keep is not None and keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
         self.ckpt_dir = ckpt_dir
         self.interval = max(int(interval), 1)
         self.keep = keep
@@ -354,9 +436,12 @@ class CheckpointManager:
         self.wait()
         # Materialize device values on the host *before* handing off to a
         # thread: the caller may donate/overwrite the arrays next step.
-        params = jax.tree_util.tree_map(np.asarray, params)
-        if opt_state is not None:
-            opt_state = jax.tree_util.tree_map(np.asarray, opt_state)
+        # Primary-only: save_checkpoint discards the trees on other ranks,
+        # so a full D2H copy there would be a pure stall.
+        if is_primary():
+            params = jax.tree_util.tree_map(np.asarray, params)
+            if opt_state is not None:
+                opt_state = jax.tree_util.tree_map(np.asarray, opt_state)
         # Async save is single-controller-only: under the per-rank-process
         # front door the save's barrier would run on a background thread
         # concurrently with training collectives, breaking the cross-rank

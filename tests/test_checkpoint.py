@@ -208,3 +208,71 @@ def test_retention_never_evicts_just_saved_step(tmp_path):
         save_checkpoint(str(tmp_path), s, p)
     save_checkpoint(str(tmp_path), 1, p, keep=2)
     assert 1 in available_steps(str(tmp_path))
+
+
+def test_keep_zero_rejected(tmp_path):
+    # keep=0 used to silently disable retention ([:-0] == empty slice)
+    params = {"w": np.ones(2, np.float32)}
+    with pytest.raises(ValueError):
+        save_checkpoint(str(tmp_path), 1, params, keep=0)
+    with pytest.raises(ValueError):
+        CheckpointManager(str(tmp_path), keep=0)
+
+
+def test_crash_window_old_dir_discoverable(tmp_path):
+    """Crash between the two os.replace calls of a re-save leaves only
+    step_<N>.old.<pid>; that complete copy must stay discoverable."""
+    params = {"w": np.arange(4, dtype=np.float32)}
+    save_checkpoint(str(tmp_path), 4, params)
+    # simulate the window: live dir renamed aside, new one never landed
+    os.rename(tmp_path / "step_4", tmp_path / "step_4.old.99999")
+    assert available_steps(str(tmp_path)) == [4]
+    assert latest_step(str(tmp_path)) == 4
+    ck = restore_checkpoint(str(tmp_path))
+    _tree_eq(ck.params, params)
+    # the next save of the same step supersedes the .old copy
+    params2 = {"w": np.full(4, 7.0, np.float32)}
+    save_checkpoint(str(tmp_path), 4, params2)
+    _tree_eq(restore_checkpoint(str(tmp_path)).params, params2)
+
+
+def test_stale_tmp_dirs_swept_on_save(tmp_path):
+    """Leftover .tmp/.old dirs from crashed saves (any pid) are removed by
+    the next save instead of leaking forever."""
+    params = {"w": np.ones(2, np.float32)}
+    save_checkpoint(str(tmp_path), 1, params)
+    os.makedirs(tmp_path / "step_9.tmp.12345")
+    # stale .old whose live dir exists -> removable
+    os.makedirs(tmp_path / "step_1.old.12345")
+    save_checkpoint(str(tmp_path), 2, params)
+    names = set(os.listdir(tmp_path))
+    assert "step_9.tmp.12345" not in names
+    assert "step_1.old.12345" not in names
+    assert {"step_1", "step_2"} <= names
+
+
+def test_bad_extra_rejected_before_writing(tmp_path):
+    """Non-JSON extra raises before any file is touched (no tmp leak)."""
+    params = {"w": np.ones(2, np.float32)}
+    with pytest.raises(TypeError):
+        save_checkpoint(str(tmp_path), 1, params, extra={"bad": object()})
+    assert not os.path.isdir(tmp_path) or not os.listdir(tmp_path)
+
+
+def test_restored_extension_dtype_leaves_writable(tmp_path):
+    import ml_dtypes
+    params = {"w": np.arange(6, dtype=ml_dtypes.bfloat16).reshape(2, 3)}
+    save_checkpoint(str(tmp_path), 1, params)
+    ck = restore_checkpoint(str(tmp_path))
+    assert ck.params["w"].flags.writeable
+    ck.params["w"] += np.asarray(1, ml_dtypes.bfloat16)  # must not raise
+
+
+def test_retention_removes_old_and_tmp_forms(tmp_path):
+    params = {"w": np.ones(2, np.float32)}
+    for s in (1, 2, 3):
+        save_checkpoint(str(tmp_path), s, params)
+    os.makedirs(tmp_path / "step_1.old.11111")
+    save_checkpoint(str(tmp_path), 4, params, keep=2)
+    assert available_steps(str(tmp_path)) == [3, 4]
+    assert "step_1.old.11111" not in os.listdir(tmp_path)
