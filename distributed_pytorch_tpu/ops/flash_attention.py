@@ -1,0 +1,415 @@
+"""Flash attention as a Pallas TPU kernel (forward + backward).
+
+The hot op of the Transformer rung (BASELINE.md ladder). The reference
+repo has no attention at all (its model is two Linear layers, reference
+``min_DDP.py:44-48``) — this kernel exists because our framework carries
+full transformer workloads; it is designed for the TPU memory hierarchy
+rather than translated from any CUDA kernel:
+
+- Blockwise online-softmax (FlashAttention-2 schedule): O(S) memory
+  instead of the O(S^2) probability matrix of ``nn.attention.dense_attention``.
+- Q/K/V tiles staged through VMEM by the pallas grid pipeline; the
+  (block_q, block_k) logits tile lives only in registers/VMEM.
+- All matmuls hit the MXU with ``preferred_element_type=float32``;
+  softmax statistics are kept in float32 even for bfloat16 inputs.
+- The TPU grid executes the last axis sequentially (annotated
+  "arbitrary"), which is what makes the scratch-accumulator pattern
+  (m/l/acc carried across k-blocks) correct without atomics; the
+  batch*head and outer block axes are annotated "parallel" so Mosaic can
+  megacore-partition them.
+
+Backward follows FlashAttention-2: recompute p = exp(qk - lse) blockwise;
+one kernel accumulates dK/dV over q-blocks, a second accumulates dQ over
+k-blocks. Residuals are (q, k, v, o, lse) — no S^2 tensor is ever saved.
+
+Numerics are validated against ``dense_attention`` (values and grads) in
+``tests/test_flash_attention.py`` using interpret mode on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Large-negative mask value instead of -inf: -inf - (-inf) = NaN would
+# poison the online-softmax rescaling for fully-masked tiles.
+_MASK = -0.7 * float(jnp.finfo(jnp.float32).max)
+_LANES = 128   # VPU lane width; m/l scratch replicates across lanes.
+_STATS = 8     # trailing dim of row-stat arrays (lse, delta): the smallest
+# width Mosaic's tiling accepts as a full trailing dimension, so stats cost
+# 8 floats/row in HBM instead of a lane-replicated 128.
+
+_PARALLEL = ("parallel", "parallel", "arbitrary")  # grid = (bh, outer, inner)
+
+
+def _interpret_default(interpret):
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(s_q, s_k, block_q, block_k):
+    bq = max(min(block_q, s_q), 1)
+    bk = max(min(block_k, s_k), 1)
+    return bq, bk
+
+
+def _pad_seq(x, block, axis):
+    s = x.shape[axis]
+    pad = (-s) % block
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _frontier_ok(iq, ik, *, block_q, block_k, q_len, k_len):
+    """Whether k-tile ``ik`` intersects the causal-visible region of q-tile
+    ``iq``. The ``k_len - q_len`` offset aligns the causal diagonal when
+    s_q != s_k (query block i attends through absolute key position
+    i + k_len - q_len). Single source of truth for fwd and both bwd kernels —
+    the masks must never desynchronize or gradients silently break."""
+    return ik * block_k <= (iq + 1) * block_q - 1 + (k_len - q_len)
+
+
+def _tile_mask(iq, ik, *, block_q, block_k, q_len, k_len, causal,
+               mask_pad_rows):
+    """Boolean (block_q, block_k) mask of logits to suppress: padded key
+    columns, the causal future, and (in backward only, where padded q rows
+    would otherwise leak into the dK/dV accumulators) padded query rows.
+    In forward, padded-row outputs are sliced away on the host instead."""
+    rows = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    masked = cols >= k_len
+    if mask_pad_rows:
+        masked = jnp.logical_or(masked, rows >= q_len)
+    if causal:
+        masked = jnp.logical_or(masked, cols > rows + (k_len - q_len))
+    return masked
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k, n_k, q_len, k_len):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _MASK)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _body():
+        q = q_ref[0]                                       # (bq, d)
+        k = k_ref[0]                                       # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # (bq, bk)
+        s = jnp.where(
+            _tile_mask(iq, ik, block_q=block_q, block_k=block_k,
+                       q_len=q_len, k_len=k_len, causal=causal,
+                       mask_pad_rows=False),
+            _MASK, s)
+
+        m_old = m_scr[:, :1]                               # (bq, 1)
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                             # (bq, bk)
+        alpha = jnp.exp(m_old - m_new)                     # (bq, 1)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = alpha * acc_scr[:] + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        @pl.when(_frontier_ok(iq, ik, block_q=block_q, block_k=block_k,
+                              q_len=q_len, k_len=k_len))
+        def _():
+            _body()
+    else:
+        _body()
+
+    @pl.when(ik == n_k - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        # Row stats are written (bq, _STATS)-wide: TPU blocks need their
+        # trailing dim to be 128-divisible or the full array dim, so the
+        # stat arrays carry a narrow replicated trailing axis and column 0
+        # is read back on the host side.
+        lse_ref[0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l_safe),
+                                      lse_ref.shape[1:])
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    bq, bk = _block_sizes(s_q, s_k, block_q, block_k)
+
+    q3 = _pad_seq(q.reshape(b * h, s_q, d), bq, 1)
+    k3 = _pad_seq(k.reshape(b * h, s_k, d), bk, 1)
+    v3 = _pad_seq(v.reshape(b * h, s_k, d), bk, 1)
+    sq_p, sk_p = q3.shape[1], k3.shape[1]
+    n_q, n_k = sq_p // bq, sk_p // bk
+
+    kern = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        n_k=n_k, q_len=s_q, k_len=s_k)
+    o3, lse3 = pl.pallas_call(
+        kern,
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, bq, _STATS), lambda bh, iq, ik: (bh, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq_p, _STATS), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL),
+        interpret=_interpret_default(interpret),
+    )(q3, k3, v3)
+    o = o3[:, :s_q].reshape(b, h, s_q, d)
+    lse = lse3[:, :s_q, 0].reshape(b, h, s_q)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _recompute_p(q_ref, k_ref, lse_ref, iq, ik, *, scale, causal, block_q,
+                 block_k, q_len, k_len):
+    """p = exp(qk*scale - lse) for one tile, masked to exact zeros."""
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    masked = _tile_mask(iq, ik, block_q=block_q, block_k=block_k,
+                        q_len=q_len, k_len=k_len, causal=causal,
+                        mask_pad_rows=True)
+    p = jnp.exp(jnp.where(masked, _MASK, s) - lse_ref[0][:, :1])
+    return jnp.where(masked, 0.0, p)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, block_q, block_k, n_q, q_len, k_len):
+    ik, iq = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _body():
+        p = _recompute_p(q_ref, k_ref, lse_ref, iq, ik, scale=scale,
+                         causal=causal, block_q=block_q, block_k=block_k,
+                         q_len=q_len, k_len=k_len)
+        do = do_ref[0].astype(jnp.float32)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # p^T @ dO
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                # dO @ v^T
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # ds^T @ q
+
+    if causal:
+        @pl.when(_frontier_ok(iq, ik, block_q=block_q, block_k=block_k,
+                              q_len=q_len, k_len=k_len))
+        def _():
+            _body()
+    else:
+        _body()
+
+    @pl.when(iq == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr,
+                   *, scale, causal, block_q, block_k, n_k, q_len, k_len):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _body():
+        p = _recompute_p(q_ref, k_ref, lse_ref, iq, ik, scale=scale,
+                         causal=causal, block_q=block_q, block_k=block_k,
+                         q_len=q_len, k_len=k_len)
+        dp = jax.lax.dot_general(
+            do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # ds @ k
+
+    if causal:
+        @pl.when(_frontier_ok(iq, ik, block_q=block_q, block_k=block_k,
+                              q_len=q_len, k_len=k_len))
+        def _():
+            _body()
+    else:
+        _body()
+
+    @pl.when(ik == n_k - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
+               interpret):
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    bq, bk = _block_sizes(s_q, s_k, block_q, block_k)
+    interp = _interpret_default(interpret)
+
+    # delta_i = sum_d dO_i * O_i — tiny elementwise+reduce; XLA fuses it.
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    q3 = _pad_seq(q.reshape(b * h, s_q, d), bq, 1)
+    k3 = _pad_seq(k.reshape(b * h, s_k, d), bk, 1)
+    v3 = _pad_seq(v.reshape(b * h, s_k, d), bk, 1)
+    g3 = _pad_seq(g.reshape(b * h, s_q, d), bq, 1)
+    # Row stats replicated to a narrow (BH, S, _STATS) trailing axis — see
+    # the lse layout note in _fwd_kernel.
+    lse2 = _pad_seq(lse.reshape(b * h, s_q), bq, 1)
+    delta2 = _pad_seq(delta.reshape(b * h, s_q), bq, 1)
+    lse3 = jnp.broadcast_to(lse2[..., None], lse2.shape + (_STATS,))
+    delta3 = jnp.broadcast_to(delta2[..., None], lse3.shape)
+    sq_p, sk_p = q3.shape[1], k3.shape[1]
+    n_q, n_k = sq_p // bq, sk_p // bk
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda bh, ik, iq: (bh, iq, 0))
+    kv_spec = pl.BlockSpec((1, bk, d), lambda bh, ik, iq: (bh, ik, 0))
+    row_spec = pl.BlockSpec((1, bq, _STATS), lambda bh, ik, iq: (bh, iq, 0))
+    dkv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, n_q=n_q,
+                          q_len=s_q, k_len=s_k),
+        grid=(b * h, n_k, n_q),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct((b * h, sk_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk_p, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL),
+        interpret=interp,
+    )(q3, k3, v3, g3, lse3, delta3)
+    dk3, dv3 = dkv
+
+    q_spec2 = pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0))
+    kv_spec2 = pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0))
+    row_spec2 = pl.BlockSpec((1, bq, _STATS), lambda bh, iq, ik: (bh, iq, 0))
+    dq3 = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, n_k=n_k,
+                          q_len=s_q, k_len=s_k),
+        grid=(b * h, n_q, n_k),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=q_spec2,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL),
+        interpret=interp,
+    )(q3, k3, v3, g3, lse3, delta3)
+
+    dq = dq3[:, :s_q].reshape(b, h, s_q, d)
+    dk = dk3[:, :s_k].reshape(b, h, s_k, d)
+    dv = dv3[:, :s_k].reshape(b, h, s_k, d)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
+                      interpret)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Memory-efficient attention: softmax(q k^T * scale) v, blockwise.
+
+    Drop-in for :func:`nn.attention.dense_attention` (same signature,
+    same result up to float tolerance) with O(S) memory and MXU-tiled
+    pallas kernels. q: (B, H, Sq, Dh); k, v: (B, H, Sk, Dh). Sequence
+    lengths need not divide the block sizes (tiles are padded+masked).
+
+    ``interpret=None`` auto-selects interpreter mode off-TPU so the same
+    code path runs in CPU tests (conftest's 8-device CPU mesh) and
+    compiled on real chips.
+    """
+    *_, dh = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(dh)
+    return _flash(q, k, v, causal, float(scale), int(block_q), int(block_k),
+                  interpret)
+
+
+def make_flash_attn_fn(block_q: int = 128, block_k: int = 128,
+                       interpret: Optional[bool] = None):
+    """An ``attn_fn`` for :class:`nn.attention.MultiHeadAttention` /
+    model constructors: models built with this compute attention through
+    the pallas kernel instead of the dense einsum path."""
+
+    def attn_fn(q, k, v, *, causal=False, scale=None):
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret)
+
+    return attn_fn
