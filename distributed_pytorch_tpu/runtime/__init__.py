@@ -1,8 +1,11 @@
 """Runtime: device/mesh discovery, process-group lifecycle, launchers
-(SPMD single-controller + native per-rank multiprocess)."""
-from . import context, launcher, multiprocess, native
+(SPMD single-controller + native per-rank multiprocess), failure
+detection (supervision, heartbeats, orphan cleanup)."""
+from . import context, launcher, multiprocess, native, watchdog
 from .context import (DATA_AXIS, MESH_AXES, device_count, get_device,
                       get_host_comm, get_mesh, get_rank, get_world_size,
                       init_mesh, init_process_group, is_initialized)
 from .launcher import find_free_port, launch
 from .multiprocess import launch_multiprocess
+from .watchdog import (Heartbeat, HeartbeatMonitor, ProcessSupervisor,
+                       StalledWorker, WorkerFailure, kill_orphan_workers)

@@ -19,11 +19,13 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
-import sys
+import time
 import traceback
 from typing import Callable
 
 from .launcher import find_free_port
+from .watchdog import (WORKER_TAG_ENV, ProcessSupervisor,
+                       register_active_tag, unregister_active_tag)
 
 _CHILD_ENV = {
     # keep children off the TPU plugin: host processes are CPU-backed
@@ -45,46 +47,47 @@ def _worker_shim(rank: int, world_size: int, master_port: int,
 
 
 def launch_multiprocess(worker_fn: Callable, nprocs: int, *args,
-                        master_port: int = None) -> None:
+                        master_port: int = None,
+                        grace_s: float = 5.0) -> None:
     """Spawn ``worker_fn(rank, nprocs, *args)`` in ``nprocs`` processes.
 
     Worker functions must be picklable (module-level), as with torch's
     ``mp.spawn``. Raises ``RuntimeError`` carrying the first failing
-    child's traceback (the ``join=True`` contract)."""
+    child's traceback (the ``join=True`` contract) — but fail-FAST: the
+    first abnormal exit terminates the surviving workers after
+    ``grace_s`` instead of leaving them hung in a collective (the orphan
+    scenario the reference handles with a manual kill command,
+    ``README.md:121-125``). Workers carry a per-launch tag in
+    ``DPX_WORKER_TAG`` so :func:`watchdog.kill_orphan_workers` can clean
+    up after a crashed launcher."""
     if nprocs < 1:
         raise ValueError(f"nprocs must be >= 1, got {nprocs}")
     port = master_port if master_port is not None else find_free_port()
+    tag = f"{os.getpid()}-{int(time.time() * 1e6)}"
 
     ctx = mp.get_context("spawn")
     err_q = ctx.Queue()
-    saved = {k: os.environ.get(k) for k in _CHILD_ENV}
+    child_env = {**_CHILD_ENV, WORKER_TAG_ENV: tag}
+    saved = {k: os.environ.get(k) for k in child_env}
     procs = []
+    register_active_tag(tag)
     try:
-        os.environ.update(_CHILD_ENV)
-        for rank in range(nprocs):
-            p = ctx.Process(
-                target=_worker_shim,
-                args=(rank, nprocs, port, worker_fn, args, err_q),
-                daemon=False)
-            p.start()
-            procs.append(p)
+        try:
+            os.environ.update(child_env)
+            for rank in range(nprocs):
+                p = ctx.Process(
+                    target=_worker_shim,
+                    args=(rank, nprocs, port, worker_fn, args, err_q),
+                    daemon=False)
+                p.start()
+                procs.append(p)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+        ProcessSupervisor(procs, err_q, grace_s=grace_s).join()
     finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
-
-    for p in procs:
-        p.join()
-
-    failures = []
-    while not err_q.empty():
-        failures.append(err_q.get())
-    bad = [p.exitcode for p in procs if p.exitcode != 0]
-    if failures:
-        rank, tb = failures[0]
-        raise RuntimeError(
-            f"worker process (rank {rank}) failed:\n{tb}")
-    if bad:
-        raise RuntimeError(f"worker process exited with codes {bad}")
+        unregister_active_tag(tag)

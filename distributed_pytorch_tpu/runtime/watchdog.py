@@ -1,0 +1,277 @@
+"""Failure detection for multi-process runs.
+
+The reference's entire failure story is manual: when a spawned run dies,
+the user greps ``ps`` for orphaned ``multiprocessing.spawn`` workers and
+kills them by hand (reference ``README.md:121-125``); child errors only
+surface through ``join=True``. This module automates all of it:
+
+- :class:`ProcessSupervisor` — fail-fast join: the first child failure
+  terminates the remaining workers after a grace period instead of
+  leaving them deadlocked in a collective waiting for the dead rank.
+- :class:`Heartbeat` / :class:`HeartbeatMonitor` — progress beacons:
+  workers stamp a per-rank file each step; the monitor flags ranks whose
+  beacon goes stale (hung collective, wedged host thread), which process
+  liveness alone cannot see.
+- :func:`kill_orphan_workers` — the automated analog of the README's
+  manual recovery command: every worker is tagged with a launch id in its
+  environment; the killer scans ``/proc`` for leftover tagged processes
+  from *previous* runs and terminates them.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Dict, List, Optional, Sequence
+
+WORKER_TAG_ENV = "DPX_WORKER_TAG"
+
+# Launch tags of multiprocess runs currently in flight in THIS process,
+# registered by launch_multiprocess. kill_orphan_workers spares these by
+# default, so "clean up leftovers" can never shoot down a live run started
+# from the same process. Callers in a different process must pass an
+# explicit ``tag`` (or ``exclude_tag``) instead.
+_ACTIVE_TAGS: set = set()
+
+
+def register_active_tag(tag: str) -> None:
+    _ACTIVE_TAGS.add(tag)
+
+
+def unregister_active_tag(tag: str) -> None:
+    _ACTIVE_TAGS.discard(tag)
+
+
+def active_tags() -> frozenset:
+    """Launch tags of in-flight runs owned by this process."""
+    return frozenset(_ACTIVE_TAGS)
+
+
+# ---------------------------------------------------------------------------
+# fail-fast supervision
+# ---------------------------------------------------------------------------
+
+
+class WorkerFailure(RuntimeError):
+    """A worker process exited abnormally (carries rank + traceback when
+    the worker managed to report one)."""
+
+
+class ProcessSupervisor:
+    """Fail-fast join over a set of worker processes.
+
+    ``join()`` polls liveness; as soon as any worker exits nonzero the
+    survivors get SIGTERM, then SIGKILL after ``grace_s`` — so a crashed
+    rank can never leave its peers hung in a rendezvous/collective (the
+    orphan scenario of reference ``README.md:121-125``).
+    """
+
+    def __init__(self, procs: Sequence, err_q=None, grace_s: float = 5.0,
+                 poll_s: float = 0.05):
+        self.procs = list(procs)
+        self.err_q = err_q
+        self.grace_s = grace_s
+        self.poll_s = poll_s
+
+    def _first_failure(self) -> Optional[int]:
+        for p in self.procs:
+            code = p.exitcode
+            if code is not None and code != 0:
+                return code
+        return None
+
+    def _drain_errors(self) -> List:
+        out = []
+        if self.err_q is not None:
+            while not self.err_q.empty():
+                out.append(self.err_q.get())
+        return out
+
+    def terminate_all(self) -> None:
+        for p in self.procs:
+            if p.is_alive():
+                p.terminate()
+        deadline = time.monotonic() + self.grace_s
+        for p in self.procs:
+            p.join(max(0.0, deadline - time.monotonic()))
+        for p in self.procs:
+            if p.is_alive():
+                p.kill()
+                p.join()
+
+    def join(self) -> None:
+        """Block until all workers finish; raise :class:`WorkerFailure` on
+        the first abnormal exit (after terminating the survivors)."""
+        while any(p.exitcode is None for p in self.procs):
+            if self._first_failure() is not None:
+                break
+            time.sleep(self.poll_s)
+
+        code = self._first_failure()
+        if code is None:
+            return
+        self.terminate_all()
+        failures = self._drain_errors()
+        if failures:
+            rank, tb = failures[0]
+            raise WorkerFailure(f"worker process (rank {rank}) failed:\n{tb}")
+        raise WorkerFailure(
+            f"worker process exited abnormally (exit code {code}); "
+            "remaining workers were terminated")
+
+
+# ---------------------------------------------------------------------------
+# progress heartbeats
+# ---------------------------------------------------------------------------
+
+
+class StalledWorker(RuntimeError):
+    """One or more ranks stopped emitting progress beacons."""
+
+
+class Heartbeat:
+    """Worker-side progress beacon: ``beat(step)`` atomically rewrites
+    ``<dir>/rank<r>.hb`` with ``<timestamp> <step>``. Call it once per
+    training step (cost: one tiny file rename)."""
+
+    def __init__(self, directory: str, rank: int):
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, f"rank{rank}.hb")
+        self._tmp = self.path + ".tmp"
+
+    def beat(self, step: int = 0) -> None:
+        with open(self._tmp, "w") as f:
+            f.write(f"{time.time()} {step}")
+        os.replace(self._tmp, self.path)
+
+
+class HeartbeatMonitor:
+    """Launcher-side staleness check over a heartbeat directory.
+
+    ``stalled(timeout_s)`` returns the ranks whose last beacon is older
+    than ``timeout_s`` (ranks that never beat are only counted once they
+    have had ``timeout_s`` since the monitor started, so slow-starting
+    workers aren't false positives). ``assert_alive`` raises
+    :class:`StalledWorker`."""
+
+    def __init__(self, directory: str, world_size: int):
+        self.directory = directory
+        self.world_size = world_size
+        self.start_time = time.time()
+
+    def last_beats(self) -> Dict[int, float]:
+        out = {}
+        for rank in range(self.world_size):
+            path = os.path.join(self.directory, f"rank{rank}.hb")
+            try:
+                with open(path) as f:
+                    out[rank] = float(f.read().split()[0])
+            except (OSError, ValueError, IndexError):
+                pass
+        return out
+
+    def stalled(self, timeout_s: float) -> List[int]:
+        now = time.time()
+        beats = self.last_beats()
+        out = []
+        for rank in range(self.world_size):
+            last = beats.get(rank, self.start_time)
+            if now - last > timeout_s:
+                out.append(rank)
+        return out
+
+    def assert_alive(self, timeout_s: float) -> None:
+        bad = self.stalled(timeout_s)
+        if bad:
+            raise StalledWorker(
+                f"ranks {bad} have not emitted a heartbeat in {timeout_s}s")
+
+
+# ---------------------------------------------------------------------------
+# orphan cleanup
+# ---------------------------------------------------------------------------
+
+
+def _proc_environ(pid: int) -> Dict[str, str]:
+    try:
+        with open(f"/proc/{pid}/environ", "rb") as f:
+            raw = f.read()
+    except OSError:
+        return {}
+    env = {}
+    for entry in raw.split(b"\0"):
+        if b"=" in entry:
+            k, _, v = entry.partition(b"=")
+            env[k.decode(errors="replace")] = v.decode(errors="replace")
+    return env
+
+
+def find_tagged_workers(tag: Optional[str] = None,
+                        exclude_tag: Optional[str] = None,
+                        exclude_active: bool = True) -> List[int]:
+    """PIDs of live processes carrying ``DPX_WORKER_TAG`` in their
+    environment — optionally only a specific ``tag``, always sparing the
+    tags of runs this process currently has in flight unless
+    ``exclude_active=False``. Returns ``[]`` on platforms without
+    ``/proc``."""
+    excluded = set(_ACTIVE_TAGS) if exclude_active else set()
+    if exclude_tag is not None:
+        excluded.add(exclude_tag)
+    pids = []
+    me = os.getpid()
+    try:
+        entries = os.listdir("/proc")
+    except OSError:
+        return []
+    for entry in entries:
+        if not entry.isdigit() or int(entry) == me:
+            continue
+        env = _proc_environ(int(entry))
+        t = env.get(WORKER_TAG_ENV)
+        if t is None or t in excluded:
+            continue
+        if tag is not None and t != tag:
+            continue
+        pids.append(int(entry))
+    return pids
+
+
+def kill_orphan_workers(tag: Optional[str] = None,
+                        exclude_tag: Optional[str] = None,
+                        exclude_active: bool = True,
+                        grace_s: float = 3.0) -> List[int]:
+    """Terminate leftover tagged worker processes (SIGTERM, then SIGKILL
+    after ``grace_s``). Returns the PIDs acted on. Runs launched by this
+    process that are still in flight are spared by default.
+
+    This is the reference's documented manual recovery (grep ps for
+    orphaned spawn workers and kill them, ``README.md:121-125``) as a
+    one-call API."""
+    pids = find_tagged_workers(tag=tag, exclude_tag=exclude_tag,
+                               exclude_active=exclude_active)
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            pass
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        if not any(_alive(pid) for pid in pids):
+            break
+        time.sleep(0.05)
+    for pid in pids:
+        if _alive(pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+    return pids
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False

@@ -1,5 +1,6 @@
-"""Utilities: primary-only logging, metrics, checkpointing, config."""
-from . import checkpoint, logging
+"""Utilities: primary-only logging, metrics, checkpointing, profiling."""
+from . import checkpoint, logging, profiler
 from .checkpoint import (Checkpoint, CheckpointManager, available_steps,
                          latest_step, restore_checkpoint, save_checkpoint)
 from .logging import MetricsLogger, is_primary, print_primary
+from .profiler import StepTimer, annotate, compiled_stats, trace

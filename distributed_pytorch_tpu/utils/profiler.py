@@ -1,0 +1,172 @@
+"""Tracing / profiling subsystem.
+
+The reference has none (SURVEY.md §5: its only observability is
+per-iteration prints whose ``.cpu().item()`` calls incidentally serialize
+the device pipeline, reference ``min_DDP.py:110-116``). A TPU framework
+needs real instrumentation because the interesting time is inside one
+compiled XLA program where host-side timers see nothing. Three layers:
+
+- **Device traces**: :func:`trace` / :func:`start_trace` wrap
+  ``jax.profiler`` and dump XPlane protos viewable in XProf/TensorBoard —
+  per-op device timelines, HBM traffic, collective time on the ICI.
+- **Step timing**: :class:`StepTimer` measures wall-clock per step with
+  explicit ``block_until_ready`` fencing (without the fence you time the
+  async dispatch, not the step) and reports percentiles + throughput.
+- **Static cost**: :func:`compiled_stats` asks XLA's cost model for
+  FLOPs/bytes of a jitted function, so kernels can be checked against
+  roofline expectations without running them.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import statistics
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+
+# ---------------------------------------------------------------------------
+# device traces (XPlane / XProf)
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a device+host profile into ``logdir``.
+
+    View with TensorBoard's profile plugin or xprof. Works on TPU and on
+    the CPU test mesh (the trace then contains host/XLA-CPU lanes only).
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+start_trace = jax.profiler.start_trace
+stop_trace = jax.profiler.stop_trace
+
+
+def annotate(name: str):
+    """Named region that shows up on the trace timeline.
+
+    Usable as context manager or decorator::
+
+        with profiler.annotate("data-load"):
+            batch = next(it)
+    """
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_memory_stats(device=None) -> Dict[str, Any]:
+    """Per-device allocator stats (bytes in use, peak, limit) where the
+    backend exposes them; empty dict otherwise (XLA-CPU has none)."""
+    dev = device if device is not None else jax.devices()[0]
+    stats = dev.memory_stats()
+    return dict(stats) if stats else {}
+
+
+# ---------------------------------------------------------------------------
+# step timing
+# ---------------------------------------------------------------------------
+
+
+class StepTimer:
+    """Wall-clock step timing with async-dispatch fencing.
+
+    Use either as a context manager per step::
+
+        timer = StepTimer(warmup=2)
+        for batch in loader:
+            with timer.step(fence=out.loss):   # fence forces completion
+                out = train_step(params, opt_state, batch)
+
+    or functionally via :meth:`measure`. The first ``warmup`` steps
+    (compile + cache warming) are recorded separately and excluded from
+    the summary statistics.
+    """
+
+    def __init__(self, warmup: int = 1):
+        self.warmup = warmup
+        self.times: List[float] = []
+        self.warmup_times: List[float] = []
+
+    @contextlib.contextmanager
+    def step(self, fence: Any = None):
+        t0 = time.perf_counter()
+        holder = {}
+        try:
+            yield holder
+        finally:
+            f = holder.get("fence", fence)
+            if f is not None:
+                jax.block_until_ready(f)
+            self._record(time.perf_counter() - t0)
+
+    def measure(self, fn: Callable, *args, n: int = 10, **kwargs):
+        """Time ``n`` calls of ``fn`` (plus warmup), fencing each result.
+        Returns the last result. Each call runs its own warmup block, so a
+        reused timer never counts a fresh function's compile step as a
+        timed sample."""
+        out = None
+        for i in range(self.warmup + n):
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            (self.warmup_times if i < self.warmup else self.times).append(dt)
+        return out
+
+    def _record(self, dt: float) -> None:
+        if len(self.warmup_times) < self.warmup:
+            self.warmup_times.append(dt)
+        else:
+            self.times.append(dt)
+
+    @property
+    def count(self) -> int:
+        return len(self.times)
+
+    def summary(self) -> Dict[str, float]:
+        """mean/median/p10/p90 step seconds and steps/sec over the
+        post-warmup samples."""
+        if not self.times:
+            return {}
+        ts = sorted(self.times)
+        n = len(ts)
+        return {
+            "steps": n,
+            "mean_s": statistics.fmean(ts),
+            "median_s": ts[n // 2],
+            "p10_s": ts[max(0, int(0.10 * n) - 1)] if n >= 10 else ts[0],
+            "p90_s": ts[min(n - 1, int(0.90 * n))],
+            "steps_per_sec": n / sum(ts),
+        }
+
+    def throughput(self, items_per_step: int) -> float:
+        """items/sec (samples, tokens, images) given a fixed per-step count."""
+        s = self.summary()
+        return s["steps_per_sec"] * items_per_step if s else 0.0
+
+
+# ---------------------------------------------------------------------------
+# static cost analysis
+# ---------------------------------------------------------------------------
+
+
+def compiled_stats(fn: Callable, *args,
+                   static_argnums=(), **kwargs) -> Dict[str, float]:
+    """XLA cost-model stats (flops, bytes accessed, ...) for ``fn`` jitted
+    on the given example args — without executing it.
+
+    Keys come from XLA's ``cost_analysis`` (always includes ``flops``
+    when the backend provides a cost model)."""
+    jitted = jax.jit(fn, static_argnums=static_argnums)
+    compiled = jitted.lower(*args, **kwargs).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+        cost = cost[0] if cost else {}
+    return dict(cost) if cost else {}

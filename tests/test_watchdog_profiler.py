@@ -1,0 +1,214 @@
+"""Failure detection (supervisor, heartbeats, orphan cleanup) and the
+profiling subsystem. The reference has neither (SURVEY.md §5): its failure
+handling is a manual kill command in the README and its profiling is
+print statements — these tests pin down the automated replacements."""
+
+import multiprocessing as mp
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu.runtime import (launch_multiprocess, watchdog)
+from distributed_pytorch_tpu.runtime.watchdog import (
+    WORKER_TAG_ENV, Heartbeat, HeartbeatMonitor, ProcessSupervisor,
+    StalledWorker, WorkerFailure, find_tagged_workers, kill_orphan_workers)
+from distributed_pytorch_tpu.utils import profiler
+
+
+# module-level so they pickle under the spawn context
+def _crasher(rank, world):
+    if rank == 1:
+        raise ValueError("rank 1 goes down")
+    time.sleep(30)  # peers hang "in a collective"
+
+
+def _sleeper_tagged(seconds):
+    time.sleep(seconds)
+
+
+def _ok_worker(rank, world):
+    pass
+
+
+class TestSupervisor:
+    def test_fail_fast_terminates_hung_peers(self):
+        """A crashed rank must bring the run down in seconds, not after the
+        30s sleep of its peers (the reference would hang there)."""
+        t0 = time.monotonic()
+        with pytest.raises(WorkerFailure, match="rank 1 goes down"):
+            launch_multiprocess(_crasher, 2)
+        assert time.monotonic() - t0 < 20
+
+    def test_clean_exit_no_error(self):
+        launch_multiprocess(_ok_worker, 2)
+
+    def test_supervisor_reports_exit_code(self):
+        ctx = mp.get_context("spawn")
+        p = ctx.Process(target=os._exit, args=(3,))
+        p.start()
+        with pytest.raises(WorkerFailure, match="exit code 3"):
+            ProcessSupervisor([p]).join()
+
+
+class TestHeartbeat:
+    def test_beat_and_monitor(self, tmp_path):
+        d = str(tmp_path)
+        mon = HeartbeatMonitor(d, world_size=2)
+        hb0 = Heartbeat(d, rank=0)
+        hb1 = Heartbeat(d, rank=1)
+        hb0.beat(step=5)
+        hb1.beat(step=5)
+        assert mon.stalled(timeout_s=10.0) == []
+        mon.assert_alive(10.0)
+
+    def test_stale_rank_detected(self, tmp_path):
+        d = str(tmp_path)
+        mon = HeartbeatMonitor(d, world_size=2)
+        Heartbeat(d, rank=0).beat()
+        time.sleep(0.3)
+        # rank 1 never beat; rank 0's beacon is now older than the window
+        assert mon.stalled(timeout_s=0.2) == [0, 1]
+        with pytest.raises(StalledWorker):
+            mon.assert_alive(0.2)
+
+    def test_slow_starter_not_flagged_early(self, tmp_path):
+        mon = HeartbeatMonitor(str(tmp_path), world_size=1)
+        # no beacon yet, but the timeout window hasn't elapsed since start
+        assert mon.stalled(timeout_s=60.0) == []
+
+
+class TestOrphanCleanup:
+    def test_find_and_kill_tagged(self):
+        tag = f"test-orphan-{os.getpid()}"
+        ctx = mp.get_context("spawn")
+        old = os.environ.get(WORKER_TAG_ENV)
+        os.environ[WORKER_TAG_ENV] = tag
+        try:
+            p = ctx.Process(target=_sleeper_tagged, args=(60,))
+            p.start()
+        finally:
+            if old is None:
+                os.environ.pop(WORKER_TAG_ENV, None)
+            else:
+                os.environ[WORKER_TAG_ENV] = old
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if p.pid in find_tagged_workers(tag=tag):
+                    break
+                time.sleep(0.05)
+            assert p.pid in find_tagged_workers(tag=tag)
+            killed = kill_orphan_workers(tag=tag)
+            assert p.pid in killed
+            p.join(10)
+            assert p.exitcode is not None and p.exitcode != 0
+        finally:
+            if p.is_alive():
+                p.kill()
+                p.join()
+
+    def test_nonexistent_tag_matches_nothing(self):
+        assert find_tagged_workers(tag="no-such-tag-ever") == []
+
+    @staticmethod
+    def _spawn_tagged(tag, seconds=60):
+        ctx = mp.get_context("spawn")
+        old = os.environ.get(WORKER_TAG_ENV)
+        os.environ[WORKER_TAG_ENV] = tag
+        try:
+            p = ctx.Process(target=_sleeper_tagged, args=(seconds,))
+            p.start()
+        finally:
+            if old is None:
+                os.environ.pop(WORKER_TAG_ENV, None)
+            else:
+                os.environ[WORKER_TAG_ENV] = old
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if p.pid in find_tagged_workers(tag=tag):
+                return p
+            time.sleep(0.05)
+        return p
+
+    def test_exclude_tag_spares_that_launch(self):
+        tag = f"test-excl-{os.getpid()}"
+        p = self._spawn_tagged(tag)
+        try:
+            # the excluded tag must survive a blanket kill...
+            killed = kill_orphan_workers(exclude_tag=tag)
+            assert p.pid not in killed and p.is_alive()
+            # ...and a targeted kill takes it down
+            assert p.pid in kill_orphan_workers(tag=tag)
+        finally:
+            if p.is_alive():
+                p.kill()
+            p.join()
+
+    def test_active_launch_spared_by_default(self):
+        tag = f"test-active-{os.getpid()}"
+        p = self._spawn_tagged(tag)
+        watchdog.register_active_tag(tag)
+        try:
+            assert p.pid not in kill_orphan_workers()
+            assert p.is_alive()
+            watchdog.unregister_active_tag(tag)
+            assert p.pid in kill_orphan_workers(tag=tag)
+        finally:
+            watchdog.unregister_active_tag(tag)
+            if p.is_alive():
+                p.kill()
+            p.join()
+
+
+class TestProfiler:
+    def test_step_timer_summary(self):
+        timer = profiler.StepTimer(warmup=1)
+        x = jnp.ones((64, 64))
+        f = jax.jit(lambda x: x @ x)
+        timer.measure(f, x, n=5)
+        s = timer.summary()
+        assert s["steps"] == 5
+        assert s["mean_s"] > 0 and s["steps_per_sec"] > 0
+        assert timer.warmup_times and len(timer.times) == 5
+        assert timer.throughput(items_per_step=64) == \
+            pytest.approx(64 * s["steps_per_sec"])
+
+    def test_measure_reuse_separates_warmup(self):
+        """A reused timer must not count the second call's warmup
+        (compile) iterations as timed samples."""
+        timer = profiler.StepTimer(warmup=1)
+        x = jnp.ones((16, 16))
+        timer.measure(jax.jit(lambda x: x + 1), x, n=3)
+        timer.measure(jax.jit(lambda x: x * 3), x, n=3)  # fresh compile
+        assert len(timer.times) == 6
+        assert len(timer.warmup_times) == 2
+
+    def test_step_context_manager_fences(self):
+        timer = profiler.StepTimer(warmup=0)
+        f = jax.jit(lambda x: x * 2)
+        with timer.step() as h:
+            h["fence"] = f(jnp.ones((8, 8)))
+        assert timer.count == 1
+
+    def test_compiled_stats_flops(self):
+        n = 128
+        stats = profiler.compiled_stats(
+            lambda a, b: a @ b, jnp.ones((n, n)), jnp.ones((n, n)))
+        # XLA's cost model: 2*n^3 flops for a dense matmul
+        assert stats.get("flops", 0) == pytest.approx(2 * n ** 3, rel=0.1)
+
+    def test_trace_writes_profile(self, tmp_path):
+        d = str(tmp_path / "prof")
+        with profiler.trace(d):
+            jax.block_until_ready(jax.jit(lambda x: x + 1)(jnp.ones(8)))
+        found = [f for _, _, fs in os.walk(d) for f in fs]
+        assert any(f.endswith(".xplane.pb") for f in found)
+
+    def test_annotate_runs(self):
+        with profiler.annotate("region"):
+            pass
