@@ -1,10 +1,12 @@
 """Parallelism engines: data (DDP), tensor, sequence (ring attention),
 pipeline (GPipe over pp), expert (Switch MoE over ep), and the composed
 GSPMD mesh trainer."""
-from . import data_parallel, moe, pipeline, sequence, spmd, tensor
+from . import data_parallel, fsdp, moe, pipeline, sequence, spmd, tensor
 from .data_parallel import (DataParallel, make_scan_train_steps,
                             make_stateful_train_step, make_train_step,
                             prepare_ddp_model, stack_state)
+from .fsdp import (fsdp_param_specs, make_fsdp_train_step,
+                   shard_model_and_opt)
 from .moe import MoELayer, moe_param_specs
 from .pipeline import (make_gspmd_pipeline_fn, pipeline_apply,
                        stack_layer_params)

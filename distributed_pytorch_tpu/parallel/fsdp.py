@@ -1,0 +1,117 @@
+"""FSDP / ZeRO-style fully-sharded data parallelism — as sharding layout.
+
+Beyond the reference's capability set (SURVEY.md §2.4: plain per-rank
+AdamW, params replicated — reference ``min_DDP.py:74``); included because
+"data parallelism at scale" on TPU means sharding the model state, not
+just the batch.
+
+On TPU this is not a wrapper class with hooks (the CUDA FSDP shape): it
+is a *layout*. Every parameter, its gradient, and its optimizer moments
+are sharded along the ``dp`` mesh axis on the largest divisible dimension;
+XLA's SPMD partitioner then materializes exactly the ZeRO-3 schedule from
+the sharding constraints:
+
+- forward/backward: all-gather each param right before use, discard after
+  (param memory: 1/N per device);
+- gradients: reduce-scatter instead of all-reduce (grad memory: 1/N);
+- optimizer update: runs on the local 1/N shard (moment memory: 1/N) —
+  no separate "optimizer state partitioning" machinery, it falls out of
+  the layout.
+
+Composes with the tp/sp axes of the same mesh: pass a ``base_specs`` tree
+(e.g. :func:`tensor.transformer_lm_param_specs`) and FSDP sharding is
+added on dims the tp layout leaves free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..optim import AdamWState, Optimizer
+from .spmd import SpmdStepOutput
+from .tensor import shard_params
+
+
+def fsdp_param_specs(params, n_shards: int, *, axis: str = "dp",
+                     min_size: int = 1024, base_specs: Optional[Any] = None):
+    """A PartitionSpec tree sharding each leaf along ``axis``.
+
+    Per leaf, the largest dimension divisible by ``n_shards`` (and not
+    already taken by ``base_specs``) is sharded; leaves smaller than
+    ``min_size`` elements stay as their base spec (gathering tiny tensors
+    costs more latency than their memory is worth — the usual FSDP
+    min-size heuristic)."""
+
+    def pick(x, base):
+        base_parts = tuple(base) if base is not None else ()
+        shape = getattr(x, "shape", ())
+        if not shape or x.size < min_size:
+            return base if base is not None else P()
+        parts = list(base_parts) + [None] * (len(shape) - len(base_parts))
+        order = sorted(range(len(shape)), key=lambda i: shape[i],
+                       reverse=True)
+        for i in order:
+            if parts[i] is None and shape[i] % n_shards == 0:
+                parts[i] = axis
+                return P(*parts)
+        return base if base is not None else P()
+
+    if base_specs is None:
+        return jax.tree_util.tree_map(lambda x: pick(x, None), params)
+    return jax.tree_util.tree_map(
+        lambda x, s: pick(x, s), params, base_specs,
+        is_leaf=lambda x: x is None)
+
+
+def opt_state_specs(opt_state, param_specs):
+    """Spec tree for an optimizer state: param-shaped subtrees (moments,
+    velocities) inherit the param specs — this is what shards the
+    optimizer (ZeRO-1) — scalars (step counters) replicate."""
+    if isinstance(opt_state, AdamWState):
+        return AdamWState(step=P(), mu=param_specs, nu=param_specs)
+    p_struct = jax.tree_util.tree_structure(param_specs)
+    if jax.tree_util.tree_structure(opt_state) == p_struct:
+        return param_specs  # e.g. sgd momentum: one param-shaped tree
+    return jax.tree_util.tree_map(lambda _: P(), opt_state)
+
+
+def shard_model_and_opt(params, opt_state, mesh: Mesh, param_specs):
+    """Place params + optimizer state on the mesh per the FSDP layout."""
+    o_specs = opt_state_specs(opt_state, param_specs)
+    return (shard_params(params, param_specs, mesh),
+            shard_params(opt_state, o_specs, mesh))
+
+
+def make_fsdp_train_step(loss_fn: Callable, optimizer: Optimizer,
+                         mesh: Mesh, param_specs,
+                         donate: bool = True) -> Callable:
+    """Compile ``step(params, opt_state, batch) -> SpmdStepOutput`` with
+    the ZeRO-3 layout pinned by sharding constraints.
+
+    ``loss_fn(params, batch) -> (loss, metrics)`` is ordinary global-view
+    model code, identical to what :func:`spmd.make_spmd_train_step` takes.
+    The constraints force gradients and updated state back to the sharded
+    layout, so XLA emits reduce-scatter for grads and keeps the AdamW
+    update local to each shard."""
+
+    def constrain(tree, specs):
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, s)),
+            tree, specs, is_leaf=lambda x: x is None)
+
+    def step(params, opt_state, batch):
+        o_specs = opt_state_specs(opt_state, param_specs)
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch)
+        grads = constrain(grads, param_specs)        # reduce-scatter point
+        params, opt_state = optimizer.update(grads, opt_state, params)
+        params = constrain(params, param_specs)
+        opt_state = constrain(opt_state, o_specs)
+        return SpmdStepOutput(params, opt_state, loss, metrics)
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())

@@ -1,0 +1,161 @@
+"""Multi-host (pod-scale) runtime: DCN × ICI meshes and host topology.
+
+The reference cannot do multi-node at all — its rendezvous is hardcoded
+``MASTER_ADDR=localhost`` and world size is the local device count
+(reference ``distributed.py:41,48``; ``README.md:4`` "single-node"). The
+TPU build generalizes it (SURVEY.md §2.4): on a pod, topology comes from
+the TPU runtime itself, so "rendezvous" is :func:`initialize` (a thin,
+idempotent wrapper over ``jax.distributed.initialize``) and the mesh is
+laid out so that fast-collective axes ride the ICI within a slice while
+only the outermost data axis crosses the DCN between slices.
+
+Single-host degradation is total: every function here works unchanged in
+a one-process run (``num_hosts() == 1``, hybrid meshes collapse to ICI
+meshes), preserving the reference's 0/1/N graceful-degradation contract.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from . import context
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-host runtime (idempotent; no-op single-host).
+
+    On Cloud TPU pods all three arguments are discovered from the
+    metadata/environment and may be omitted. Off-pod (e.g. CPU fleets)
+    pass them explicitly — the analog of the reference's
+    MASTER_ADDR/MASTER_PORT env rendezvous (``distributed.py:48-49``),
+    except the coordinator serves topology, not a TCP store.
+    """
+    global _initialized
+    if _initialized:
+        return
+    explicit = any(a is not None
+                   for a in (coordinator_address, num_processes, process_id))
+    auto_pod = os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
+        "MEGASCALE_COORDINATOR_ADDRESS")
+    if explicit or auto_pod:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        except RuntimeError as e:
+            # Explicit callers must know about any failure. On
+            # auto-detected pods, only the benign "a backend is already
+            # live / service already up" races degrade to single-host;
+            # real failures (unreachable coordinator) would otherwise
+            # silently split a pod job into N independent single-host
+            # jobs all believing they are primary.
+            if explicit or not _is_benign_init_error(e):
+                raise
+    _initialized = True
+
+
+def _is_benign_init_error(e: Exception) -> bool:
+    msg = str(e).lower()
+    return ("must be called before" in msg
+            or "already initialized" in msg)
+
+
+def num_hosts() -> int:
+    """Number of controller processes in the job (1 single-host)."""
+    return jax.process_count()
+
+
+def host_index() -> int:
+    """This controller's process index (0 on a single host)."""
+    return jax.process_index()
+
+
+def is_primary_host() -> bool:
+    """True on process 0 — the multi-host extension of the reference's
+    rank-0 ``is_primary`` contract (``distributed.py:94-95``)."""
+    return jax.process_index() == 0
+
+
+def local_device_slice() -> Tuple[int, int]:
+    """(start, stop) indices of this host's devices in the global order."""
+    per_host = len(jax.local_devices())
+    start = jax.process_index() * per_host
+    return start, start + per_host
+
+
+def init_hybrid_mesh(ici: Sequence[Tuple[str, int]],
+                     dcn: Sequence[Tuple[str, int]] = (),
+                     devices=None) -> Mesh:
+    """Build a mesh whose ``ici`` axes stay within a host/slice (fast
+    interconnect) and whose ``dcn`` axes span hosts (datacenter network).
+
+    ``ici`` / ``dcn`` are ``(axis_name, size)`` pairs, e.g.::
+
+        # 4 hosts x 8 chips: data-parallel over DCN, tensor+data over ICI
+        mesh = init_hybrid_mesh(ici=[("dp", 2), ("tp", 4)],
+                                dcn=[("dp_outer", 4)])
+
+    The DCN axes are laid out OUTERMOST: a collective over an ici axis
+    touches only devices on one ICI domain (slice), so the
+    bandwidth-hungry collectives (tp all-reduce, sp permutes) never cross
+    the DCN — the scaling-book layout rule. On a single slice (including
+    any single-slice multi-host pod, where ICI spans all hosts), ``dcn``
+    axes must have size 1 or be omitted; the mesh degrades to a plain ICI
+    mesh.
+    """
+    devs = list(devices) if devices is not None else context.visible_devices()
+    if not devs:
+        devs = list(jax.devices())
+    dcn_size = int(np.prod([s for _, s in dcn])) if dcn else 1
+    ici_size = int(np.prod([s for _, s in ici])) if ici else 1
+    if dcn_size * ici_size != len(devs):
+        raise ValueError(
+            f"mesh {dcn_size}x{ici_size} != {len(devs)} devices")
+    # ICI reaches every chip in a slice (not just one host's), so the DCN
+    # dimension is the number of *slices*, not jax.process_count().
+    n_slices = len({getattr(d, "slice_index", 0) for d in devs})
+    if n_slices > 1 and dcn_size != n_slices:
+        raise ValueError(
+            f"dcn axes multiply to {dcn_size} but the devices span "
+            f"{n_slices} slices — the DCN dimension must equal the slice "
+            "count so ici axes stay within one ICI domain")
+
+    # Device order is process- then slice-grouped, so reshaping with the
+    # dcn axes first keeps each ici block on one slice's devices.
+    arr = context._as_device_array(devs)
+    shape = tuple(s for _, s in dcn) + tuple(s for _, s in ici)
+    names = tuple(n for n, _ in dcn) + tuple(n for n, _ in ici)
+    return Mesh(arr.reshape(shape), names)
+
+
+def process_allgather(x):
+    """Gather a small host-local numpy value from every process (returns
+    stacked axis 0 = process index). Single-host: adds the leading axis.
+
+    For control-plane data (metrics, health beacons) — NOT the data path
+    (that is the compiled collectives')."""
+    x = np.asarray(x)
+    if num_hosts() == 1:
+        return x[None]
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(x)
+
+
+def broadcast_from_primary(x):
+    """Broadcast a small host-local numpy value from process 0 to all
+    processes — the multi-host analog of ``sync_params``' broadcast-from-
+    rank-0 contract (reference ``distributed.py:163-170``) for host data."""
+    x = np.asarray(x)
+    if num_hosts() == 1:
+        return x
+    from jax.experimental import multihost_utils
+    return multihost_utils.broadcast_one_to_all(x)

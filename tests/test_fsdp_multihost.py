@@ -1,0 +1,163 @@
+"""FSDP (ZeRO-3 layout) numerics + sharding, and multi-host helpers on the
+8-device virtual mesh. FSDP must be a pure layout change: identical loss
+trajectory to replicated DP, with params/grads/moments actually sharded."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_pytorch_tpu import models, optim
+from distributed_pytorch_tpu.ops.losses import cross_entropy_per_example
+from distributed_pytorch_tpu.parallel import (fsdp_param_specs,
+                                              make_fsdp_train_step,
+                                              make_spmd_train_step,
+                                              shard_batch_spec,
+                                              shard_model_and_opt)
+from distributed_pytorch_tpu.parallel.fsdp import opt_state_specs
+from distributed_pytorch_tpu.parallel.tensor import shard_params
+from distributed_pytorch_tpu.runtime import context, multihost
+
+
+def _mesh8():
+    return context.init_mesh(dp=8)
+
+
+def _lm():
+    # dims chosen divisible by 8 so every big leaf shards
+    return models.TransformerLM(vocab=64, dim=32, n_layers=2, n_heads=4,
+                                max_seq=16)
+
+
+def _loss_fn(model):
+    def loss_fn(p, batch):
+        x, y = batch
+        return cross_entropy_per_example(model.apply(p, x), y).mean(), {}
+    return loss_fn
+
+
+class TestFsdpSpecs:
+    def test_largest_divisible_dim_sharded(self):
+        params = {"w": jnp.zeros((48, 64)), "b": jnp.zeros((7,)),
+                  "tiny": jnp.zeros((8, 8))}
+        specs = fsdp_param_specs(params, 8, min_size=128)
+        assert specs["w"] == P(None, "dp")      # 64 is the largest dim % 8
+        assert specs["b"] == P()                # 7 not divisible
+        assert specs["tiny"] == P()             # below min_size
+
+
+    def test_base_specs_respected(self):
+        params = {"w": jnp.zeros((64, 128))}
+        base = {"w": P(None, "tp")}             # tp already owns dim 1
+        specs = fsdp_param_specs(params, 8, min_size=1, base_specs=base)
+        assert specs["w"] == P("dp", "tp")      # fsdp takes the free dim
+
+    def test_opt_state_specs_adamw(self):
+        params = {"w": jnp.zeros((64, 64))}
+        p_specs = fsdp_param_specs(params, 8, min_size=1)
+        state = optim.adamw(1e-3).init(params)
+        o = opt_state_specs(state, p_specs)
+        assert o.step == P()
+        assert o.mu["w"] == p_specs["w"] and o.nu["w"] == p_specs["w"]
+
+
+class TestFsdpNumerics:
+    def test_matches_replicated_dp(self):
+        """ZeRO-3 is a layout, not math: the loss trajectory must equal
+        replicated data parallelism step for step."""
+        mesh = _mesh8()
+        model = _lm()
+        loss_fn = _loss_fn(model)
+        opt = optim.adamw(1e-3)
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, 64, (16, 16)).astype(np.int32)
+        batch = shard_batch_spec((toks, toks), mesh, P("dp", None))
+
+        # replicated baseline
+        p_rep = shard_params(model.init(jax.random.PRNGKey(0)),
+                             jax.tree_util.tree_map(lambda _: P(),
+                                                    model.init(jax.random.PRNGKey(0))),
+                             mesh)
+        o_rep = opt.init(p_rep)
+        step_rep = make_spmd_train_step(loss_fn, opt, donate=False)
+
+        # fsdp
+        params = model.init(jax.random.PRNGKey(0))
+        specs = fsdp_param_specs(params, 8, min_size=1)
+        opt_state = opt.init(params)
+        params, opt_state = shard_model_and_opt(params, opt_state, mesh,
+                                                specs)
+        step_fsdp = make_fsdp_train_step(loss_fn, opt, mesh, specs,
+                                         donate=False)
+
+        for _ in range(3):
+            out_r = step_rep(p_rep, o_rep, batch)
+            out_f = step_fsdp(params, opt_state, batch)
+            p_rep, o_rep = out_r.params, out_r.opt_state
+            params, opt_state = out_f.params, out_f.opt_state
+            np.testing.assert_allclose(float(out_f.loss), float(out_r.loss),
+                                       rtol=1e-5)
+
+    def test_state_actually_sharded(self):
+        mesh = _mesh8()
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        specs = fsdp_param_specs(params, 8, min_size=1)
+        opt = optim.adamw(1e-3)
+        params, opt_state = shard_model_and_opt(params, opt.init(params),
+                                                mesh, specs)
+        w = params["blocks"][0]["fc1"]["w"]
+        assert "dp" in jax.tree_util.tree_leaves(
+            [w.sharding.spec])[0] or "dp" in tuple(w.sharding.spec)
+        # local shard is 1/8 of the global array
+        shard = w.addressable_shards[0].data
+        assert shard.size == w.size // 8
+        mu = opt_state.mu["blocks"][0]["fc1"]["w"]
+        assert mu.addressable_shards[0].data.size == mu.size // 8
+
+        # updated state keeps the sharded layout (no silent re-replication)
+        loss_fn = _loss_fn(model)
+        rng = np.random.default_rng(1)
+        toks = rng.integers(0, 64, (16, 16)).astype(np.int32)
+        batch = shard_batch_spec((toks, toks), mesh, P("dp", None))
+        out = make_fsdp_train_step(loss_fn, opt, mesh, specs,
+                                   donate=False)(params, opt_state, batch)
+        w2 = out.params["blocks"][0]["fc1"]["w"]
+        assert w2.addressable_shards[0].data.size == w2.size // 8
+
+
+class TestMultihost:
+    def test_single_host_degradation(self):
+        multihost.initialize()  # no-op off-pod
+        assert multihost.num_hosts() == 1
+        assert multihost.host_index() == 0
+        assert multihost.is_primary_host()
+        start, stop = multihost.local_device_slice()
+        assert (start, stop) == (0, len(jax.local_devices()))
+
+    def test_hybrid_mesh_single_host(self):
+        mesh = multihost.init_hybrid_mesh(ici=[("dp", 4), ("tp", 2)])
+        assert mesh.shape == {"dp": 4, "tp": 2}
+        mesh2 = multihost.init_hybrid_mesh(ici=[("dp", 8)],
+                                           dcn=[("dp_outer", 1)])
+        assert mesh2.shape == {"dp_outer": 1, "dp": 8}
+
+    def test_hybrid_mesh_size_mismatch_raises(self):
+        with pytest.raises(ValueError, match="devices"):
+            multihost.init_hybrid_mesh(ici=[("dp", 4)])
+
+    def test_hybrid_mesh_usable_for_compute(self):
+        mesh = multihost.init_hybrid_mesh(ici=[("dp", 8)])
+        x = jnp.arange(16.0)
+        y = jax.jit(
+            lambda x: x * 2,
+            in_shardings=jax.NamedSharding(mesh, P("dp")),
+            out_shardings=jax.NamedSharding(mesh, P("dp")))(x)
+        np.testing.assert_allclose(np.asarray(y), np.arange(16.0) * 2)
+
+    def test_control_plane_helpers(self):
+        g = multihost.process_allgather(np.array([1.5, 2.5]))
+        assert g.shape == (1, 2)
+        b = multihost.broadcast_from_primary(np.array([3]))
+        np.testing.assert_array_equal(b, [3])
