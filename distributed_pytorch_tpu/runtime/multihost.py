@@ -44,29 +44,39 @@ def initialize(coordinator_address: Optional[str] = None,
         return
     explicit = any(a is not None
                    for a in (coordinator_address, num_processes, process_id))
-    auto_pod = os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
-        "MEGASCALE_COORDINATOR_ADDRESS")
-    if explicit or auto_pod:
-        try:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes, process_id=process_id)
-        except RuntimeError as e:
-            # Explicit callers must know about any failure. On
-            # auto-detected pods, only the benign "a backend is already
-            # live / service already up" races degrade to single-host;
-            # real failures (unreachable coordinator) would otherwise
-            # silently split a pod job into N independent single-host
-            # jobs all believing they are primary.
-            if explicit or not _is_benign_init_error(e):
-                raise
+    if not explicit and _pod_worker_count() <= 1:
+        # Nothing to join and nothing attempted: do NOT latch, so a later
+        # explicit initialize(...) still works.
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    except RuntimeError as e:
+        # For auto-detected pods, only the duplicate-join race is safe to
+        # swallow. Anything else — and ANY failure of an explicit call
+        # (the caller asked for a specific coordinator and didn't get
+        # it) — must propagate: swallowing it would silently split one
+        # pod job into N independent "primary" single-host jobs that
+        # trample shared outputs.
+        if explicit or "already initialized" not in str(e).lower():
+            raise
     _initialized = True
 
 
-def _is_benign_init_error(e: Exception) -> bool:
-    msg = str(e).lower()
-    return ("must be called before" in msg
-            or "already initialized" in msg)
+def _pod_worker_count() -> int:
+    """Worker count advertised by the pod environment (1 off-pod).
+
+    Both signals are consulted: a multislice fleet of single-host slices
+    has a one-entry TPU_WORKER_HOSTNAMES *and* a megascale coordinator —
+    the fleet still needs the join."""
+    n = 1
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if hosts:
+        n = max(n, len([h for h in hosts.split(",") if h.strip()]))
+    if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        n = max(n, 2)
+    return n
 
 
 def num_hosts() -> int:
@@ -114,7 +124,13 @@ def init_hybrid_mesh(ici: Sequence[Tuple[str, int]],
     """
     devs = list(devices) if devices is not None else context.visible_devices()
     if not devs:
-        devs = list(jax.devices())
+        # Same opt-in contract as context.init_mesh: CPU devices count
+        # only when DPX_CPU_DEVICES opts them in; silently meshing over
+        # jax.devices() would disagree with device_count()/world-size
+        # checks everywhere else.
+        raise ValueError(
+            "no visible accelerator devices (on a CPU host set "
+            f"{context.CPU_DEVICES_ENV} to opt virtual devices in)")
     dcn_size = int(np.prod([s for _, s in dcn])) if dcn else 1
     ici_size = int(np.prod([s for _, s in ici])) if ici else 1
     if dcn_size * ici_size != len(devs):

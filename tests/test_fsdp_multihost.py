@@ -75,10 +75,9 @@ class TestFsdpNumerics:
         batch = shard_batch_spec((toks, toks), mesh, P("dp", None))
 
         # replicated baseline
-        p_rep = shard_params(model.init(jax.random.PRNGKey(0)),
-                             jax.tree_util.tree_map(lambda _: P(),
-                                                    model.init(jax.random.PRNGKey(0))),
-                             mesh)
+        from distributed_pytorch_tpu.parallel import replicated_specs
+        p0 = model.init(jax.random.PRNGKey(0))
+        p_rep = shard_params(p0, replicated_specs(p0), mesh)
         o_rep = opt.init(p_rep)
         step_rep = make_spmd_train_step(loss_fn, opt, donate=False)
 
