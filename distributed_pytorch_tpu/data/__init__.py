@@ -1,5 +1,7 @@
-"""Data: sharded sampling, mesh-aware loading, ladder datasets."""
-from . import datasets, loader, sampler
+"""Data: sharded sampling, mesh-aware loading, device prefetching,
+ladder datasets."""
+from . import datasets, loader, prefetch, sampler
 from .datasets import DummyDataset, SyntheticImages, SyntheticLM
 from .loader import DataLoader
+from .prefetch import PrefetchLoader, device_prefetch
 from .sampler import ShardedSampler, data_sampler

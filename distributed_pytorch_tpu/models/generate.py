@@ -1,0 +1,208 @@
+"""Autoregressive text generation with a KV cache — the inference path.
+
+The reference has no inference story at all (its workload is a training
+loop over an MLP, reference ``min_DDP.py``); a complete LM framework needs
+one, and on TPU it must be a *compiled* loop: the whole
+prefill-then-decode pipeline here is two XLA programs (one prefill, one
+``lax.scan`` over decode steps), with the KV cache as a fixed-shape
+carry — no per-token host round trips, no dynamic shapes.
+
+Design notes (TPU-first):
+- The cache is preallocated at ``max_len`` per layer ((B, H, max, Dh) for
+  K and V); each step writes one slot with ``dynamic_update_slice`` and
+  attends over the full buffer under a position mask. Static shapes keep
+  XLA happy; the masked tail costs FLOPs but no recompilation.
+- Decode attention is a (B, H, 1, max) x (B, H, max, Dh) matmul pair —
+  bandwidth-bound as always for single-token decoding; the cache layout
+  keeps the contraction on the MXU's fast axis.
+- Sampling (greedy / temperature / top-k) happens on-device inside the
+  scan; the host sees only the final (B, steps) token block.
+
+Works on the same ``TransformerLM`` params used for training (reads the
+block submodules directly; no weight conversion).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.attention import dense_attention
+from .transformer import TransformerLM
+
+Params = Dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    k: Any        # list-like pytree of (B, H, max_len, Dh) per layer
+    v: Any
+    length: jnp.ndarray   # () int32 — number of valid positions
+
+
+# qkv projection / output projection / MLP all go through the block's own
+# methods (nn/attention.py), so the fused-qkv layout and MLP math have one
+# source of truth shared with training.
+
+
+def init_cache(model: TransformerLM, batch: int, max_len: int,
+               dtype=None) -> KVCache:
+    dtype = dtype or model.dtype
+    dh = model.dim // model.n_heads
+    shape = (batch, model.n_heads, max_len, dh)
+    zeros = lambda: [jnp.zeros(shape, dtype) for _ in range(model.n_layers)]
+    return KVCache(k=zeros(), v=zeros(), length=jnp.zeros((), jnp.int32))
+
+
+def prefill(model: TransformerLM, params: Params, tokens,
+            max_len: int) -> Tuple[jnp.ndarray, KVCache]:
+    """Run the prompt through the model once, filling the cache.
+
+    tokens: (B, S) int32. Returns (last-position logits (B, vocab),
+    cache with ``length = S``)."""
+    b, s = tokens.shape
+    if s > max_len:
+        raise ValueError(f"prompt length {s} exceeds max_len {max_len}")
+    cache = init_cache(model, b, max_len)
+    x = model.tok.apply(params["tok"], tokens)
+    x = x + model.pos.apply(params["pos"], jnp.arange(s))
+    ks, vs = [], []
+    for i, blk in enumerate(model.blocks):
+        p = params["blocks"][i]
+        hq, hk, hv = blk.attn.project_qkv(p["attn"],
+                                          blk.ln1.apply(p["ln1"], x))
+        o = blk.attn.attn_fn(hq, hk, hv, causal=True)
+        x = x + blk.attn.project_out(p["attn"], o)
+        x = x + blk.mlp(p, x)
+        ks.append(jax.lax.dynamic_update_slice(
+            cache.k[i], hk.astype(cache.k[i].dtype), (0, 0, 0, 0)))
+        vs.append(jax.lax.dynamic_update_slice(
+            cache.v[i], hv.astype(cache.v[i].dtype), (0, 0, 0, 0)))
+    x = model.ln_f.apply(params["ln_f"], x[:, -1:])
+    logits = model.head.apply(params["head"], x)[:, 0]
+    return logits, KVCache(k=ks, v=vs,
+                           length=jnp.asarray(s, jnp.int32))
+
+
+def decode_step(model: TransformerLM, params: Params, cache: KVCache,
+                token) -> Tuple[jnp.ndarray, KVCache]:
+    """One cached decode step. token: (B,) int32 at position
+    ``cache.length``. Returns (logits (B, vocab), advanced cache)."""
+    idx = cache.length
+    x = model.tok.apply(params["tok"], token[:, None])         # (B,1,D)
+    x = x + model.pos.apply(params["pos"], idx[None])
+    scale = 1.0 / math.sqrt(model.dim // model.n_heads)
+    max_len = cache.k[0].shape[2]
+    pos_mask = (jnp.arange(max_len) <= idx)                    # (max,)
+
+    new_k, new_v = [], []
+    for i, blk in enumerate(model.blocks):
+        p = params["blocks"][i]
+        hq, hk, hv = blk.attn.project_qkv(p["attn"],
+                                          blk.ln1.apply(p["ln1"], x))
+        k = jax.lax.dynamic_update_slice(
+            cache.k[i], hk.astype(cache.k[i].dtype), (0, 0, idx, 0))
+        v = jax.lax.dynamic_update_slice(
+            cache.v[i], hv.astype(cache.v[i].dtype), (0, 0, idx, 0))
+        new_k.append(k)
+        new_v.append(v)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", hq, k).astype(
+            jnp.float32) * scale                               # (B,H,1,max)
+        logits = jnp.where(pos_mask[None, None, None, :], logits,
+                           -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        x = x + blk.attn.project_out(p["attn"], o)
+        x = x + blk.mlp(p, x)
+
+    x = model.ln_f.apply(params["ln_f"], x)
+    logits = model.head.apply(params["head"], x)[:, 0]
+    return logits, KVCache(k=new_k, v=new_v, length=idx + 1)
+
+
+def _sample(logits, rng, temperature: float, top_k: Optional[int]):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        vals, _ = jax.lax.top_k(logits, top_k)
+        cutoff = vals[..., -1:]
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(model: TransformerLM, params: Params, prompt, max_new: int,
+             *, temperature: float = 0.0, top_k: Optional[int] = None,
+             rng=None, max_len: Optional[int] = None) -> jnp.ndarray:
+    """Generate ``max_new`` tokens after ``prompt`` ((B, S) int32).
+
+    temperature=0 is greedy; otherwise softmax sampling with optional
+    top-k. Returns (B, max_new) int32. The decode loop is one
+    ``lax.scan`` — jit :func:`make_generate_fn`'s product to cache the
+    whole pipeline as two XLA programs."""
+    return make_generate_fn(model, max_new, temperature=temperature,
+                            top_k=top_k, max_len=max_len)(
+        params, prompt, rng if rng is not None else jax.random.PRNGKey(0))
+
+
+def _check_attn_compatible(model: TransformerLM,
+                           allow_custom_attn: bool) -> None:
+    """Decode attends over the cache with an inline softmax(qk)v — exact
+    for the dense core and dense-equivalent kernels (flash attention
+    marks itself ``dense_equivalent``), wrong for behavior-changing
+    custom cores (sliding-window, biased). Refuse those unless the
+    caller explicitly opts in."""
+    if allow_custom_attn:
+        return
+    for blk in model.blocks:
+        f = blk.attn.attn_fn
+        if f is dense_attention or getattr(f, "dense_equivalent", False):
+            continue
+        raise ValueError(
+            "model was built with a custom attn_fn whose semantics the "
+            "cached decode path cannot reproduce; pass "
+            "allow_custom_attn=True only if the core computes standard "
+            "softmax(q k^T * scale) v")
+
+
+def make_generate_fn(model: TransformerLM, max_new: int, *,
+                     temperature: float = 0.0, top_k: Optional[int] = None,
+                     max_len: Optional[int] = None,
+                     allow_custom_attn: bool = False):
+    """Build ``fn(params, prompt, rng) -> (B, max_new) tokens`` suitable
+    for ``jax.jit`` (all shape-determining arguments are closed over)."""
+    _check_attn_compatible(model, allow_custom_attn)
+
+    def fn(params, prompt, rng):
+        s = prompt.shape[1]
+        limit = max_len or (s + max_new)
+        if limit > model.max_seq:
+            raise ValueError(
+                f"cache length {limit} (prompt {s} + max_new {max_new} "
+                f"or explicit max_len) exceeds the model's max_seq "
+                f"({model.max_seq})")
+        if s + max_new > limit:
+            raise ValueError(
+                f"max_len {limit} cannot hold prompt ({s}) + max_new "
+                f"({max_new}) tokens — the cache would wrap and corrupt")
+        rng_first, *step_rngs = jax.random.split(rng, max_new)
+        logits, cache = prefill(model, params, prompt, limit)
+        first = _sample(logits, rng_first, temperature, top_k)
+
+        def body(carry, step_rng):
+            cache, token = carry
+            logits, cache = decode_step(model, params, cache, token)
+            nxt = _sample(logits, step_rng, temperature, top_k)
+            return (cache, nxt), nxt
+
+        if max_new == 1:
+            return first[:, None]
+        (_, _), rest = jax.lax.scan(body, (cache, first),
+                                    jnp.stack(step_rngs))
+        return jnp.concatenate([first[:, None], jnp.moveaxis(rest, 0, 1)],
+                               axis=1)                        # (B, max_new)
+
+    return fn

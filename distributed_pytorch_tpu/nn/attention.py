@@ -62,14 +62,27 @@ class MultiHeadAttention(Module):
         k1, k2 = jax.random.split(key)
         return {"qkv": self.qkv.init(k1), "out": self.out.init(k2)}
 
-    def apply(self, params: Params, x, **kwargs):
-        b, s, d = x.shape
+    def project_qkv(self, params: Params, x):
+        """x (B, S, D) → q, k, v each (B, H, S, Dh), via the fused qkv
+        matmul. The single source of truth for the qkv memory layout —
+        the cached decode path (models/generate.py) builds its KV cache
+        through this method."""
+        b, s, _ = x.shape
         qkv = self.qkv.apply(params["qkv"], x)           # (B, S, 3D) one matmul
         qkv = qkv.reshape(b, s, 3, self.n_heads, self.head_dim)
         q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        return q, k, v
+
+    def project_out(self, params: Params, o):
+        """o (B, H, S, Dh) → output projection (B, S, D)."""
+        b, h, s, dh = o.shape
+        return self.out.apply(params["out"],
+                              o.transpose(0, 2, 1, 3).reshape(b, s, h * dh))
+
+    def apply(self, params: Params, x, **kwargs):
+        q, k, v = self.project_qkv(params, x)
         o = self.attn_fn(q, k, v, causal=self.causal)
-        o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
-        return self.out.apply(params["out"], o)
+        return self.project_out(params, o)
 
 
 class TransformerBlock(Module):
@@ -93,11 +106,16 @@ class TransformerBlock(Module):
                 "fc1": self.fc1.init(ks[3]),
                 "fc2": self.fc2.init(jax.random.fold_in(ks[3], 1))}
 
+    def mlp(self, params: Params, x):
+        """LN → fc1 → GELU → fc2 (no residual/dropout). Shared by apply
+        and the cached decode path (models/generate.py)."""
+        return self.fc2.apply(params["fc2"],
+                              gelu(self.fc1.apply(params["fc1"],
+                                                  self.ln2.apply(params["ln2"], x))))
+
     def apply(self, params: Params, x, *, rng=None, train: bool = False, **_):
         r1, r2 = (jax.random.split(rng) if rng is not None else (None, None))
         h = self.attn.apply(params["attn"], self.ln1.apply(params["ln1"], x))
         x = x + self.drop.apply({}, h, rng=r1, train=train)
-        h = self.fc2.apply(params["fc2"],
-                           gelu(self.fc1.apply(params["fc1"],
-                                               self.ln2.apply(params["ln2"], x))))
-        return x + self.drop.apply({}, h, rng=r2, train=train)
+        return x + self.drop.apply({}, self.mlp(params, x), rng=r2,
+                                   train=train)

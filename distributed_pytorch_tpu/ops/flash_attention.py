@@ -412,4 +412,7 @@ def make_flash_attn_fn(block_q: int = 128, block_k: int = 128,
                                block_q=block_q, block_k=block_k,
                                interpret=interpret)
 
+    # computes exactly softmax(qk)v — cached decode (models/generate.py)
+    # may substitute its inline core for this one
+    attn_fn.dense_equivalent = True
     return attn_fn

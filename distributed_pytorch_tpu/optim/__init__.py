@@ -76,3 +76,11 @@ def adamw(lr: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
         return new_params, AdamWState(step=step, mu=mu, nu=nu)
 
     return Optimizer(init, update)
+
+
+# Schedules/transforms import Optimizer from this module, so they load
+# after it is defined.
+from . import schedules  # noqa: E402
+from .schedules import (accumulate, clip_by_global_norm, constant,  # noqa: E402
+                        cosine_decay, linear_warmup, warmup_cosine,
+                        with_clipping, with_schedule)

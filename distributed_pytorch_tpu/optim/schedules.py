@@ -1,0 +1,155 @@
+"""Learning-rate schedules and gradient transforms.
+
+The reference fixes ``AdamW(params, 1e-4)`` with no schedule, no clipping,
+no accumulation (``min_DDP.py:74``). Real training runs need all three;
+they are provided as pure functions/transforms so they compile into the
+same single XLA step program as the optimizer itself.
+
+A schedule is ``f(step) -> lr`` on traced int steps (usable inside jit);
+``with_schedule`` rebuilds any lr-taking optimizer factory into a
+scheduled optimizer. ``clip_by_global_norm`` is a grad transform;
+``accumulate`` wraps an optimizer so updates apply every k-th step with
+averaged gradients — the standard big-batch recipe when the per-step
+batch doesn't fit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import Optimizer
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def constant(lr: float) -> Schedule:
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+def linear_warmup(base: Schedule, warmup_steps: int) -> Schedule:
+    def f(step):
+        s = jnp.asarray(step, jnp.float32)
+        w = jnp.minimum(1.0, (s + 1.0) / float(max(warmup_steps, 1)))
+        return base(step) * w
+    return f
+
+
+def cosine_decay(lr: float, decay_steps: int, alpha: float = 0.0) -> Schedule:
+    """lr * (alpha + (1-alpha) * 0.5 * (1 + cos(pi * t)))  for t in [0,1]."""
+    if decay_steps < 1:
+        raise ValueError(f"decay_steps must be >= 1, got {decay_steps} "
+                         "(0 would make the lr 0/0 = NaN)")
+    def f(step):
+        t = jnp.clip(jnp.asarray(step, jnp.float32) / float(decay_steps),
+                     0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * t))
+        return lr * (alpha + (1.0 - alpha) * cos)
+    return f
+
+
+def warmup_cosine(lr: float, warmup_steps: int, total_steps: int,
+                  alpha: float = 0.0) -> Schedule:
+    """The standard LM schedule: linear warmup into cosine decay."""
+    decay = cosine_decay(lr, max(total_steps - warmup_steps, 1), alpha)
+    def f(step):
+        s = jnp.asarray(step, jnp.float32)
+        warm = lr * (s + 1.0) / float(max(warmup_steps, 1))
+        return jnp.where(s < warmup_steps,
+                         warm, decay(s - warmup_steps))
+    return f
+
+
+class ScheduledState(NamedTuple):
+    step: jnp.ndarray
+    inner: Any
+
+
+def with_schedule(opt_factory: Callable[[float], Optimizer],
+                  schedule: Schedule) -> Optimizer:
+    """Optimizer whose lr follows ``schedule``: ``opt_factory(lr)`` must
+    build the underlying optimizer for a given lr in a way that uses lr
+    only as a scalar multiplier (true of :func:`optim.sgd` /
+    :func:`optim.adamw`) — the factory is traced once with lr=1 and the
+    scheduled lr scales the parameter delta."""
+    unit = opt_factory(1.0)
+
+    def init(params):
+        return ScheduledState(step=jnp.zeros((), jnp.int32),
+                              inner=unit.init(params))
+
+    def update(grads, state, params):
+        lr = schedule(state.step)
+        new_params_unit, inner = unit.update(grads, state.inner, params)
+        # delta computed at lr=1, scaled by the scheduled lr
+        new_params = jax.tree_util.tree_map(
+            lambda p, pu: p + lr * (pu - p), params, new_params_unit)
+        return new_params, ScheduledState(step=state.step + 1, inner=inner)
+
+    return Optimizer(init, update)
+
+
+def global_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves))
+
+
+def clip_by_global_norm(grads, max_norm: float):
+    """Scale ``grads`` so their global L2 norm is at most ``max_norm``."""
+    norm = global_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+
+def with_clipping(opt: Optimizer, max_norm: float) -> Optimizer:
+    """Clip gradients by global norm before the inner update."""
+    def update(grads, state, params):
+        return opt.update(clip_by_global_norm(grads, max_norm), state,
+                          params)
+    return Optimizer(opt.init, update)
+
+
+class AccumState(NamedTuple):
+    count: jnp.ndarray   # micro-steps since last apply
+    acc: Any             # running gradient sum
+    inner: Any
+
+
+def accumulate(opt: Optimizer, every: int) -> Optimizer:
+    """Apply the inner optimizer every ``every`` micro-steps with the
+    mean of the accumulated gradients; in between, params pass through
+    unchanged. Effective batch = every x per-step batch, numerics equal
+    to one big batch (mean of means over equal micro-batches)."""
+    if every < 1:
+        raise ValueError(f"every must be >= 1, got {every}")
+
+    def init(params):
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        return AccumState(count=jnp.zeros((), jnp.int32), acc=zeros,
+                          inner=opt.init(params))
+
+    def update(grads, state, params):
+        acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), state.acc, grads)
+        count = state.count + 1
+
+        def apply(_):
+            mean = jax.tree_util.tree_map(lambda a: a / every, acc)
+            mean = jax.tree_util.tree_map(
+                lambda m, g: m.astype(g.dtype), mean, grads)
+            new_params, inner = opt.update(mean, state.inner, params)
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return new_params, AccumState(jnp.zeros((), jnp.int32), zeros,
+                                          inner)
+
+        def skip(_):
+            return params, AccumState(count, acc, state.inner)
+
+        return jax.lax.cond(count >= every, apply, skip, None)
+
+    return Optimizer(init, update)

@@ -71,6 +71,10 @@ def parse_args(argv=None):
                    help="Steps between host syncs (loss fetch + log). "
                         "Between boundaries the loop never blocks, so "
                         "steps pipeline on the device.")
+    p.add_argument("--generate", default=0, type=int, metavar="N",
+                   help="After training, greedy-decode N tokens from a "
+                        "short prompt with the compiled KV-cache path "
+                        "and print them (byte-decoded when --text).")
     return p.parse_args(argv)
 
 
@@ -206,6 +210,23 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
             f"done: {sps:.2f} steps/s, {sps * tokens_per_step:,.0f} "
             f"tokens/s (mean step {1e3 / sps:.2f} ms, "
             f"{timed_steps} timed steps)")
+
+    if args.generate > 0:
+        from distributed_pytorch_tpu.models import make_generate_fn
+        # generation runs on replicated single-program params
+        gen_params = jax.device_get(params)
+        x0, _ = dataset[0]
+        prompt = jnp.asarray(
+            np.asarray(x0)[: min(16, args.seq_len)][None], jnp.int32)
+        gen = jax.jit(make_generate_fn(model, args.generate))
+        toks = np.asarray(gen(gen_params, prompt,
+                              jax.random.PRNGKey(0)))[0]
+        if args.text:
+            dist.print_primary("generated:",
+                               bytes(toks.tolist()).decode(errors="replace"))
+        else:
+            dist.print_primary("generated tokens:", toks.tolist())
+
     logger.close()
     dist.cleanup()
     return params

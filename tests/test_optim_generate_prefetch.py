@@ -1,0 +1,256 @@
+"""Schedules/grad transforms, KV-cache generation, and device prefetch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu import models, optim
+from distributed_pytorch_tpu.data import (DataLoader, DummyDataset,
+                                          PrefetchLoader, device_prefetch)
+from distributed_pytorch_tpu.models.generate import (decode_step,
+                                                     make_generate_fn,
+                                                     prefill)
+
+
+# ---------------------------------------------------------------------------
+# schedules / transforms
+# ---------------------------------------------------------------------------
+
+
+class TestSchedules:
+    def test_cosine_endpoints(self):
+        s = optim.cosine_decay(1.0, 100)
+        assert float(s(0)) == pytest.approx(1.0)
+        assert float(s(100)) == pytest.approx(0.0, abs=1e-6)
+        assert 0.4 < float(s(50)) < 0.6
+
+    def test_warmup_cosine_shape(self):
+        s = optim.warmup_cosine(2.0, warmup_steps=10, total_steps=110)
+        assert float(s(0)) == pytest.approx(0.2)     # (0+1)/10 * 2
+        assert float(s(9)) == pytest.approx(2.0)
+        assert float(s(10)) == pytest.approx(2.0, rel=1e-3)  # decay start
+        assert float(s(110)) == pytest.approx(0.0, abs=1e-6)
+
+    def test_with_schedule_matches_fixed_lr_adamw(self):
+        """A constant schedule must reproduce the plain optimizer exactly
+        (the delta-scaling trick is exact for lr-linear updates)."""
+        params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+        grads = {"w": jnp.full((4, 4), 0.5), "b": jnp.ones((4,))}
+        plain = optim.adamw(3e-3)
+        sched = optim.with_schedule(optim.adamw, optim.constant(3e-3))
+        ps, ss = params, sched.init(params)
+        pp, sp = params, plain.init(params)
+        for _ in range(3):
+            ps, ss = sched.update(grads, ss, ps)
+            pp, sp = plain.update(grads, sp, pp)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(ps[k]), np.asarray(pp[k]),
+                                       rtol=1e-6)
+
+    def test_with_schedule_scales_step(self):
+        params = {"w": jnp.zeros((2,))}
+        grads = {"w": jnp.ones((2,))}
+        sched = optim.with_schedule(
+            optim.sgd, lambda step: jnp.where(step < 1, 1.0, 0.0))
+        p, s = params, sched.init(params)
+        p, s = sched.update(grads, s, p)
+        moved = float(p["w"][0])
+        p, s = sched.update(grads, s, p)
+        assert float(p["w"][0]) == pytest.approx(moved)  # lr 0: no move
+
+    def test_clipping(self):
+        g = {"a": jnp.full((3,), 4.0), "b": jnp.full((4,), 3.0)}
+        # global norm = sqrt(3*16 + 4*9) = sqrt(84)
+        clipped = optim.clip_by_global_norm(g, 1.0)
+        n = float(optim.schedules.global_norm(clipped))
+        assert n == pytest.approx(1.0, rel=1e-5)
+        same = optim.clip_by_global_norm(g, 100.0)
+        np.testing.assert_allclose(np.asarray(same["a"]), 4.0)
+
+    def test_with_clipping_wraps(self):
+        opt = optim.with_clipping(optim.sgd(1.0), max_norm=1.0)
+        p = {"w": jnp.zeros((4,))}
+        st = opt.init(p)
+        p2, _ = opt.update({"w": jnp.full((4,), 10.0)}, st, p)
+        assert float(jnp.linalg.norm(p2["w"])) == pytest.approx(1.0, rel=1e-5)
+
+    def test_accumulate_matches_big_batch(self):
+        """k micro-steps with accumulation == one step on the mean grad."""
+        params = {"w": jnp.ones((4,))}
+        micro = [{"w": jnp.full((4,), float(i))} for i in range(1, 4)]
+        mean = {"w": jnp.full((4,), 2.0)}
+
+        inner = optim.adamw(1e-2)
+        acc = optim.accumulate(optim.adamw(1e-2), every=3)
+        pa, sa = params, acc.init(params)
+        for g in micro:
+            pa, sa = acc.update(g, sa, pa)
+        pb, sb = inner.update(mean, inner.init(params), params)
+        np.testing.assert_allclose(np.asarray(pa["w"]), np.asarray(pb["w"]),
+                                   rtol=1e-6)
+
+    def test_accumulate_passthrough_between_applies(self):
+        acc = optim.accumulate(optim.sgd(1.0), every=2)
+        p = {"w": jnp.zeros((2,))}
+        s = acc.init(p)
+        p1, s = acc.update({"w": jnp.ones((2,))}, s, p)
+        np.testing.assert_allclose(np.asarray(p1["w"]), 0.0)  # no apply yet
+        p2, s = acc.update({"w": jnp.ones((2,))}, s, p1)
+        np.testing.assert_allclose(np.asarray(p2["w"]), -1.0)  # mean grad 1
+
+
+# ---------------------------------------------------------------------------
+# KV-cache generation
+# ---------------------------------------------------------------------------
+
+
+def _lm():
+    return models.TransformerLM(vocab=61, dim=32, n_layers=2, n_heads=4,
+                                max_seq=64)
+
+
+class TestGenerate:
+    def test_decode_matches_full_forward(self):
+        """Greedy cached decoding must equal argmax over the full
+        (uncached) forward at every step."""
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 7), 0, 61)
+        gen = jax.jit(make_generate_fn(model, max_new=6))
+        out = np.asarray(gen(params, prompt, jax.random.PRNGKey(2)))
+
+        # reference: repeatedly run the full model
+        toks = np.asarray(prompt)
+        want = []
+        for _ in range(6):
+            logits = model.apply(params, jnp.asarray(toks))
+            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+            want.append(nxt)
+            toks = np.concatenate([toks, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(out, np.stack(want, axis=1))
+
+    def test_prefill_then_decode_cache_consistency(self):
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 5), 0, 61)
+        logits, cache = prefill(model, params, prompt, max_len=16)
+        assert int(cache.length) == 5
+        full = model.apply(params, prompt)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, -1]), atol=1e-5)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits2, cache = decode_step(model, params, cache, nxt)
+        assert int(cache.length) == 6
+        full2 = model.apply(params, jnp.concatenate(
+            [prompt, nxt[:, None]], axis=1))
+        np.testing.assert_allclose(np.asarray(logits2),
+                                   np.asarray(full2[:, -1]), atol=1e-5)
+
+    def test_sampling_modes(self):
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        prompt = jnp.zeros((2, 3), jnp.int32)
+        out = make_generate_fn(model, 5, temperature=1.0, top_k=8)(
+            params, prompt, jax.random.PRNGKey(4))
+        assert out.shape == (2, 5)
+        assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < 61))
+
+    def test_max_seq_guard(self):
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="max_seq"):
+            make_generate_fn(model, 100)(params, jnp.zeros((1, 10), jnp.int32),
+                                         jax.random.PRNGKey(0))
+
+    def test_short_max_len_guard(self):
+        """An explicit max_len too small for prompt+max_new must raise,
+        not silently wrap the cache."""
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="cannot hold"):
+            make_generate_fn(model, 6, max_len=8)(
+                params, jnp.zeros((1, 5), jnp.int32), jax.random.PRNGKey(0))
+
+    def test_flash_attn_model_generates(self):
+        """Flash-built models pass the dense-equivalence check and decode
+        to the same greedy tokens as the dense-core model."""
+        from distributed_pytorch_tpu.ops import make_flash_attn_fn
+        dense = _lm()
+        flash = models.TransformerLM(vocab=61, dim=32, n_layers=2,
+                                     n_heads=4, max_seq=64,
+                                     attn_fn=make_flash_attn_fn(16, 16))
+        params = dense.init(jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(5), (1, 6), 0, 61)
+        a = make_generate_fn(dense, 5)(params, prompt, jax.random.PRNGKey(6))
+        b = make_generate_fn(flash, 5)(params, prompt, jax.random.PRNGKey(6))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_custom_attn_rejected(self):
+        def weird(q, k, v, *, causal=False, scale=None):
+            return v
+        model = models.TransformerLM(vocab=61, dim=32, n_layers=1,
+                                     n_heads=4, max_seq=64, attn_fn=weird)
+        with pytest.raises(ValueError, match="custom attn_fn"):
+            make_generate_fn(model, 2)
+        make_generate_fn(model, 2, allow_custom_attn=True)  # escape hatch
+
+    def test_single_token_generate(self):
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        prompt = jnp.zeros((2, 3), jnp.int32)
+        out = make_generate_fn(model, 1)(params, prompt,
+                                         jax.random.PRNGKey(0))
+        assert out.shape == (2, 1)
+        full = model.apply(params, prompt)
+        np.testing.assert_array_equal(
+            np.asarray(out[:, 0]),
+            np.asarray(jnp.argmax(full[:, -1], axis=-1)))
+
+
+# ---------------------------------------------------------------------------
+# prefetch
+# ---------------------------------------------------------------------------
+
+
+class TestPrefetch:
+    def test_yields_all_batches_on_device(self):
+        ds = DummyDataset(32, 4)
+        loader = DataLoader(ds, batch_size=8)
+        got = list(device_prefetch(loader, size=2))
+        assert len(got) == len(loader) == 4
+        x, y = got[0]
+        assert isinstance(x, jax.Array) and isinstance(y, jax.Array)
+        np.testing.assert_allclose(np.asarray(x)[:, 0],
+                                   np.arange(8, dtype=np.float32))
+
+    def test_error_propagates(self):
+        def bad():
+            yield (np.zeros(2), np.zeros(2))
+            raise RuntimeError("source died")
+        it = device_prefetch(bad(), size=1)
+        next(it)
+        with pytest.raises(RuntimeError, match="source died"):
+            list(it)
+
+    def test_prefetch_loader_epochs(self):
+        ds = DummyDataset(16, 4)
+        pl = PrefetchLoader(DataLoader(ds, batch_size=4), size=2)
+        assert len(pl) == 4
+        pl.set_epoch(1)
+        for epoch_batches in (list(pl), list(pl)):  # re-iterable
+            assert len(epoch_batches) == 4
+
+    def test_abandoned_iterator_stops_worker(self):
+        import threading
+        ds = DummyDataset(64, 4)
+        it = device_prefetch(DataLoader(ds, batch_size=1), size=1)
+        next(it)
+        it.close()  # generator finalizer sets the abandoned flag
+        deadline = __import__("time").monotonic() + 5
+        while __import__("time").monotonic() < deadline:
+            if not any(t.name == "dpx-prefetch" and t.is_alive()
+                       for t in threading.enumerate()):
+                break
+        assert not any(t.name == "dpx-prefetch" and t.is_alive()
+                       for t in threading.enumerate())
