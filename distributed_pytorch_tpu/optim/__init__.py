@@ -49,10 +49,17 @@ class AdamWState(NamedTuple):
 def adamw(lr: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
           weight_decay: float = 0.01) -> Optimizer:
     """AdamW with torch-default hyperparameters (``min_DDP.py:74`` passes
-    only the learning rate, inheriting betas/eps/wd defaults)."""
+    only the learning rate, inheriting betas/eps/wd defaults).
+
+    Moments are kept in float32 and the update computed in float32
+    regardless of parameter dtype — for float32 params this is exactly
+    torch's arithmetic; for bfloat16 params it is the standard
+    mixed-precision recipe (bf16 moments destroy Adam's second-moment
+    scale), with the delta cast back to the parameter dtype."""
 
     def init(params):
-        zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
+        zeros = lambda: jax.tree_util.tree_map(
+            lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
         return AdamWState(step=jnp.zeros((), jnp.int32), mu=zeros(), nu=zeros())
 
     def update(grads, state, params):
@@ -61,16 +68,19 @@ def adamw(lr: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
         c1 = 1.0 - b1 ** t
         c2 = 1.0 - b2 ** t
 
-        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g,
-                                    state.mu, grads)
-        nu = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * (g * g),
-                                    state.nu, grads)
+        mu = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+            state.mu, grads)
+        nu = jax.tree_util.tree_map(
+            lambda v, g: b2 * v + (1 - b2) * jnp.square(
+                g.astype(jnp.float32)),
+            state.nu, grads)
 
         def step_fn(p, m, v):
-            p = p * (1.0 - lr * weight_decay)
+            pf = p.astype(jnp.float32) * (1.0 - lr * weight_decay)
             mhat = m / c1
             vhat = v / c2
-            return p - lr * mhat / (jnp.sqrt(vhat) + eps)
+            return (pf - lr * mhat / (jnp.sqrt(vhat) + eps)).astype(p.dtype)
 
         new_params = jax.tree_util.tree_map(step_fn, params, mu, nu)
         return new_params, AdamWState(step=step, mu=mu, nu=nu)

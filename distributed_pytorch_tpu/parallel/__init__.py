@@ -2,7 +2,8 @@
 pipeline (GPipe over pp), expert (Switch MoE over ep), and the composed
 GSPMD mesh trainer."""
 from . import data_parallel, fsdp, moe, pipeline, sequence, spmd, tensor
-from .data_parallel import (DataParallel, make_scan_train_steps,
+from .data_parallel import (DataParallel, make_eval_step,
+                            make_scan_train_steps, make_stateful_eval_step,
                             make_stateful_train_step, make_train_step,
                             prepare_ddp_model, stack_state)
 from .fsdp import (fsdp_param_specs, make_fsdp_train_step,

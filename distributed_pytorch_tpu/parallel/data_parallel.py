@@ -179,6 +179,46 @@ def make_stateful_train_step(loss_fn: Callable, optimizer: Optimizer,
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
 
 
+def make_eval_step(eval_fn: Callable) -> Callable:
+    """Compile a data-parallel evaluation step (no gradients, no update).
+
+    ``eval_fn(params, batch) -> metrics`` returns a pytree of per-example
+    arrays (leading axis = local batch). The returned
+    ``step(params, batch)`` runs on the global batch (axis 0 sharded over
+    ``dp``) and yields the metrics in global rank order — the inference
+    analog of :func:`make_train_step`, with the same 0/1/N graceful
+    degradation."""
+    world = context.get_world_size()
+    if world == 1:
+        return jax.jit(eval_fn)
+    mesh = context.get_mesh()
+    sharded = shard_map(
+        eval_fn, mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def make_stateful_eval_step(eval_fn: Callable) -> Callable:
+    """Like :func:`make_eval_step` for models with state (BatchNorm
+    running stats): ``eval_fn(params, state, batch) -> metrics``. State is
+    per-device (the stacked layout of :func:`stack_state`) and read-only —
+    eval mode uses running stats without updating them."""
+    world = context.get_world_size()
+    if world == 1:
+        return jax.jit(eval_fn)
+    mesh = context.get_mesh()
+    sharded = shard_map(
+        eval_fn, mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
 def stack_state(state, world: Optional[int] = None):
     """Stack a single model-state pytree to the per-rank layout the
     stateful step expects (leading axis = world)."""

@@ -48,6 +48,9 @@ def parse_args(argv=None):
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--limit-steps", default=None, type=int,
                    help="Cap steps per epoch (smoke runs).")
+    p.add_argument("--eval", action="store_true",
+                   help="Evaluate after each epoch on the held-out split "
+                        "(CIFAR test_batch, or 10%% of synthetic data).")
     p.add_argument("--log", default=None, type=str)
     return p.parse_args(argv)
 
@@ -59,13 +62,15 @@ class Cifar10:
     MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
     STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, split: str = "train"):
         d = os.path.join(root, "cifar-10-batches-py")
         if not os.path.isdir(d):
             raise FileNotFoundError(f"{d} not found")
+        files = ([f"data_batch_{i}" for i in range(1, 6)]
+                 if split == "train" else ["test_batch"])
         xs, ys = [], []
-        for i in range(1, 6):
-            with open(os.path.join(d, f"data_batch_{i}"), "rb") as f:
+        for name in files:
+            with open(os.path.join(d, name), "rb") as f:
                 batch = pickle.load(f, encoding="bytes")
             xs.append(batch[b"data"])
             ys.extend(batch[b"labels"])
@@ -92,8 +97,13 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
 
     if args.data_dir:
         dataset = Cifar10(args.data_dir)
+        eval_set = Cifar10(args.data_dir, split="test") if args.eval else None
     else:
         dataset = SyntheticImages(args.data_size)
+        eval_set = (SyntheticImages(max(args.data_size // 10,
+                                        args.batch_size * max(world_size, 1)),
+                                    seed=1)
+                    if args.eval else None)
     sampler = dist.data_sampler(dataset, is_distributed, shuffle=True)
     loader = DataLoader(dataset, batch_size=args.batch_size,
                         shuffle=(sampler is None), sampler=sampler,
@@ -131,6 +141,25 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
         return per_ex.mean(), (new_st, {"correct": correct})
 
     step_fn = make_stateful_train_step(loss_fn, optimizer)
+
+    eval_step = eval_loader = None
+    if eval_set is not None:
+        from distributed_pytorch_tpu.parallel import make_stateful_eval_step
+
+        eval_sampler = dist.data_sampler(eval_set, is_distributed,
+                                         shuffle=False)
+        eval_loader = DataLoader(eval_set, batch_size=args.batch_size,
+                                 sampler=eval_sampler, drop_last=True)
+
+        def eval_fn(p, st, batch):
+            x, y = batch
+            logits, _ = model.apply(p, x.astype(
+                jnp.bfloat16 if args.bf16 else jnp.float32), state=st,
+                train=False)
+            return (jnp.argmax(logits, axis=-1) == y)
+
+        eval_step = make_stateful_eval_step(eval_fn)
+
     logger = MetricsLogger(args.log)
 
     # Host syncs only at epoch boundaries: losses and correct-counts are
@@ -167,6 +196,15 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
             dist.print_primary(
                 f"epoch {epoch}: acc {correct_sum / max(n_seen, 1):.4f} "
                 f"loss {losses[-1]:.4f}")
+        if eval_step is not None:
+            evs = [eval_step(params, state, dist.shard_batch(b))
+                   for b in eval_loader]
+            corr = np.concatenate([np.asarray(e).reshape(-1) for e in evs])
+            logger.log(epoch, eval_acc=corr.mean())
+            if not quiet:
+                dist.print_primary(
+                    f"epoch {epoch}: EVAL acc {corr.mean():.4f} "
+                    f"({int(corr.sum())}/{corr.size})")
 
     jax.block_until_ready(params)
     if t_run0 is not None and timed_steps > 0 and not quiet:

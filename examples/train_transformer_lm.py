@@ -75,7 +75,24 @@ def parse_args(argv=None):
                    help="After training, greedy-decode N tokens from a "
                         "short prompt with the compiled KV-cache path "
                         "and print them (byte-decoded when --text).")
+    p.add_argument("--eval", action="store_true",
+                   help="Hold out 10%% of the data; report validation "
+                        "loss and perplexity after training.")
     return p.parse_args(argv)
+
+
+class Subset:
+    """Index-selected view of a dataset (the holdout split)."""
+
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = np.asarray(indices)
+
+    def __getitem__(self, i):
+        return self.dataset[int(self.indices[i])]
+
+    def __len__(self):
+        return len(self.indices)
 
 
 class ByteCorpus:
@@ -111,6 +128,12 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
         dataset = ByteCorpus(args.text, args.seq_len)
     else:
         dataset = SyntheticLM(args.data_size, args.seq_len, vocab)
+    eval_set = None
+    if args.eval:
+        n = len(dataset)
+        n_eval = max(n // 10, 1)
+        dataset, eval_set = (Subset(dataset, np.arange(n - n_eval)),
+                             Subset(dataset, np.arange(n - n_eval, n)))
     sampler = dist.data_sampler(dataset, is_distributed, shuffle=True)
     loader = DataLoader(dataset, batch_size=args.batch_size,
                         shuffle=(sampler is None), sampler=sampler,
@@ -211,13 +234,41 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
             f"tokens/s (mean step {1e3 / sps:.2f} ms, "
             f"{timed_steps} timed steps)")
 
+    if eval_set is not None:
+        from distributed_pytorch_tpu.parallel import make_eval_step
+
+        eval_sampler = dist.data_sampler(eval_set, is_distributed,
+                                         shuffle=False)
+        eval_loader = DataLoader(eval_set, batch_size=args.batch_size,
+                                 sampler=eval_sampler, drop_last=True)
+        if len(eval_loader) == 0:
+            dist.print_primary("eval: holdout smaller than one global "
+                               "batch; skipping")
+        else:
+            def eval_fn(p, batch):
+                x, y = batch
+                return cross_entropy_per_example(model.apply(p, x), y)
+
+            # FSDP-sharded params work unchanged (eval_fn is replicated
+            # code; the partitioner gathers as needed)
+            ev = (make_eval_step(eval_fn) if not (args.fsdp and
+                                                  is_distributed)
+                  else jax.jit(eval_fn))
+            nlls = [np.asarray(ev(params, place(b))).reshape(-1)
+                    for b in eval_loader]
+            nll = float(np.concatenate(nlls).mean())
+            logger.log(step, eval_nll=nll)
+            if not quiet:
+                dist.print_primary(
+                    f"eval: nll {nll:.4f}  ppl {np.exp(min(nll, 20)):.2f}")
+
     if args.generate > 0:
         from distributed_pytorch_tpu.models import make_generate_fn
         # generation runs on replicated single-program params
         gen_params = jax.device_get(params)
         x0, _ = dataset[0]
-        prompt = jnp.asarray(
-            np.asarray(x0)[: min(16, args.seq_len)][None], jnp.int32)
+        p_len = max(1, min(16, args.seq_len, model.max_seq - args.generate))
+        prompt = jnp.asarray(np.asarray(x0)[:p_len][None], jnp.int32)
         gen = jax.jit(make_generate_fn(model, args.generate))
         toks = np.asarray(gen(gen_params, prompt,
                               jax.random.PRNGKey(0)))[0]

@@ -59,6 +59,23 @@ def test_resnet_synthetic():
     assert all(np.isfinite(x) for x in h)
 
 
+def test_resnet_eval():
+    h = []
+    dist.launch(train_resnet.main_worker,
+                ["--epochs", "1", "--batch-size", "2", "--data-size", "128",
+                 "--limit-steps", "1", "--eval"], True, h)
+    assert h and all(np.isfinite(x) for x in h)
+
+
+def test_transformer_lm_eval_and_generate():
+    h = []
+    dist.launch(train_transformer_lm.main_worker,
+                ["--steps", "3", "--batch-size", "1", "--seq-len", "16",
+                 "--dim", "16", "--n-layers", "1", "--n-heads", "2",
+                 "--data-size", "128", "--eval", "--generate", "4"], True, h)
+    assert len(h) == 3 and all(np.isfinite(x) for x in h)
+
+
 def test_resnet_missing_cifar_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         train_resnet.Cifar10(str(tmp_path))
