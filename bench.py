@@ -1,36 +1,42 @@
-"""Benchmark: min_ddp steps/sec/chip on DummyModel (BASELINE.json metric).
+"""Headline benchmark. Prints ONE JSON line:
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "steps/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-The reference publishes no numbers (BASELINE.md), so the baseline is
-*measured* here: the same workload (MLP 1->hidden->classes, batch 8,
-CrossEntropy, AdamW lr 1e-4) in eager torch on this host's CPU — the
-reference's actual single-process execution model (its world<=1 branch,
-reference distributed.py:54-58, runs plain eager torch with no process
-group). value = this framework's steps/sec on the accelerator using its
-fast path (scan-fused steps: N train steps compiled into one XLA program,
-parallel/data_parallel.py make_scan_train_steps; numerics proven equal to
-per-step execution in tests/test_models.py).
+Three measurements, most important first:
+
+1. **Flagship MFU** (the headline ``value``): TransformerLM, ~135M params,
+   bf16, flash attention, seq 1024, trained single-chip. ``value`` is the
+   MFU fraction = achieved model FLOP/s / chip peak bf16 FLOP/s
+   (benchmarks/mfu_transformer.py). The reference cannot run this model at
+   all; ``vs_baseline`` is our tokens/s over eager-torch-CPU tokens/s on
+   the same model — the only measurable torch baseline in this
+   environment (torch has no TPU backend here).
+2. **min_ddp metric** (``min_ddp`` field): the reference's implicit
+   benchmark (MLP 1->32->4, batch 8, reference min_DDP.py:44-48).
+   ``steps_per_sec`` is the PER-STEP path — one jitted call per step,
+   matching the reference workload's per-step loss materialization
+   semantics. The scan-fused path (N steps per XLA call; legitimate
+   TPU fast path but different semantics) is reported separately as
+   ``fused_steps_per_sec``, never as the headline.
+3. **world-8 DP step** (``dp8`` field): the same min_ddp train step on an
+   8-device virtual CPU mesh (subprocess), so collective overhead is
+   measured at all. steps/s on 8 CPU devices, global batch 64.
+
+Robustness: the TPU backend behind the axon tunnel comes and goes
+(BENCH_r01.json died on it). Backend init runs in a subprocess with
+bounded retries + backoff; on final failure the script still prints a
+parseable JSON record with an ``error`` field and whatever measurements
+did succeed (rc stays 0 so the record is recorded).
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-import distributed_pytorch_tpu as dist
-from distributed_pytorch_tpu import models, optim
-from distributed_pytorch_tpu.data import DummyDataset
-from distributed_pytorch_tpu.ops.losses import cross_entropy
-from distributed_pytorch_tpu.parallel import (make_scan_train_steps,
-                                              make_train_step)
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
 BATCH = 8
 HIDDEN = 32
@@ -38,9 +44,75 @@ N_CLASSES = 4
 DATA_SIZE = 32
 
 
+# ---------------------------------------------------------------------------
+# backend probing with retries
+# ---------------------------------------------------------------------------
+
+
+def probe_backend(timeout_s: int = 120) -> dict:
+    """Probe JAX backend init in a SUBPROCESS (a wedged tunnel hangs the
+    whole process — a timeout around an in-process jax.devices() call
+    cannot recover it). Only a real TPU counts as healthy: a CPU
+    fallback would silently run the flagship bench on the host (with
+    interpret-mode pallas — hours, and no meaningful MFU)."""
+    code = ("import jax, json; d = jax.devices()[0]; "
+            "print(json.dumps({'platform': d.platform, "
+            "'kind': d.device_kind}))")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+        if out.returncode == 0 and out.stdout.strip():
+            info = json.loads(out.stdout.strip().splitlines()[-1])
+            if info.get("platform") == "tpu":
+                return info
+    except (subprocess.TimeoutExpired, json.JSONDecodeError):
+        pass
+    return {}
+
+
+def wait_for_backend(max_tries: int = 4, base_sleep_s: float = 30.0) -> dict:
+    """Bounded retries with backoff; returns probe info ({} = no TPU)."""
+    for i in range(max_tries):
+        info = probe_backend()
+        if info:
+            return info
+        if i < max_tries - 1:
+            sleep = base_sleep_s * (2 ** i)
+            print(f"# backend probe {i + 1}/{max_tries} failed; "
+                  f"retrying in {sleep:.0f}s", file=sys.stderr)
+            time.sleep(sleep)
+    return {}
+
+
+def _run_stage(stage: str, timeout_s: int) -> dict:
+    """Re-invoke this script for one measurement stage in a subprocess
+    with a hard timeout — the tunnel can wedge mid-run, and the
+    parseable-JSON-on-failure contract must survive that."""
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--stage", stage],
+            capture_output=True, text=True, timeout=timeout_s,
+            env={**os.environ,
+                 "PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")})
+        if out.returncode == 0 and out.stdout.strip():
+            return json.loads(out.stdout.strip().splitlines()[-1])
+        return {"error": (out.stderr or "no output").strip()[-500:]}
+    except subprocess.TimeoutExpired:
+        return {"error": f"stage {stage} timed out after {timeout_s}s"}
+    except json.JSONDecodeError as e:
+        return {"error": f"stage {stage} emitted unparseable output: {e}"}
+
+
+# ---------------------------------------------------------------------------
+# measurement 2: the reference's implicit benchmark (min_ddp MLP)
+# ---------------------------------------------------------------------------
+
+
 def _batches(n_steps: int, seed: int = 0):
-    """Cycle the seeded DummyDataset in loader order, batch 8 (the
-    reference's implicit benchmark config, BASELINE.md)."""
+    import numpy as np
+    from distributed_pytorch_tpu.data import DummyDataset
     ds = DummyDataset(DATA_SIZE, N_CLASSES, seed=seed)
     xs, ys = [], []
     for t in range(n_steps):
@@ -50,8 +122,16 @@ def _batches(n_steps: int, seed: int = 0):
     return np.stack(xs), np.stack(ys)
 
 
-def bench_ours(n_steps: int = 2000, fused_chunk: int = 100):
-    model = models.DummyModel(in_dim=1, hidden_dim=HIDDEN, n_classes=N_CLASSES)
+def bench_min_ddp(n_steps: int = 2000, fused_chunk: int = 100) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from distributed_pytorch_tpu import models, optim
+    from distributed_pytorch_tpu.ops.losses import cross_entropy
+    from distributed_pytorch_tpu.parallel import (make_scan_train_steps,
+                                                  make_train_step)
+
+    model = models.DummyModel(in_dim=1, hidden_dim=HIDDEN,
+                              n_classes=N_CLASSES)
     params = model.init(jax.random.PRNGKey(0))
     opt = optim.adamw(1e-4)
     opt_state = opt.init(params)
@@ -63,25 +143,11 @@ def bench_ours(n_steps: int = 2000, fused_chunk: int = 100):
     xs, ys = _batches(fused_chunk)
     xs, ys = jnp.asarray(xs), jnp.asarray(ys)
 
-    # --- fused path: fused_chunk steps per XLA call
-    run = make_scan_train_steps(loss_fn, opt, n_steps=fused_chunk)
-    params2, opt2, losses = run(params, opt_state, (xs, ys))  # compile
-    jax.block_until_ready(losses)
-    n_calls = max(n_steps // fused_chunk, 1)
-    t0 = time.perf_counter()
-    p, o = params2, opt2
-    for _ in range(n_calls):
-        p, o, losses = run(p, o, (xs, ys))
-    jax.block_until_ready(losses)
-    fused_sps = n_calls * fused_chunk / (time.perf_counter() - t0)
-
-    # --- per-step path (one jitted call per step, like the eager loop);
-    # fresh params: the fused path donated (and thus deleted) the originals
-    params = model.init(jax.random.PRNGKey(0))
-    opt_state = opt.init(params)
+    # per-step path FIRST (the honest number for the reference's per-step
+    # semantics): one jitted call per step, loss materialized every step.
     step = make_train_step(loss_fn, opt, donate=False)
     b0 = (xs[0], ys[0])
-    out = step(params, opt_state, b0)  # compile
+    out = step(params, opt_state, b0)
     jax.block_until_ready(out.loss)
     m = min(n_steps, 500)
     t0 = time.perf_counter()
@@ -90,13 +156,29 @@ def bench_ours(n_steps: int = 2000, fused_chunk: int = 100):
     jax.block_until_ready(out.loss)
     per_step_sps = m / (time.perf_counter() - t0)
 
-    return fused_sps, per_step_sps
+    # scan-fused fast path (different semantics: no per-step host visibility)
+    run = make_scan_train_steps(loss_fn, opt, n_steps=fused_chunk)
+    p2, o2, losses = run(params, opt_state, (xs, ys))
+    jax.block_until_ready(losses)
+    n_calls = max(n_steps // fused_chunk, 1)
+    t0 = time.perf_counter()
+    p, o = p2, o2
+    for _ in range(n_calls):
+        p, o, losses = run(p, o, (xs, ys))
+    jax.block_until_ready(losses)
+    fused_sps = n_calls * fused_chunk / (time.perf_counter() - t0)
+
+    return {"steps_per_sec": round(per_step_sps, 1),
+            "fused_steps_per_sec": round(fused_sps, 1)}
 
 
-def bench_torch_cpu(n_steps: int = 500):
-    """The measured baseline: the reference's workload in eager torch CPU."""
+def bench_torch_cpu_mlp(n_steps: int = 500) -> float:
+    """Measured baseline: the reference's workload in eager torch on this
+    host's CPU (the reference's world<=1 branch runs exactly this,
+    reference distributed.py:54-58)."""
     import torch
     import torch.nn as nn
+    from distributed_pytorch_tpu.data import DummyDataset
 
     torch.manual_seed(0)
     model = nn.Sequential(nn.Linear(1, HIDDEN), nn.Linear(HIDDEN, N_CLASSES))
@@ -105,7 +187,6 @@ def bench_torch_cpu(n_steps: int = 500):
     ds = DummyDataset(DATA_SIZE, N_CLASSES)
     x = torch.tensor(ds.data[:BATCH])
     y = torch.tensor(ds.labels[:BATCH]).long()
-    # warmup
     for _ in range(20):
         opt.zero_grad(); crit(model(x), y).backward(); opt.step()
     t0 = time.perf_counter()
@@ -117,26 +198,170 @@ def bench_torch_cpu(n_steps: int = 500):
     return n_steps / (time.perf_counter() - t0)
 
 
-def main():
-    fused, per_step, baseline = None, None, None
-    fused, per_step = bench_ours()
-    try:
-        baseline = bench_torch_cpu()
-    except Exception:
-        baseline = None
+def bench_torch_cpu_lm(dim=768, n_layers=12, n_heads=12, vocab=32000,
+                       seq=1024, batch=2, n_steps=2) -> float:
+    """tokens/s for the flagship LM config in eager torch CPU — the
+    vs_baseline denominator for the MFU headline."""
+    import torch
+    import torch.nn as nn
 
-    value = fused
+    torch.manual_seed(0)
+    layer = nn.TransformerEncoderLayer(
+        dim, n_heads, 4 * dim, batch_first=True, norm_first=True,
+        activation="gelu")
+    enc = nn.TransformerEncoder(layer, n_layers)
+    emb = nn.Embedding(vocab, dim)
+    head = nn.Linear(dim, vocab, bias=False)
+    params = (list(enc.parameters()) + list(emb.parameters())
+              + list(head.parameters()))
+    opt = torch.optim.AdamW(params, 3e-4)
+    crit = nn.CrossEntropyLoss()
+    mask = nn.Transformer.generate_square_subsequent_mask(seq)
+    tokens = torch.randint(0, vocab, (batch, seq + 1))
+
+    def one_step():
+        opt.zero_grad()
+        h = emb(tokens[:, :-1])
+        h = enc(h, mask=mask, is_causal=True)
+        loss = crit(head(h).reshape(-1, vocab),
+                    tokens[:, 1:].reshape(-1))
+        loss.backward()
+        opt.step()
+
+    one_step()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        one_step()
+    dt = time.perf_counter() - t0
+    return n_steps * batch * seq / dt
+
+
+# ---------------------------------------------------------------------------
+# measurement 3: world-8 DP step on the virtual CPU mesh (subprocess —
+# platform selection must happen before backend init)
+# ---------------------------------------------------------------------------
+
+_DP8_CODE = r"""
+import json, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import jax.numpy as jnp
+import numpy as np
+import distributed_pytorch_tpu as dist
+from distributed_pytorch_tpu import models, optim
+from distributed_pytorch_tpu.ops.losses import cross_entropy
+from distributed_pytorch_tpu.parallel import make_train_step
+
+dist.init_process_group(rank=0, world_size=8)
+model = models.DummyModel(in_dim=1, hidden_dim=32, n_classes=4)
+params = model.init(jax.random.PRNGKey(0))
+opt = optim.adamw(1e-4)
+opt_state = opt.init(params)
+
+def loss_fn(p, batch):
+    x, y = batch
+    return cross_entropy(model.apply(p, x), y), {}
+
+step = make_train_step(loss_fn, opt, donate=False)
+x = dist.shard_batch(np.arange(64, dtype=np.float32)[:, None])
+y = dist.shard_batch(np.zeros(64, dtype=np.int32))
+out = step(params, opt_state, (x, y))
+jax.block_until_ready(out.loss)
+# fence every step: on a small host the 8-way rendezvous aborts if many
+# async steps pile up (and the reference's workload materializes loss
+# per step anyway, so the fenced number is the semantically right one)
+n = 50
+t0 = time.perf_counter()
+for _ in range(n):
+    out = step(out.params, out.opt_state, (x, y))
+    jax.block_until_ready(out.loss)
+print(json.dumps({"steps_per_sec": round(n / (time.perf_counter() - t0), 1),
+                  "world": 8, "global_batch": 64}))
+"""
+
+
+def bench_dp8() -> dict:
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _DP8_CODE], capture_output=True,
+            text=True, timeout=600,
+            env={**os.environ,
+                 "PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", ""),
+                 "JAX_PLATFORMS": "cpu", "DPX_CPU_DEVICES": "8"})
+        if out.returncode == 0 and out.stdout.strip():
+            return json.loads(out.stdout.strip().splitlines()[-1])
+        return {"error": (out.stderr or "no output").strip()[-500:]}
+    except subprocess.TimeoutExpired:
+        return {"error": "dp8 bench timed out"}
+
+
+# ---------------------------------------------------------------------------
+
+
+def _stage_main(stage: str) -> int:
+    """Run ONE measurement in this process and print its JSON line
+    (invoked by the orchestrator via _run_stage)."""
+    if stage == "mfu":
+        from benchmarks.mfu_transformer import run as mfu_run
+        print(json.dumps(mfu_run()))
+    elif stage == "min_ddp":
+        print(json.dumps(bench_min_ddp()))
+    else:
+        print(json.dumps({"error": f"unknown stage {stage!r}"}))
+        return 2
+    return 0
+
+
+def main():
     rec = {
-        "metric": "min_ddp_dummymodel_steps_per_sec_per_chip",
-        "value": round(value, 1),
-        "unit": "steps/s",
-        "vs_baseline": round(value / baseline, 2) if baseline else None,
-        "per_step_path_steps_per_sec": round(per_step, 1),
-        "torch_cpu_baseline_steps_per_sec": round(baseline, 1) if baseline else None,
-        "device": str(jax.devices()[0]),
+        "metric": "transformer_lm_mfu_single_chip",
+        "value": None,
+        "unit": "mfu_fraction",
+        "vs_baseline": None,
     }
+
+    info = wait_for_backend()
+    rec["device"] = info.get("kind") or "none"
+
+    if info:
+        mfu_rec = _run_stage("mfu", timeout_s=1800)
+        if "mfu" in mfu_rec:
+            rec["value"] = mfu_rec["mfu"]
+            rec["tokens_per_sec"] = mfu_rec["tokens_per_sec"]
+            rec["mfu_detail"] = mfu_rec
+        else:
+            rec["error"] = f"mfu stage: {mfu_rec.get('error', 'no result')}"
+        rec["min_ddp"] = _run_stage("min_ddp", timeout_s=900)
+    else:
+        rec["error"] = "no healthy TPU backend after retries"
+
+    try:
+        tps = bench_torch_cpu_lm()
+        rec["torch_cpu_lm_tokens_per_sec"] = round(tps, 1)
+        if rec.get("tokens_per_sec"):
+            rec["vs_baseline"] = round(rec["tokens_per_sec"] / tps, 2)
+    except Exception as e:  # noqa: BLE001
+        rec["torch_cpu_lm_tokens_per_sec"] = None
+        rec.setdefault("warnings", []).append(
+            f"torch lm baseline failed: {type(e).__name__}: {e}")
+
+    try:
+        sps = bench_torch_cpu_mlp()
+        if "steps_per_sec" in rec.get("min_ddp", {}):
+            rec["min_ddp"]["torch_cpu_baseline_steps_per_sec"] = round(sps, 1)
+            rec["min_ddp"]["vs_torch_cpu"] = round(
+                rec["min_ddp"]["steps_per_sec"] / sps, 2)
+    except Exception:  # noqa: BLE001
+        pass
+
+    rec["dp8"] = bench_dp8()
+
     print(json.dumps(rec))
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--stage":
+        raise SystemExit(_stage_main(sys.argv[2]))
     main()

@@ -143,7 +143,7 @@ def all_gather(data):
     world = context.get_world_size()
     if world == 1:
         return jnp.asarray(data)[None]
-    return jnp.asarray(data)
+    return _check_stacked(jnp.asarray(data), "all_gather")
 
 
 def broadcast(tensor, src: int = 0):

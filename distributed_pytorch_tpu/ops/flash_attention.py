@@ -147,7 +147,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     def _finish():
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        # Rows whose running max never moved off the _MASK sentinel saw no
+        # unmasked logit (causal with s_q > s_k puts whole rows above the
+        # diagonal). Dense softmax over an all--inf row is NaN; match it —
+        # otherwise such rows silently emit a mean of masked-out v rows.
+        no_logit = m_scr[:, :1] == _MASK
+        out = jnp.where(no_logit, jnp.float32(jnp.nan), acc_scr[:] / l_safe)
+        o_ref[0] = out.astype(o_ref.dtype)
         # Row stats are written (bq, _STATS)-wide: TPU blocks need their
         # trailing dim to be 128-divisible or the full array dim, so the
         # stat arrays carry a narrow replicated trailing axis and column 0

@@ -1,5 +1,5 @@
 """Parallelism engines: data (DDP), tensor, sequence (ring attention),
-pipeline (GPipe over pp), expert (Switch MoE over ep), and the composed
+pipeline (GPipe + 1F1B over pp), expert (Switch MoE over ep), and the composed
 GSPMD mesh trainer."""
 from . import data_parallel, fsdp, moe, pipeline, sequence, spmd, tensor
 from .data_parallel import (DataParallel, make_eval_step,
@@ -9,8 +9,8 @@ from .data_parallel import (DataParallel, make_eval_step,
 from .fsdp import (fsdp_param_specs, make_fsdp_train_step,
                    shard_model_and_opt)
 from .moe import MoELayer, moe_param_specs
-from .pipeline import (make_gspmd_pipeline_fn, pipeline_apply,
-                       stack_layer_params)
+from .pipeline import (make_gspmd_pipeline_fn, make_pipeline_train_fn,
+                       pipeline_apply, stack_layer_params)
 from .sequence import make_ring_attn_fn, ring_attention
 from .spmd import (make_gspmd_ring_attn_fn, make_spmd_train_step,
                    shard_batch_spec)

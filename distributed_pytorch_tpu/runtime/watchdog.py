@@ -207,14 +207,27 @@ def _proc_environ(pid: int) -> Dict[str, str]:
     return env
 
 
+def _launcher_pid(tag: str) -> Optional[int]:
+    """Launch tags are ``<launcher_pid>-<microsecond timestamp>``
+    (runtime/multiprocess.py); recover the launcher pid, or None for a
+    foreign/unparseable tag."""
+    head, _, _ = tag.partition("-")
+    return int(head) if head.isdigit() else None
+
+
 def find_tagged_workers(tag: Optional[str] = None,
                         exclude_tag: Optional[str] = None,
-                        exclude_active: bool = True) -> List[int]:
-    """PIDs of live processes carrying ``DPX_WORKER_TAG`` in their
-    environment — optionally only a specific ``tag``, always sparing the
-    tags of runs this process currently has in flight unless
-    ``exclude_active=False``. Returns ``[]`` on platforms without
-    ``/proc``."""
+                        exclude_active: bool = True,
+                        require_dead_launcher: bool = True) -> List[int]:
+    """PIDs of live ORPHANED processes carrying ``DPX_WORKER_TAG`` in
+    their environment — optionally only a specific ``tag``, always sparing
+    the tags of runs this process currently has in flight unless
+    ``exclude_active=False``. A worker only counts as orphaned when the
+    launcher pid encoded in its tag is no longer alive — otherwise a
+    cleanup call in one job would shoot down a concurrent job's live
+    workers (``_ACTIVE_TAGS`` is per-process and cannot see them). Pass
+    ``require_dead_launcher=False`` to force-match live runs too. Returns
+    ``[]`` on platforms without ``/proc``."""
     excluded = set(_ACTIVE_TAGS) if exclude_active else set()
     if exclude_tag is not None:
         excluded.add(exclude_tag)
@@ -233,6 +246,10 @@ def find_tagged_workers(tag: Optional[str] = None,
             continue
         if tag is not None and t != tag:
             continue
+        if require_dead_launcher:
+            lp = _launcher_pid(t)
+            if lp is not None and lp != me and _alive(lp):
+                continue  # launcher still running: not an orphan
         pids.append(int(entry))
     return pids
 
@@ -240,16 +257,21 @@ def find_tagged_workers(tag: Optional[str] = None,
 def kill_orphan_workers(tag: Optional[str] = None,
                         exclude_tag: Optional[str] = None,
                         exclude_active: bool = True,
+                        require_dead_launcher: bool = True,
                         grace_s: float = 3.0) -> List[int]:
     """Terminate leftover tagged worker processes (SIGTERM, then SIGKILL
     after ``grace_s``). Returns the PIDs acted on. Runs launched by this
-    process that are still in flight are spared by default.
+    process that are still in flight are spared by default, and so are
+    workers whose launcher process (encoded in the tag) is still alive —
+    concurrent jobs in other processes are not orphans. Pass
+    ``require_dead_launcher=False`` to kill a live run by explicit tag.
 
     This is the reference's documented manual recovery (grep ps for
     orphaned spawn workers and kill them, ``README.md:121-125``) as a
     one-call API."""
     pids = find_tagged_workers(tag=tag, exclude_tag=exclude_tag,
-                               exclude_active=exclude_active)
+                               exclude_active=exclude_active,
+                               require_dead_launcher=require_dead_launcher)
     for pid in pids:
         try:
             os.kill(pid, signal.SIGTERM)

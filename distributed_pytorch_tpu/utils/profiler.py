@@ -77,12 +77,15 @@ def device_memory_stats(device=None) -> Dict[str, Any]:
 class StepTimer:
     """Wall-clock step timing with async-dispatch fencing.
 
-    Use either as a context manager per step::
+    Use either as a context manager per step — the yielded holder takes
+    the fence produced *inside* the block (``out`` does not exist yet on
+    the first iteration, so it cannot be passed as the ``fence=`` arg)::
 
         timer = StepTimer(warmup=2)
         for batch in loader:
-            with timer.step(fence=out.loss):   # fence forces completion
+            with timer.step() as h:
                 out = train_step(params, opt_state, batch)
+                h["fence"] = out.loss          # fence forces completion
 
     or functionally via :meth:`measure`. The first ``warmup`` steps
     (compile + cache warming) are recorded separately and excluded from
@@ -155,6 +158,32 @@ class StepTimer:
 # ---------------------------------------------------------------------------
 # static cost analysis
 # ---------------------------------------------------------------------------
+
+
+def compiled_memory(fn: Callable, *args,
+                    static_argnums=(), **kwargs) -> Dict[str, float]:
+    """XLA memory analysis for ``fn`` jitted on the example args, without
+    executing it: argument/output/temp/generated-code sizes in bytes.
+    ``temp_size_bytes`` is the compiler's buffer-allocation high water
+    mark for intermediates — the number that separates schedules with
+    O(T) activation footprints from O(S) ones (see parallel/pipeline.py).
+    Returns {} when the backend exposes no memory analysis."""
+    jitted = jax.jit(fn, static_argnums=static_argnums)
+    compiled = jitted.lower(*args, **kwargs).compile()
+    try:
+        m = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if m is None:
+        return {}
+    out = {}
+    for name in ("argument_size_in_bytes", "output_size_in_bytes",
+                 "temp_size_in_bytes", "alias_size_in_bytes",
+                 "generated_code_size_in_bytes"):
+        v = getattr(m, name, None)
+        if v is not None:
+            out[name.replace("_in_bytes", "_bytes")] = float(v)
+    return out
 
 
 def compiled_stats(fn: Callable, *args,

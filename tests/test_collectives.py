@@ -64,6 +64,15 @@ def test_all_gather(group8):
     assert out.shape == (8, 3)
 
 
+def test_all_gather_shape_mismatch_raises(group8):
+    """all_gather must validate the stacked layout at world>1 like gather
+    does — a silent passthrough would hand callers a wrongly-shaped array."""
+    with pytest.raises(ValueError):
+        dist.all_gather(jnp.zeros((5, 3)))  # leading axis != world
+    with pytest.raises(ValueError):
+        dist.all_gather(jnp.float32(1.0))   # scalar can't be stacked
+
+
 def test_barrier_runs(group8):
     dist.barrier()
     dist.wait_for_everyone()

@@ -57,6 +57,28 @@ def test_grads_match_dense(causal, s_q, s_k):
                                    err_msg=f"d{name} mismatch")
 
 
+@pytest.mark.parametrize("s_q,s_k,bq,bk", [
+    (64, 32, 16, 16),    # whole q-tiles above the diagonal (body skipped)
+    (40, 24, 16, 16),    # ragged + partially-masked tiles
+    (64, 16, 64, 16),    # fully-masked rows inside an executed tile
+])
+def test_causal_sq_gt_sk_nan_rows_match_dense(s_q, s_k, bq, bk):
+    """Causal with s_q > s_k: query rows above the shifted diagonal attend
+    to nothing. Dense softmax over an all--inf row is NaN; the kernel must
+    emit NaN for exactly those rows rather than a mean of masked-out v rows
+    (regression: the _finish guard used to handle only the never-executed
+    l==0 case)."""
+    q, k, v = _qkv(jax.random.PRNGKey(6), s_q=s_q, s_k=s_k)
+    want = np.asarray(dense_attention(q, k, v, causal=True))
+    got = np.asarray(flash_attention(q, k, v, causal=True,
+                                     block_q=bq, block_k=bk))
+    nan_rows = np.isnan(want).all(axis=-1)
+    assert nan_rows.any(), "case must exercise fully-masked rows"
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+    np.testing.assert_allclose(got[~nan_rows], want[~nan_rows],
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_bfloat16_close():
     q, k, v = _qkv(jax.random.PRNGKey(2), dtype=jnp.bfloat16)
     want = dense_attention(q, k, v, causal=True).astype(jnp.float32)

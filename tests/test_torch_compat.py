@@ -97,6 +97,30 @@ class TestReferenceWorkloadUnmodified:
         assert abs(agg[0] - sum(per_rank)) < 2e-3
 
 
+def test_all_reduce_invalid_op_message_matches_reference():
+    """The shim's invalid-op ValueError text is deliberately identical to
+    reference distributed.py:131 (error-message parity — callers matching
+    on the message see the same behavior). This test pins that rationale:
+    if the string drifts from the reference's, one of the two must change
+    knowingly."""
+    sys.path.insert(0, SHIM_DIR)
+    try:
+        import distributed as shim
+    finally:
+        sys.path.pop(0)
+    ref_line = '"{op}" is an invalid reduce operation!'
+    with open("/root/reference/distributed.py") as f:
+        assert ref_line in f.read()
+    orig = shim.get_world_size
+    shim.get_world_size = lambda: 2  # skip the world==1 short-circuit
+    try:
+        with pytest.raises(ValueError,
+                           match='"prod" is an invalid reduce operation!'):
+            shim.all_reduce(torch.zeros(3), op="prod")
+    finally:
+        shim.get_world_size = orig
+
+
 class TestShardedSampler:
     def test_padding_when_world_exceeds_dataset(self):
         """total > 2*len(dataset): every rank still gets num_samples

@@ -149,6 +149,38 @@ class TestOrphanCleanup:
                 p.kill()
             p.join()
 
+    def test_concurrent_jobs_live_workers_spared(self):
+        """A blanket cleanup from one process must not kill another job's
+        live workers: _ACTIVE_TAGS is per-process and cannot see them, so
+        orphan-ness is decided by the liveness of the launcher pid encoded
+        in the tag (regression: this used to kill any tagged process)."""
+        ctx = mp.get_context("spawn")
+        launcher = ctx.Process(target=_sleeper_tagged, args=(60,))
+        launcher.start()  # stands in for a concurrent job's live launcher
+        tag = f"{launcher.pid}-123456"  # the launch-tag format
+        worker = self._spawn_tagged(tag)
+        try:
+            # blanket cleanup: the worker's launcher is alive -> spared
+            killed = kill_orphan_workers()
+            assert worker.pid not in killed and worker.is_alive()
+            # even an explicit-tag kill respects liveness by default...
+            assert worker.pid not in kill_orphan_workers(tag=tag)
+            # ...unless forced
+            launcher.kill()
+            launcher.join()
+            # launcher dead -> now a genuine orphan, collected
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if worker.pid in find_tagged_workers(tag=tag):
+                    break
+                time.sleep(0.05)
+            assert worker.pid in kill_orphan_workers(tag=tag)
+        finally:
+            for p in (launcher, worker):
+                if p.is_alive():
+                    p.kill()
+                p.join()
+
     def test_active_launch_spared_by_default(self):
         tag = f"test-active-{os.getpid()}"
         p = self._spawn_tagged(tag)

@@ -325,6 +325,9 @@ def all_reduce(tensor, op="sum"):
         _COMM.allreduce(work)
         work /= world_size
     else:
+        # Error-message parity with reference distributed.py:131 —
+        # callers matching on the message see identical behavior (pinned
+        # by tests/test_torch_compat.py::test_all_reduce_invalid_op_message).
         raise ValueError(f'"{op}" is an invalid reduce operation!')
     with torch.no_grad():
         tensor.copy_(torch.from_numpy(work).to(tensor.dtype).view_as(tensor))
@@ -387,9 +390,9 @@ def barrier():
     _COMM.barrier()
 
 
-# wrapper with same functionality but better readability as barrier
 def wait_for_everyone():
-    """Reference distributed.py:181-182."""
+    """Readability alias for :func:`barrier` (reference
+    distributed.py:181-182)."""
     barrier()
 
 
