@@ -198,13 +198,19 @@ def bench_torch_cpu_mlp(n_steps: int = 500) -> float:
     return n_steps / (time.perf_counter() - t0)
 
 
-def bench_torch_cpu_lm(dim=768, n_layers=12, n_heads=12, vocab=32000,
-                       seq=1024, batch=2, n_steps=2) -> float:
+def bench_torch_cpu_lm(batch=2, n_steps=2) -> float:
     """tokens/s for the flagship LM config in eager torch CPU — the
-    vs_baseline denominator for the MFU headline."""
+    vs_baseline denominator for the MFU headline. The model config comes
+    from benchmarks.mfu_transformer.FLAGSHIP (single source of truth);
+    only batch is reduced — CPU throughput is ~flat in batch and a full
+    flagship batch takes minutes per step here."""
     import torch
     import torch.nn as nn
 
+    from benchmarks.mfu_transformer import FLAGSHIP
+    dim, n_layers, n_heads = (FLAGSHIP["dim"], FLAGSHIP["n_layers"],
+                              FLAGSHIP["n_heads"])
+    vocab, seq = FLAGSHIP["vocab"], FLAGSHIP["seq"]
     torch.manual_seed(0)
     layer = nn.TransformerEncoderLayer(
         dim, n_heads, 4 * dim, batch_first=True, norm_first=True,
@@ -295,6 +301,8 @@ def bench_dp8() -> dict:
         return {"error": (out.stderr or "no output").strip()[-500:]}
     except subprocess.TimeoutExpired:
         return {"error": "dp8 bench timed out"}
+    except json.JSONDecodeError as e:
+        return {"error": f"dp8 bench emitted unparseable output: {e}"}
 
 
 # ---------------------------------------------------------------------------

@@ -48,6 +48,13 @@ PEAK_BF16 = {
 # environment. Others are best-effort from cloud.google.com spec pages.
 
 
+# The flagship single-chip benchmark config (GPT-2-small class). bench.py
+# measures its torch-CPU baseline from THESE constants — change them here
+# and every consumer (run() defaults, the vs_baseline denominator) follows.
+FLAGSHIP = {"dim": 768, "n_layers": 12, "n_heads": 12, "vocab": 32000,
+            "seq": 1024, "batch": 8}
+
+
 def model_flops_per_token(dim: int, n_layers: int, vocab: int, seq: int,
                           mlp_ratio: int = 4, causal: bool = True) -> float:
     """Analytic matmul FLOPs per token, forward pass.
@@ -67,8 +74,9 @@ def count_params(params) -> int:
                for l in jax.tree_util.tree_leaves(params))
 
 
-def run(dim: int = 768, n_layers: int = 12, n_heads: int = 12,
-        vocab: int = 32000, seq: int = 1024, batch: int = 8,
+def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
+        n_heads: int = FLAGSHIP["n_heads"], vocab: int = FLAGSHIP["vocab"],
+        seq: int = FLAGSHIP["seq"], batch: int = FLAGSHIP["batch"],
         steps: int = 30, dtype=jnp.bfloat16,
         use_flash: bool = True, interpret: Optional[bool] = None) -> dict:
     from distributed_pytorch_tpu import models, optim

@@ -40,6 +40,7 @@ import math
 import os
 import socket
 import sys
+import threading
 from contextlib import closing
 
 import numpy as np
@@ -243,17 +244,33 @@ def data_sampler(dataset, distributed, shuffle):
 
 # model wrapping (reference distributed.py:112-115)
 class DistributedDataParallel(torch.nn.Module):
-    """Grad-hook DDP over the native host group.
+    """Grad-hook DDP over the native host group, with bucketed, overlapped
+    gradient synchronization.
 
     Reproduces the torch DDP contract the reference relies on
     (distributed.py:27,114 and SURVEY.md §2.3 row 4): parameters and
     buffers broadcast from rank 0 at construction; during ``backward``
-    each parameter's gradient is all-reduced and averaged across ranks as
-    it is produced, so ``optimizer.step()`` sees synchronized gradients
-    with no extra calls in the training loop (min_DDP.py:102-104).
+    gradients are all-reduced and averaged across ranks as they are
+    produced, so ``optimizer.step()`` sees synchronized gradients with no
+    extra calls in the training loop (min_DDP.py:102-104).
+
+    Like the torch reducer, parameters are grouped into size-capped flat
+    buckets in REVERSE registration order (the order autograd produces
+    gradients), one bucket never mixing dtypes (gradients reduce in their
+    native dtype — no silent downcast); each bucket's single ring
+    all-reduce is issued by a communication thread as soon as the bucket's
+    gradients are all accumulated, overlapping communication with the rest
+    of backward. Buckets are processed in a fixed order on every rank, so
+    the ring collectives can never interleave differently across ranks.
+    An autograd end-of-backward callback joins the thread, so
+    ``backward()`` returns with fully synchronized gradients — and, like
+    torch DDP without ``find_unused_parameters``, raises if some
+    requires_grad parameter produced no gradient (silently skipping its
+    bucket would let ranks diverge). ``bucket_cap_mb=0`` degrades to one
+    bucket per parameter (the unbucketed baseline, kept for measurement).
     """
 
-    def __init__(self, module, device_ids=None, **kwargs):
+    def __init__(self, module, device_ids=None, bucket_cap_mb=25, **kwargs):
         super().__init__()
         self.module = module
         self._world = get_world_size()
@@ -262,23 +279,110 @@ class DistributedDataParallel(torch.nn.Module):
             with torch.no_grad():
                 for t in list(module.parameters()) + list(module.buffers()):
                     _broadcast_inplace(t)
+            self._build_buckets(bucket_cap_mb)
+            self._lock = threading.Lock()
+            self._ready = [0] * len(self._buckets)
+            self._total_ready = 0
+            self._bucket_done = None
+            self._worker = None
+            self._worker_exc = None
+            self._abort = False
             self._hooks = [
-                p.register_post_accumulate_grad_hook(self._sync_grad)
-                for p in module.parameters() if p.requires_grad]
+                p.register_post_accumulate_grad_hook(self._on_grad)
+                for p in self.module.parameters() if p.requires_grad]
 
-    def _sync_grad(self, param):
-        g = param.grad
-        if g is None:
-            return
-        if g.device.type == "cpu":
-            arr = g.detach().numpy()  # shares memory on CPU
-            out = _COMM.allreduce(arr)
-            if out is not arr:  # comm had to copy (non-contiguous input)
-                g.copy_(torch.from_numpy(out))
-        else:  # accelerator grads stage through host, like torch's gloo path
-            work = _COMM.allreduce(_to_np(g))
-            g.copy_(torch.from_numpy(work).to(g.device))
-        g.div_(self._world)
+    def _build_buckets(self, cap_mb: float) -> None:
+        params = [p for p in self.module.parameters() if p.requires_grad]
+        cap = cap_mb * (1 << 20)
+        self._buckets, cur, size = [], [], 0
+        for p in reversed(params):  # autograd's gradient-ready order
+            nbytes = p.numel() * p.element_size()
+            if cur and (size + nbytes > cap or p.dtype != cur[-1].dtype):
+                self._buckets.append(cur)
+                cur, size = [], 0
+            cur.append(p)
+            size += nbytes
+        if cur:
+            self._buckets.append(cur)
+        self._param_bucket = {id(p): bi
+                              for bi, b in enumerate(self._buckets)
+                              for p in b}
+        self._n_params = len(params)
+
+    def _reduce_bucket(self, bucket) -> None:
+        grads = [p.grad for p in bucket]
+        flat = np.concatenate([_to_np(g).ravel() for g in grads])
+        out = _COMM.allreduce(flat)
+        if out is not flat:
+            flat = out
+        flat /= self._world
+        off = 0
+        with torch.no_grad():
+            for g in grads:
+                n = g.numel()
+                g.copy_(torch.from_numpy(
+                    flat[off:off + n].reshape(tuple(g.shape))).to(
+                        device=g.device, dtype=g.dtype))
+                off += n
+
+    def _worker_main(self, done_events) -> None:
+        try:
+            for bi, ev in enumerate(done_events):
+                ev.wait()
+                if self._abort:
+                    return
+                self._reduce_bucket(self._buckets[bi])
+        except Exception as e:  # noqa: BLE001 — re-raised at finalize
+            self._worker_exc = e
+
+    def _on_grad(self, param) -> None:
+        with self._lock:
+            if self._worker is None:  # first gradient of this backward
+                self._bucket_done = [threading.Event()
+                                     for _ in self._buckets]
+                self._worker_exc = None
+                self._abort = False
+                self._worker = threading.Thread(
+                    target=self._worker_main, args=(self._bucket_done,),
+                    daemon=True)
+                self._worker.start()
+                # runs on the autograd engine once this backward pass
+                # completes, whether or not every hook fired
+                torch.autograd.Variable._execution_engine.queue_callback(
+                    self._finalize_backward)
+            bi = self._param_bucket[id(param)]
+            self._ready[bi] += 1
+            if self._ready[bi] == len(self._buckets[bi]):
+                self._bucket_done[bi].set()
+            self._total_ready += 1
+
+    def _finalize_backward(self) -> None:
+        """End-of-backward: join the comm thread so grads are synchronized
+        when ``backward()`` returns; detect incomplete backwards (a
+        requires_grad parameter that produced no gradient) instead of
+        wedging on the missing bucket."""
+        with self._lock:
+            worker, events = self._worker, self._bucket_done
+            if worker is None:
+                return
+            incomplete = self._total_ready != self._n_params
+            if incomplete:
+                self._abort = True
+                for ev in events:
+                    ev.set()  # unblock the worker so it can exit
+            self._worker = None
+            self._ready = [0] * len(self._buckets)
+            self._total_ready = 0
+        worker.join()
+        if self._worker_exc is not None:
+            raise self._worker_exc
+        if incomplete:
+            raise RuntimeError(
+                "DistributedDataParallel: some requires_grad parameters "
+                "received no gradient in this backward pass; gradient "
+                "buckets were left unsynchronized (torch DDP raises here "
+                "too unless find_unused_parameters is used — exclude the "
+                "unused parameters or set requires_grad=False)")
 
     def forward(self, *args, **kwargs):
         # torch DDP re-broadcasts buffers (e.g. BatchNorm running stats)
