@@ -20,14 +20,17 @@ class MoEBlock(Module):
 
     def __init__(self, dim: int, n_heads: int, n_experts: int,
                  mlp_ratio: int = 4, *, causal: bool = True,
-                 capacity_factor: float = 2.0,
+                 capacity_factor: float = 2.0, top_k: int = 1,
+                 router_z_coef: float = 0.1,
                  attn_fn: Optional[Callable] = None, dtype=jnp.float32):
         self.ln1 = LayerNorm(dim, dtype=dtype)
         self.attn = MultiHeadAttention(dim, n_heads, causal=causal,
                                        attn_fn=attn_fn, dtype=dtype)
         self.ln2 = LayerNorm(dim, dtype=dtype)
+        self.router_z_coef = router_z_coef
         self.moe = MoELayer(dim, n_experts, mlp_ratio,
-                            capacity_factor=capacity_factor, dtype=dtype)
+                            capacity_factor=capacity_factor, top_k=top_k,
+                            dtype=dtype)
 
     def init(self, key) -> Params:
         ks = jax.random.split(key, 3)
@@ -37,9 +40,13 @@ class MoEBlock(Module):
     def apply(self, params: Params, x, **_):
         x = x + self.attn.apply(params["attn"],
                                 self.ln1.apply(params["ln1"], x))
-        h, aux = self.moe.apply(params["moe"],
-                                self.ln2.apply(params["ln2"], x))
-        return x + h, aux
+        h, m = self.moe.apply_with_metrics(params["moe"],
+                                           self.ln2.apply(params["ln2"], x))
+        # trainable aux = load-balancing loss + router z-loss, with
+        # router_z_coef weighting z RELATIVE to the load loss (callers
+        # scale the combined aux into their loss — e.g. loss + 0.01*aux
+        # with the 0.1 default lands on ST-MoE's 0.01*load + 0.001*z)
+        return x + h, m["aux_loss"] + self.router_z_coef * m["z_loss"]
 
 
 class MoETransformerLM(Module):
@@ -48,6 +55,7 @@ class MoETransformerLM(Module):
     def __init__(self, vocab: int = 256, dim: int = 128, n_layers: int = 2,
                  n_heads: int = 4, n_experts: int = 4, max_seq: int = 512,
                  mlp_ratio: int = 4, capacity_factor: float = 2.0,
+                 top_k: int = 1, router_z_coef: float = 0.1,
                  attn_fn: Optional[Callable] = None, dtype=jnp.float32):
         self.vocab = vocab
         self.dim = dim
@@ -57,7 +65,8 @@ class MoETransformerLM(Module):
         self.pos = Embedding(max_seq, dim, dtype=dtype)
         self.blocks = [
             MoEBlock(dim, n_heads, n_experts, mlp_ratio,
-                     capacity_factor=capacity_factor, attn_fn=attn_fn,
+                     capacity_factor=capacity_factor, top_k=top_k,
+                     router_z_coef=router_z_coef, attn_fn=attn_fn,
                      dtype=dtype)
             for _ in range(n_layers)
         ]

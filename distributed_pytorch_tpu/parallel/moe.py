@@ -8,16 +8,29 @@ whole layer is three einsums XLA maps onto the MXU. Expert weights carry a
 the all-to-all over the ``ep`` mesh axis (the same program a hand-written
 MPI alltoall would compute, derived from layout instead of code).
 
-Top-1 (Switch) routing with capacity factor; overflow tokens are dropped
-(contribute zero — the transformer's residual path carries them), the
-standard Switch behavior. The load-balancing auxiliary loss (Switch
-Transformer eq. 4: E * sum_e f_e * P_e) is returned for the trainer to add.
+Routing is top-k (``top_k=1`` = Switch, ``top_k=2`` = GShard): each token
+is dispatched to its k highest-probability experts, first choices queueing
+ahead of second choices for the fixed per-expert capacity; overflow tokens
+are dropped (contribute zero — the transformer's residual path carries
+them). Gate values are renormalized over the selected experts when k > 1.
+Losses/diagnostics returned by :meth:`MoELayer.apply_with_metrics`:
+
+- ``aux_loss`` — Switch load-balancing loss (Switch Transformer eq. 4:
+  E * sum_e f_e * P_e over first-choice assignments),
+- ``z_loss`` — router z-loss (ST-MoE: mean logsumexp(logits)^2), which
+  keeps router logits small and training stable; callers weight it
+  (~1e-3) into the loss,
+- ``drop_rate`` — fraction of (token, choice) dispatches dropped for
+  capacity,
+- ``expert_load`` — (E,) share of the KEPT dispatches handled by each
+  expert (sums to 1 whenever anything was kept; dropped slots are
+  accounted in ``drop_rate``, not here).
 """
 
 from __future__ import annotations
 
 import math
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,11 +43,16 @@ class MoELayer(Module):
     """Token-routed expert FFN bank: x (..., D) -> (y (..., D), aux_loss)."""
 
     def __init__(self, dim: int, n_experts: int, mlp_ratio: int = 4,
-                 capacity_factor: float = 1.25, dtype=jnp.float32):
+                 capacity_factor: float = 1.25, top_k: int = 1,
+                 normalize_gates: bool = True, dtype=jnp.float32):
+        if not 1 <= top_k <= n_experts:
+            raise ValueError(f"top_k={top_k} not in [1, {n_experts}]")
         self.dim = dim
         self.n_experts = n_experts
         self.hidden = mlp_ratio * dim
         self.capacity_factor = capacity_factor
+        self.top_k = top_k
+        self.normalize_gates = normalize_gates
         self.dtype = dtype
 
     def init(self, key) -> Params:
@@ -53,40 +71,65 @@ class MoELayer(Module):
                     "b": jnp.zeros((e, d), self.dtype)},
         }
 
-    def apply(self, params: Params, x, **_) -> Tuple[Any, Any]:
+    def apply_with_metrics(self, params: Params, x,
+                           **_) -> Tuple[Any, Dict[str, Any]]:
         orig_shape = x.shape
         n = math.prod(orig_shape[:-1])
         xt = x.reshape(n, self.dim)
-        e = self.n_experts
-        cap = max(int(self.capacity_factor * n / e), 1)
+        e, k = self.n_experts, self.top_k
+        cap = max(int(self.capacity_factor * n * k / e), 1)
 
-        logits = xt @ params["gate"]["w"]                     # (N, E)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        expert = jnp.argmax(probs, axis=-1)                   # (N,)
-        gate_val = jnp.max(probs, axis=-1)                    # (N,)
+        logits = (xt @ params["gate"]["w"]).astype(jnp.float32)  # (N, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_p, top_i = jax.lax.top_k(probs, k)                   # (N, K)
+        gates = top_p
+        if k > 1 and self.normalize_gates:
+            gates = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
 
-        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (N, E)
-        # position of each token within its expert's queue
-        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0        # (N, E)
+        onehot = jax.nn.one_hot(top_i, e, dtype=jnp.float32)     # (N, K, E)
+        # Per-expert queue positions with first choices ahead of second
+        # choices (GShard priority): cumsum over the choice-major flat
+        # order. k=1 reduces exactly to the Switch cumsum over tokens.
+        flat = onehot.transpose(1, 0, 2).reshape(k * n, e)
+        pos_flat = jnp.cumsum(flat, axis=0) * flat - 1.0
+        pos = pos_flat.reshape(k, n, e).transpose(1, 0, 2)       # (N, K, E)
         keep = (pos >= 0) & (pos < cap)
-        dispatch = jax.nn.one_hot(pos.astype(jnp.int32), cap,
-                                  dtype=jnp.float32) * keep[..., None]
-        # dispatch: (N, E, C) one-hot; combine adds the gate weight
-        combine = dispatch * gate_val[:, None, None]
+        # one_hot of -1 / >=cap is all-zero, so `keep` is belt-and-braces
+        disp_k = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                                dtype=jnp.float32) * keep[..., None]
+        dispatch = disp_k.sum(axis=1)                            # (N, E, C)
+        combine = jnp.einsum("nkec,nk->nec", disp_k, gates)      # (N, E, C)
 
         expert_in = jnp.einsum("nec,nd->ecd", dispatch,
-                               xt.astype(jnp.float32))          # (E, C, D)
+                               xt.astype(jnp.float32))           # (E, C, D)
         h = gelu(jnp.einsum("ecd,edh->ech", expert_in, params["fc1"]["w"])
                  + params["fc1"]["b"][:, None, :])
         expert_out = (jnp.einsum("ech,ehd->ecd", h, params["fc2"]["w"])
                       + params["fc2"]["b"][:, None, :])          # (E, C, D)
         y = jnp.einsum("nec,ecd->nd", combine, expert_out)
 
-        # Switch aux loss: E * sum_e (fraction routed to e) * (mean prob e)
-        frac = onehot.mean(axis=0)
+        # Switch aux loss over FIRST-choice assignments (eq. 4)
+        frac = onehot[:, 0, :].mean(axis=0)
         mean_prob = probs.mean(axis=0)
         aux = e * jnp.sum(frac * mean_prob)
-        return y.reshape(orig_shape).astype(x.dtype), aux
+        # ST-MoE router z-loss: penalize large router logits
+        z_loss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+        kept = disp_k.sum(axis=(2, 3))                           # (N, K)
+        per_expert = dispatch.sum(axis=(0, 2))                   # (E,)
+        metrics = {
+            "aux_loss": aux,
+            "z_loss": z_loss,
+            "drop_rate": 1.0 - kept.mean(),
+            "expert_load": per_expert / jnp.maximum(per_expert.sum(), 1.0),
+        }
+        return y.reshape(orig_shape).astype(x.dtype), metrics
+
+    def apply(self, params: Params, x, **kw) -> Tuple[Any, Any]:
+        """Back-compat contract: ``(y, aux_loss)`` with aux the Switch
+        load-balancing loss (z-loss and drop diagnostics via
+        :meth:`apply_with_metrics`)."""
+        y, m = self.apply_with_metrics(params, x, **kw)
+        return y, m["aux_loss"]
 
 
 def moe_param_specs(ep_axis: str = "ep", tp_axis: Optional[str] = None):

@@ -305,3 +305,141 @@ class Test1F1BTraining:
             assert m1["temp_size_bytes"] < m2["temp_size_bytes"], (m1, m2)
         finally:
             dist.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# top-k routing
+# ---------------------------------------------------------------------------
+
+
+def _expert_ffn(params, e, xi):
+    h = np.asarray(xi) @ np.asarray(params["fc1"]["w"][e]) + \
+        np.asarray(params["fc1"]["b"][e])
+    h = np.asarray(jax.nn.gelu(jnp.asarray(h)))
+    return h @ np.asarray(params["fc2"]["w"][e]) + \
+        np.asarray(params["fc2"]["b"][e])
+
+
+def test_moe_top2_matches_dense_mixture():
+    """top_k=2 with ample capacity == for each token the renormalized
+    gate-weighted sum of its two best experts' FFN outputs."""
+    layer = MoELayer(dim=8, n_experts=4, mlp_ratio=2, capacity_factor=4.0,
+                     top_k=2)
+    params = layer.init(jax.random.PRNGKey(1))
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+    y, m = layer.apply_with_metrics(params, x)
+    assert float(m["drop_rate"]) == 0.0
+
+    logits = np.asarray(x @ params["gate"]["w"])
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    want = np.zeros_like(np.asarray(x))
+    for i in range(16):
+        top2 = np.argsort(probs[i])[::-1][:2]
+        g = probs[i, top2] / probs[i, top2].sum()
+        for gw, e in zip(g, top2):
+            want[i] += gw * _expert_ffn(params, int(e), x[i])
+    np.testing.assert_allclose(np.asarray(y), want, rtol=2e-3, atol=2e-4)
+
+
+def test_moe_top1_scarce_capacity_matches_switch_reference():
+    """top_k=1 under SCARCE capacity must reproduce Switch routing against
+    an independent numpy reference (token-order queue per expert, overflow
+    dropped) — the choice-major cumsum must degenerate exactly to the
+    token cumsum."""
+    layer = MoELayer(dim=8, n_experts=4, mlp_ratio=2, capacity_factor=0.5)
+    params = layer.init(jax.random.PRNGKey(2))
+    rng = np.random.default_rng(2)
+    n = 32
+    x = jnp.asarray(rng.standard_normal((n, 8)), jnp.float32)
+    y, m = layer.apply_with_metrics(params, x)
+
+    cap = max(int(0.5 * n / 4), 1)
+    logits = np.asarray(x @ params["gate"]["w"])
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    want = np.zeros((n, 8), np.float32)
+    counts = [0] * 4
+    kept = 0
+    for i in range(n):
+        e = int(np.argmax(probs[i]))
+        if counts[e] < cap:
+            counts[e] += 1
+            kept += 1
+            want[i] = probs[i, e] * _expert_ffn(params, e, x[i])
+    np.testing.assert_allclose(np.asarray(y), want, rtol=2e-3, atol=2e-4)
+    assert float(m["drop_rate"]) == pytest.approx(1 - kept / n)
+
+
+def test_moe_first_choices_have_capacity_priority():
+    """Under scarcity a token's FIRST choice beats another token's second
+    choice for the slot, even when the second-chooser comes earlier in
+    token order: t0 = (E1 first, E0 second), t1 = (E0 first), cap 1 per
+    expert -> E0's slot must go to t1, and t0 keeps only its E1 output."""
+    layer = MoELayer(dim=2, n_experts=2, mlp_ratio=2, capacity_factor=0.5,
+                     top_k=2)
+    params = layer.init(jax.random.PRNGKey(3))
+    params["gate"]["w"] = jnp.asarray([[4.0, 0.0], [0.0, 4.0]])
+    t0, t1 = [1.0, 2.0], [2.0, 1.0]   # argmax experts: t0->E1, t1->E0
+    x = jnp.asarray([t0, t1], jnp.float32)
+    # n=2, k=2, e=2, cf=0.5 -> cap = 1 slot per expert for 4 dispatches
+    y, m = layer.apply_with_metrics(params, x)
+    assert float(m["drop_rate"]) == pytest.approx(0.5)
+    np.testing.assert_allclose(np.asarray(m["expert_load"]), [0.5, 0.5])
+
+    logits = np.asarray(x @ params["gate"]["w"])
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    # renormalized over the two selected experts = original probs (e=2)
+    want0 = probs[0, 1] * _expert_ffn(params, 1, x[0])  # first choice kept
+    want1 = probs[1, 0] * _expert_ffn(params, 0, x[1])  # first choice kept
+    # inverted priority would instead give t0 both slots and t1 nothing
+    np.testing.assert_allclose(np.asarray(y[0]), want0, rtol=2e-3,
+                               atol=2e-4)
+    np.testing.assert_allclose(np.asarray(y[1]), want1, rtol=2e-3,
+                               atol=2e-4)
+
+
+def test_moe_z_loss_and_drop_metrics():
+    layer = MoELayer(dim=4, n_experts=2, capacity_factor=0.125)  # cap=1
+    params = layer.init(jax.random.PRNGKey(0))
+    x = jnp.tile(jnp.asarray([[1.0, 2.0, 3.0, 4.0]]), (16, 1))
+    _, m = layer.apply_with_metrics(params, x)
+    assert float(m["z_loss"]) > 0
+    # 16 identical tokens, one expert, cap 1 -> 15/16 dropped; the single
+    # kept dispatch is 100% of the KEPT load on that expert
+    assert float(m["drop_rate"]) == pytest.approx(15 / 16)
+    np.testing.assert_allclose(np.asarray(m["expert_load"]).sum(), 1.0)
+
+
+def test_moe_lm_top2_trains():
+    """MoETransformerLM with top_k=2 + z-loss trains under the ep mesh."""
+    mesh = context.init_mesh(dp=2, tp=2, ep=2)
+    try:
+        model = models.MoETransformerLM(vocab=32, dim=16, n_layers=2,
+                                        n_heads=2, n_experts=2, max_seq=8,
+                                        capacity_factor=4.0, top_k=2)
+        params = shard_params(model.init(jax.random.PRNGKey(0)),
+                              model.param_specs(), mesh)
+        opt = optim.adamw(1e-2)
+        opt_state = opt.init(params)
+
+        def loss_fn(p, batch):
+            x, y = batch
+            logits, aux = model.apply(p, x)
+            return cross_entropy_per_example(logits, y).mean() + 0.01 * aux, {}
+
+        step = make_spmd_train_step(loss_fn, opt, donate=False)
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, 32, (8, 8)).astype(np.int32)
+        batch = shard_batch_spec((toks, toks), mesh, P("dp", None))
+        losses = []
+        out = step(params, opt_state, batch)
+        losses.append(float(out.loss))
+        for _ in range(6):
+            out = step(out.params, out.opt_state, batch)
+            losses.append(float(out.loss))
+        assert losses[-1] < losses[0]
+    finally:
+        dist.cleanup()
