@@ -37,7 +37,8 @@ class MoEBlock(Module):
         return {"ln1": self.ln1.init(ks[0]), "attn": self.attn.init(ks[1]),
                 "ln2": self.ln2.init(ks[2]), "moe": self.moe.init(ks[2])}
 
-    def apply(self, params: Params, x, **_):
+    def apply_with_metrics(self, params: Params, x, **_):
+        """(y, router metrics dict incl. the combined trainable ``aux``)."""
         x = x + self.attn.apply(params["attn"],
                                 self.ln1.apply(params["ln1"], x))
         h, m = self.moe.apply_with_metrics(params["moe"],
@@ -46,7 +47,12 @@ class MoEBlock(Module):
         # router_z_coef weighting z RELATIVE to the load loss (callers
         # scale the combined aux into their loss — e.g. loss + 0.01*aux
         # with the 0.1 default lands on ST-MoE's 0.01*load + 0.001*z)
-        return x + h, m["aux_loss"] + self.router_z_coef * m["z_loss"]
+        m = dict(m, aux=m["aux_loss"] + self.router_z_coef * m["z_loss"])
+        return x + h, m
+
+    def apply(self, params: Params, x, **kw):
+        y, m = self.apply_with_metrics(params, x, **kw)
+        return y, m["aux"]
 
 
 class MoETransformerLM(Module):
@@ -83,16 +89,28 @@ class MoETransformerLM(Module):
             "head": self.head.init(ks[-1]),
         }
 
-    def apply(self, params: Params, tokens, *, pos_offset=0, **_):
+    def apply_with_metrics(self, params: Params, tokens, *, pos_offset=0,
+                           **_):
+        """(logits, aux_loss, metrics): metrics averages the per-layer
+        router diagnostics (``drop_rate``, ``z_loss``, ``aux_loss``,
+        ``expert_load``) so capacity_factor/top_k can be tuned from the
+        training loop without bypassing the model API."""
         b, s = tokens.shape
         x = self.tok.apply(params["tok"], tokens)
         x = x + self.pos.apply(params["pos"], pos_offset + jnp.arange(s))
-        aux_total = 0.0
+        per_layer = []
         for i, blk in enumerate(self.blocks):
-            x, aux = blk.apply(params["blocks"][i], x)
-            aux_total = aux_total + aux
+            x, m = blk.apply_with_metrics(params["blocks"][i], x)
+            per_layer.append(m)
         x = self.ln_f.apply(params["ln_f"], x)
-        return self.head.apply(params["head"], x), aux_total / self.n_layers
+        metrics = {k: sum(m[k] for m in per_layer) / self.n_layers
+                   for k in per_layer[0]}
+        return (self.head.apply(params["head"], x), metrics.pop("aux"),
+                metrics)
+
+    def apply(self, params: Params, tokens, **kw):
+        logits, aux, _ = self.apply_with_metrics(params, tokens, **kw)
+        return logits, aux
 
     def param_specs(self, ep_axis: str = "ep", tp_axis: str = "tp"):
         """PartitionSpec tree: attention tensor-parallel over ``tp``,

@@ -1,0 +1,191 @@
+"""Cross-front-door contract: the same collective operations produce the
+SAME primary-side results under all three front doors —
+
+1. **SPMD** (single controller, stacked arrays over the dp mesh axis,
+   ``distributed_pytorch_tpu.api``),
+2. **host** (one OS process per rank over the native TCP group,
+   ``runtime.launch_multiprocess`` + the same api), and
+3. **torch** (the ``torch_compat/distributed`` shim over the same native
+   transport, torch tensors).
+
+One canonical pure-numpy expectation (:func:`canonical`) parameterized by
+world size is the oracle; each door's run must match it exactly. This is
+the operational form of the reference's semantics table (SURVEY.md §2.1
+#12-17): sum and avg all-reduce, rooted reduce, rooted gather, broadcast
+from a nonzero src, and the invalid-op ValueError. Non-primary-side
+quirks (gather's zeros, reduce's untouched buffers) are pinned separately
+per door in tests/test_collectives.py, tests/test_host_backend.py, and
+tests/test_torch_compat.py — this file is about the values every door
+must AGREE on.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import distributed_pytorch_tpu as dist
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SHIM_DIR = os.path.join(REPO, "torch_compat")
+
+
+def rank_tensor(rank: int):
+    """The deterministic per-rank payload every door uses."""
+    return (rank + 1.0) * np.asarray([1.0, 2.0, 3.0], np.float32)
+
+
+def canonical(world: int) -> dict:
+    """What the API must observably return on the PRIMARY, any door."""
+    stack = np.stack([rank_tensor(r) for r in range(world)])
+    return {
+        "all_reduce_sum": stack.sum(axis=0).tolist(),
+        "all_reduce_avg": (stack.sum(axis=0) / world).tolist(),
+        "reduce_root": stack.sum(axis=0).tolist(),
+        "gather": stack.tolist(),
+        "broadcast_src1": stack[min(1, world - 1)].tolist(),
+        "invalid_op_raises": True,
+    }
+
+
+def _observe_spmd(world: int) -> dict:
+    """SPMD door: stacked (world, ...) arrays on the virtual mesh."""
+    import jax.numpy as jnp
+
+    stack = jnp.asarray(np.stack([rank_tensor(r) for r in range(world)]))
+    out = {
+        "all_reduce_sum": np.asarray(dist.all_reduce(stack, "sum"))[0]
+        .tolist(),
+        "all_reduce_avg": np.asarray(dist.all_reduce(stack, "avg"))[0]
+        .tolist(),
+        "reduce_root": np.asarray(dist.reduce(stack, "sum")).tolist(),
+        "gather": [np.asarray(g).tolist() for g in dist.gather(stack)],
+        "broadcast_src1": np.asarray(dist.broadcast(stack, src=1))[0]
+        .tolist(),
+    }
+    dist.barrier()
+    dist.wait_for_everyone()
+    try:
+        dist.all_reduce(stack, "prod")
+        out["invalid_op_raises"] = False
+    except ValueError:
+        out["invalid_op_raises"] = True
+    return out
+
+
+def _host_worker(rank, world, out_path):
+    """Host door: per-rank process, own tensor, native TCP collectives."""
+    import numpy as np
+
+    import distributed_pytorch_tpu as dist
+    from tests.test_front_door_contract import rank_tensor
+
+    dist.init_process_group(rank, world)
+    x = rank_tensor(rank)
+    out = {
+        "all_reduce_sum": np.asarray(dist.all_reduce(x.copy(), "sum"))
+        .tolist(),
+        "all_reduce_avg": np.asarray(dist.all_reduce(x.copy(), "avg"))
+        .tolist(),
+        "reduce_root": np.asarray(dist.reduce(x.copy(), "sum")).tolist(),
+        "gather": [np.asarray(g).tolist() for g in dist.gather(x.copy())],
+        "broadcast_src1": np.asarray(
+            dist.broadcast(x.copy(), src=1)).tolist(),
+    }
+    dist.barrier()
+    dist.wait_for_everyone()
+    try:
+        dist.all_reduce(x.copy(), "prod")
+        out["invalid_op_raises"] = False
+    except ValueError:
+        out["invalid_op_raises"] = True
+    if dist.is_primary():
+        with open(out_path, "w") as f:
+            json.dump(out, f)
+    dist.cleanup()
+
+
+_TORCH_WORKER = r"""
+import json, sys
+import numpy as np
+import torch
+import distributed as dist  # the shim, via PYTHONPATH
+
+rank, world, port, out_path = (int(sys.argv[1]), int(sys.argv[2]),
+                               sys.argv[3], sys.argv[4])
+import os
+os.environ["MASTER_ADDR"] = "localhost"
+os.environ["MASTER_PORT"] = port
+dist.init_process_group(rank, world)
+x0 = (rank + 1.0) * torch.tensor([1.0, 2.0, 3.0])
+out = {}
+out["all_reduce_sum"] = dist.all_reduce(x0.clone(), "sum").tolist()
+out["all_reduce_avg"] = dist.all_reduce(x0.clone(), "avg").tolist()
+out["reduce_root"] = dist.reduce(x0.clone(), "sum").tolist()
+out["gather"] = [g.tolist() for g in dist.gather(x0.clone())]
+b = dist.sync_params([x0.clone()])  # broadcast is from rank 0 in the shim
+dist.barrier()
+dist.wait_for_everyone()
+try:
+    dist.all_reduce(x0.clone(), "prod")
+    out["invalid_op_raises"] = False
+except ValueError:
+    out["invalid_op_raises"] = True
+if dist.is_primary():
+    with open(out_path, "w") as f:
+        json.dump(out, f)
+dist.cleanup()
+"""
+
+
+class TestFrontDoorContract:
+    def test_spmd_door_matches_canonical(self, group8):
+        assert _observe_spmd(8) == canonical(8)
+
+    def test_host_door_matches_canonical(self, tmp_path):
+        from distributed_pytorch_tpu.runtime import launch_multiprocess
+
+        out_path = str(tmp_path / "host.json")
+        launch_multiprocess(_host_worker, 2, out_path)
+        with open(out_path) as f:
+            got = json.load(f)
+        assert got == canonical(2)
+
+    def test_torch_door_matches_canonical(self, tmp_path):
+        from distributed_pytorch_tpu.runtime.launcher import find_free_port
+
+        out_path = str(tmp_path / "torch.json")
+        port = str(find_free_port())
+        env = dict(os.environ, PYTHONPATH=SHIM_DIR)
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _TORCH_WORKER, str(r), "2", port,
+             out_path],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for r in range(2)]
+        outs = [p.communicate(timeout=120)[0] for p in procs]
+        assert all(p.returncode == 0 for p in procs), "\n".join(outs)
+        with open(out_path) as f:
+            got = json.load(f)
+        want = canonical(2)
+        # the shim has no standalone broadcast-with-src (the reference
+        # exposes only sync_params' broadcast-from-0); drop that key
+        want.pop("broadcast_src1")
+        assert got == want
+
+    def test_three_doors_agree(self, tmp_path, group8):
+        """The actual cross-door assertion: primary-side observables from
+        all three doors reduce to the same canonical table (worlds differ
+        — 8 for SPMD, 2 for the process doors — so agreement is via the
+        shared oracle, which is exact for every world)."""
+        spmd = _observe_spmd(8)
+        assert spmd == canonical(8)
+        # host and torch doors are exercised (and compared to the same
+        # oracle) in the two tests above; this test documents the triple
+        # and guards the oracle itself
+        c2 = canonical(2)
+        assert c2["all_reduce_sum"] == [3.0, 6.0, 9.0]
+        assert c2["reduce_root"] == c2["all_reduce_sum"]
+        assert np.allclose(c2["broadcast_src1"], rank_tensor(1))

@@ -443,3 +443,21 @@ def test_moe_lm_top2_trains():
         assert losses[-1] < losses[0]
     finally:
         dist.cleanup()
+
+
+def test_moe_lm_exposes_router_metrics():
+    """The model API surfaces layer-averaged router diagnostics so
+    capacity_factor/top_k can be tuned from the training loop."""
+    model = models.MoETransformerLM(vocab=16, dim=8, n_layers=2, n_heads=2,
+                                    n_experts=2, max_seq=8, top_k=2,
+                                    capacity_factor=4.0)
+    params = model.init(jax.random.PRNGKey(0))
+    toks = jnp.asarray(np.arange(16).reshape(2, 8) % 16, jnp.int32)
+    logits, aux, m = model.apply_with_metrics(params, toks)
+    assert logits.shape == (2, 8, 16)
+    assert set(m) == {"aux_loss", "z_loss", "drop_rate", "expert_load"}
+    assert float(m["drop_rate"]) >= 0
+    # back-compat two-tuple keeps the combined aux
+    logits2, aux2 = model.apply(params, toks)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(logits2))
+    assert float(aux) == pytest.approx(float(aux2))
