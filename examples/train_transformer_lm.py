@@ -2,10 +2,10 @@
 ladder (BASELINE.md: "nn.TransformerEncoder LM on WikiText-2", built here
 as a decoder-only causal LM).
 
-Zero-egress data policy: trains on a local text file byte-tokenized
-(``--text /path/to/corpus``; any plain-text corpus, e.g. a WikiText dump
-already on disk) or, by default, the seeded synthetic LM dataset — same
-model/step code either way.
+Zero-egress data policy: trains on a local text corpus byte-tokenized
+(``--text /path/to/corpus`` — a file, or a directory like the Python
+stdlib source tree whose text files are concatenated) or, by default, the
+seeded synthetic LM dataset — same model/step code either way.
 
 Showcases the TPU-native fast paths on top of the reference-parity API:
   --flash      pallas flash-attention core instead of the dense einsum
@@ -54,8 +54,11 @@ def parse_args(argv=None):
     p.add_argument("--n-heads", default=8, type=int)
     p.add_argument("--lr", default=3e-4, type=float)
     p.add_argument("--text", default=None, type=str,
-                   help="Local text file to byte-tokenize (vocab=256); "
-                        "default: seeded synthetic tokens.")
+                   help="Local text file OR directory to byte-tokenize "
+                        "(vocab=256; a directory concatenates its "
+                        ".py/.md/.txt/.rst files — e.g. the Python "
+                        "stdlib source tree); default: seeded "
+                        "synthetic tokens.")
     p.add_argument("--data-size", default=512, type=int,
                    help="Number of synthetic samples when --text is unset.")
     p.add_argument("--flash", action="store_true",
@@ -96,11 +99,40 @@ class Subset:
 
 
 class ByteCorpus:
-    """Byte-level LM windows over a local text file: sample i is
-    (bytes[i*S:(i+1)*S], shifted-by-one targets)."""
+    """Byte-level LM windows over a local text corpus: sample i is
+    (bytes[i*S:(i+1)*S], shifted-by-one targets).
 
-    def __init__(self, path: str, seq_len: int):
-        raw = np.fromfile(path, dtype=np.uint8)
+    ``path`` may be a file, or a directory whose ``.py/.md/.txt/.rst``
+    files (sorted, recursive) are concatenated — e.g. the Python stdlib
+    source tree, the only sizeable real text corpus in a zero-egress
+    environment."""
+
+    _EXTS = (".py", ".md", ".txt", ".rst")
+
+    def __init__(self, path: str, seq_len: int, max_bytes: int = 1 << 26):
+        if os.path.isdir(path):
+            chunks, total = [], 0
+            for root, dirs, files in os.walk(path):
+                if total >= max_bytes:
+                    break
+                dirs.sort()
+                for f in sorted(files):
+                    if total >= max_bytes:
+                        break
+                    if f.endswith(self._EXTS):
+                        try:
+                            chunk = np.fromfile(os.path.join(root, f),
+                                                dtype=np.uint8,
+                                                count=max_bytes - total)
+                        except OSError:
+                            continue
+                        chunks.append(chunk)
+                        total += len(chunk)
+            if not chunks:
+                raise ValueError(f"{path}: no text files found")
+            raw = np.concatenate(chunks)
+        else:
+            raw = np.fromfile(path, dtype=np.uint8)
         n = (len(raw) - 1) // seq_len
         if n < 1:
             raise ValueError(f"{path}: need > {seq_len + 1} bytes")

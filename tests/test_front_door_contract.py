@@ -175,16 +175,11 @@ class TestFrontDoorContract:
         want.pop("broadcast_src1")
         assert got == want
 
-    def test_three_doors_agree(self, tmp_path, group8):
-        """The actual cross-door assertion: primary-side observables from
-        all three doors reduce to the same canonical table (worlds differ
-        — 8 for SPMD, 2 for the process doors — so agreement is via the
-        shared oracle, which is exact for every world)."""
-        spmd = _observe_spmd(8)
-        assert spmd == canonical(8)
-        # host and torch doors are exercised (and compared to the same
-        # oracle) in the two tests above; this test documents the triple
-        # and guards the oracle itself
+    def test_oracle_self_check(self):
+        """Guards the shared oracle with hand-computed constants (each
+        door is compared to this oracle in the three tests above — that
+        is the cross-door agreement; worlds differ, the oracle is exact
+        for every world)."""
         c2 = canonical(2)
         assert c2["all_reduce_sum"] == [3.0, 6.0, 9.0]
         assert c2["reduce_root"] == c2["all_reduce_sum"]
