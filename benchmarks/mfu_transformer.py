@@ -77,7 +77,7 @@ def count_params(params) -> int:
 def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
         n_heads: int = FLAGSHIP["n_heads"], vocab: int = FLAGSHIP["vocab"],
         seq: int = FLAGSHIP["seq"], batch: int = FLAGSHIP["batch"],
-        steps: int = 30, dtype=jnp.bfloat16,
+        steps: int = 30, dtype=jnp.bfloat16, remat: bool = False,
         use_flash: bool = True, interpret: Optional[bool] = None) -> dict:
     from distributed_pytorch_tpu import models, optim
     from distributed_pytorch_tpu.ops import make_flash_attn_fn
@@ -90,7 +90,7 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
         if use_flash else None
     model = models.TransformerLM(vocab=vocab, dim=dim, n_layers=n_layers,
                                  n_heads=n_heads, max_seq=seq,
-                                 attn_fn=attn_fn, dtype=dtype)
+                                 attn_fn=attn_fn, remat=remat, dtype=dtype)
     params = model.init(jax.random.PRNGKey(0))
     n_params = count_params(params)
     opt = optim.adamw(3e-4)
@@ -139,7 +139,7 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
                    "vocab": vocab, "seq": seq, "batch": batch,
                    "dtype": str(jnp.dtype(dtype).name),
                    "attention": "flash" if use_flash else "dense",
-                   "optimizer": "adamw"},
+                   "remat": remat, "optimizer": "adamw"},
         "n_params": n_params,
         "steps_timed": summ["steps"],
         "step_ms_median": round(step_s * 1e3, 3),
@@ -154,12 +154,12 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
 
 
 def main(argv):
-    small = "--small" in argv
-    if small:
+    remat = "--remat" in argv
+    if "--small" in argv:
         rec = run(dim=128, n_layers=2, n_heads=4, vocab=512, seq=256,
-                  batch=4, steps=5)
+                  batch=4, steps=5, remat=remat)
     else:
-        rec = run()
+        rec = run(remat=remat)
     print(json.dumps(rec, indent=2))
     return 0
 

@@ -27,12 +27,13 @@ class TransformerLM(Module):
     def __init__(self, vocab: int = 256, dim: int = 128, n_layers: int = 2,
                  n_heads: int = 4, max_seq: int = 512, mlp_ratio: int = 4,
                  dropout: float = 0.0, attn_fn: Optional[Callable] = None,
-                 dtype=jnp.float32):
+                 remat: bool = False, dtype=jnp.float32):
         self.vocab = vocab
         self.dim = dim
         self.n_layers = n_layers
         self.n_heads = n_heads
         self.max_seq = max_seq
+        self.remat = remat
         self.dtype = dtype
         self.tok = Embedding(vocab, dim, dtype=dtype)
         self.pos = Embedding(max_seq, dim, dtype=dtype)
@@ -66,6 +67,16 @@ class TransformerLM(Module):
         x = x + self.pos.apply(params["pos"], pos_offset + jnp.arange(s))
         for i, blk in enumerate(self.blocks):
             r = jax.random.fold_in(rng, i) if rng is not None else None
-            x = blk.apply(params["blocks"][i], x, rng=r, train=train)
+
+            def run_block(p, x, blk=blk, r=r):
+                return blk.apply(p, x, rng=r, train=train)
+
+            if self.remat:
+                # recompute the block in backward instead of saving its
+                # activations: trades ~1/3 more FLOPs for O(n_layers)
+                # less activation HBM, buying batch size (and MFU) on
+                # memory-bound configs
+                run_block = jax.checkpoint(run_block)
+            x = run_block(params["blocks"][i], x)
         x = self.ln_f.apply(params["ln_f"], x)
         return self.head.apply(params["head"], x)

@@ -56,9 +56,9 @@ def parse_args(argv=None):
     p.add_argument("--text", default=None, type=str,
                    help="Local text file OR directory to byte-tokenize "
                         "(vocab=256; a directory concatenates its "
-                        ".py/.md/.txt/.rst files — e.g. the Python "
-                        "stdlib source tree); default: seeded "
-                        "synthetic tokens.")
+                        ".py/.md/.txt/.rst files up to a 64MiB cap, "
+                        "e.g. the Python stdlib source tree); default: "
+                        "seeded synthetic tokens.")
     p.add_argument("--data-size", default=512, type=int,
                    help="Number of synthetic samples when --text is unset.")
     p.add_argument("--flash", action="store_true",
@@ -66,6 +66,9 @@ def parse_args(argv=None):
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3 layout instead of replicated DP.")
+    p.add_argument("--remat", action="store_true",
+                   help="Rematerialize each block in backward (less "
+                        "activation memory, ~1/3 more FLOPs).")
     p.add_argument("--trace", default=None, type=str,
                    help="Capture an XProf trace of steps 5-10 into DIR.")
     p.add_argument("--log", default=None, type=str,
@@ -103,9 +106,10 @@ class ByteCorpus:
     (bytes[i*S:(i+1)*S], shifted-by-one targets).
 
     ``path`` may be a file, or a directory whose ``.py/.md/.txt/.rst``
-    files (sorted, recursive) are concatenated — e.g. the Python stdlib
-    source tree, the only sizeable real text corpus in a zero-egress
-    environment."""
+    files (sorted, recursive) are concatenated up to ``max_bytes``
+    (default 64 MiB; truncation is reported on stderr) — e.g. the Python
+    stdlib source tree, the only sizeable real text corpus in a
+    zero-egress environment."""
 
     _EXTS = (".py", ".md", ".txt", ".rst")
 
@@ -130,6 +134,9 @@ class ByteCorpus:
                         total += len(chunk)
             if not chunks:
                 raise ValueError(f"{path}: no text files found")
+            if total >= max_bytes:
+                print(f"ByteCorpus: {path} truncated to {max_bytes} bytes "
+                      f"(max_bytes cap)", file=sys.stderr)
             raw = np.concatenate(chunks)
         else:
             raw = np.fromfile(path, dtype=np.uint8)
@@ -182,7 +189,7 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
                                  n_layers=args.n_layers,
                                  n_heads=args.n_heads,
                                  max_seq=args.seq_len, attn_fn=attn_fn,
-                                 dtype=dtype)
+                                 remat=args.remat, dtype=dtype)
     params = model.init(jax.random.PRNGKey(0))
     optimizer = optim.adamw(args.lr)
 
