@@ -1,4 +1,5 @@
 """Ops: losses and TPU (Pallas) kernels with portable fallbacks."""
 from . import losses
-from .flash_attention import flash_attention, make_flash_attn_fn
+from .flash_attention import (flash_attention, flash_attention_with_lse,
+                              make_flash_attn_fn)
 from .losses import cross_entropy, cross_entropy_per_example

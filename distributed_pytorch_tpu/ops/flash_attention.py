@@ -298,7 +298,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
-               interpret):
+               interpret, g_lse=None):
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
     bq, bk = _block_sizes(s_q, s_k, block_q, block_k)
@@ -306,6 +306,11 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
 
     # delta_i = sum_d dO_i * O_i — tiny elementwise+reduce; XLA fuses it.
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        # An lse cotangent folds into the same kernels: per query row,
+        # ds_j = p_j (dp_j - delta + g_lse)   [dlse/ds_j = p_j], i.e. the
+        # kernels run unchanged with delta' = delta - g_lse.
+        delta = delta - g_lse.astype(jnp.float32)
 
     q3 = _pad_seq(q.reshape(b * h, s_q, d), bq, 1)
     k3 = _pad_seq(k.reshape(b * h, s_k, d), bk, 1)
@@ -367,23 +372,44 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    o, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
-    return o
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_lse_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_lse_vjp_bwd(causal, scale, block_q, block_k, interpret, res, gs):
     q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
-                      interpret)
+    g_o, g_lse = gs
+    return _flash_bwd(q, k, v, o, lse, g_o, causal, scale, block_q,
+                      block_k, interpret, g_lse=g_lse)
 
 
-_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool = False,
+                             scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: Optional[bool] = None):
+    """Like :func:`flash_attention` but also returns the per-row
+    log-sum-exp ``lse`` (B, H, Sq) — DIFFERENTIABLY (the lse cotangent is
+    folded into the backward kernels' delta term). This is the building
+    block for cross-block softmax merging: two attention partials
+    ``(o1, lse1), (o2, lse2)`` over disjoint key sets combine exactly via
+
+        lse = logaddexp(lse1, lse2)
+        o   = o1 * exp(lse1 - lse) + o2 * exp(lse2 - lse)
+
+    which is how ring flash attention (parallel/sequence.py) accumulates
+    a device's queries over the rotating k/v blocks."""
+    *_, dh = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(dh)
+    return _flash_lse(q, k, v, causal, float(scale), int(block_q),
+                      int(block_k), interpret)
 
 
 def flash_attention(q, k, v, *, causal: bool = False,
@@ -403,8 +429,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
     """
     *_, dh = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(dh)
-    return _flash(q, k, v, causal, float(scale), int(block_q), int(block_k),
-                  interpret)
+    # single vjp path: the unused lse output gets a zero cotangent, which
+    # the backward folds away for free (delta - 0)
+    o, _ = _flash_lse(q, k, v, causal, float(scale), int(block_q),
+                      int(block_k), interpret)
+    return o
 
 
 def make_flash_attn_fn(block_q: int = 128, block_k: int = 128,

@@ -11,7 +11,8 @@ from .fsdp import (fsdp_param_specs, make_fsdp_train_step,
 from .moe import MoELayer, moe_param_specs
 from .pipeline import (make_gspmd_pipeline_fn, make_pipeline_train_fn,
                        pipeline_apply, stack_layer_params)
-from .sequence import make_ring_attn_fn, ring_attention
+from .sequence import (make_ring_attn_fn, make_ring_flash_attn_fn,
+                       ring_attention, ring_flash_attention)
 from .spmd import (make_gspmd_ring_attn_fn, make_spmd_train_step,
                    shard_batch_spec)
 from .tensor import (replicated_specs, shard_params,

@@ -98,3 +98,74 @@ def make_ring_attn_fn(axis_name: str = "sp"):
         return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
                               scale=scale)
     return attn_fn
+
+
+def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
+                         causal: bool = False,
+                         scale: Optional[float] = None,
+                         block_q: int = 128, block_k: int = 128,
+                         interpret: Optional[bool] = None):
+    """Ring attention with the pallas FLASH kernel as the per-block core.
+
+    Same contract as :func:`ring_attention` (call inside ``shard_map``
+    with sequence-sharded (B, H, S_local, Dh) blocks; exact numerics),
+    but each ring step runs the O(S_local)-memory flash kernel instead of
+    materializing the (S_local, S_local) logits block — so per-device
+    attention memory stays flat as S_local grows, compounding the ring's
+    S/n sharding. Per-step partials merge via the differentiable
+    (o, lse) combination (ops/flash_attention.flash_attention_with_lse),
+    and the ring loop is a static Python unroll, so ``jax.grad`` yields
+    the reverse ring (cotangents ppermute backwards) automatically.
+
+    Causality uses the same block structure as :func:`ring_attention`:
+    the t==0 step (own block) runs the causal kernel; later steps run the
+    non-causal kernel and are merged with weight zero when the held block
+    is in the causal future (lse forced to the mask value — exp
+    underflows to exactly 0), keeping shapes/kernels static per step.
+    """
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, s_loc, dh = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(dh)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    o_acc = jnp.zeros((b, h, s_loc, dh), jnp.float32)
+    lse_acc = jnp.full((b, h, s_loc), _NEG, jnp.float32)
+    kt, vt = k, v
+    # mesh axis sizes are static, so the ring unrolls at trace time
+    n_static = int(n)
+    for t in range(n_static):
+        o_j, lse_j = flash_attention_with_lse(
+            q, kt, vt, causal=(causal and t == 0), scale=scale,
+            block_q=block_q, block_k=block_k, interpret=interpret)
+        o_j = o_j.astype(jnp.float32)
+        if causal and t > 0:
+            # held block has global index (my - t) % n; visible iff it is
+            # strictly before my, i.e. t <= my on this unrolled step
+            visible = (t <= my)
+            lse_j = jnp.where(visible, lse_j, _NEG)
+        lse_new = jnp.logaddexp(lse_acc, lse_j)
+        w_acc = jnp.exp(lse_acc - lse_new)[..., None]
+        w_j = jnp.exp(lse_j - lse_new)[..., None]
+        o_acc = o_acc * w_acc + o_j * w_j
+        lse_acc = lse_new
+        if t < n_static - 1:
+            kt = lax.ppermute(kt, axis_name, perm)
+            vt = lax.ppermute(vt, axis_name, perm)
+    return o_acc.astype(q.dtype)
+
+
+def make_ring_flash_attn_fn(axis_name: str = "sp", block_q: int = 128,
+                            block_k: int = 128,
+                            interpret: Optional[bool] = None):
+    """``attn_fn`` drop-in running :func:`ring_flash_attention` — the
+    long-context fast path: sequence-parallel ring over ICI with the
+    pallas kernel inside each hop."""
+    def attn_fn(q, k, v, *, causal: bool = False, scale=None):
+        return ring_flash_attention(q, k, v, axis_name=axis_name,
+                                    causal=causal, scale=scale,
+                                    block_q=block_q, block_k=block_k,
+                                    interpret=interpret)
+    return attn_fn

@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..optim import Optimizer
 from ..runtime import context
-from .sequence import ring_attention
+from .sequence import ring_attention, ring_flash_attention
 
 
 class SpmdStepOutput(NamedTuple):
@@ -39,14 +39,25 @@ class SpmdStepOutput(NamedTuple):
 
 
 def make_gspmd_ring_attn_fn(mesh: Mesh, *, dp: str = "dp", tp: str = "tp",
-                            sp: str = "sp"):
+                            sp: str = "sp", core: str = "dense",
+                            block_q: int = 128, block_k: int = 128,
+                            interpret=None):
     """An ``attn_fn`` for use INSIDE a GSPMD-jitted model: a shard_map
     island that runs ring attention over the ``sp`` axis while batch/heads
-    stay sharded over ``dp``/``tp``."""
+    stay sharded over ``dp``/``tp``. ``core='flash'`` swaps the per-hop
+    dense block for the pallas flash kernel
+    (:func:`..parallel.sequence.ring_flash_attention`) — the long-context
+    fast path, O(S_local) attention memory per device."""
+    if core not in ("dense", "flash"):
+        raise ValueError(f"unknown ring attention core {core!r}")
     qkv_spec = P(dp, tp, sp, None)  # (B, H, S, Dh)
 
     def attn_fn(q, k, v, *, causal: bool = False, scale=None):
         def island(q, k, v):
+            if core == "flash":
+                return ring_flash_attention(
+                    q, k, v, axis_name=sp, causal=causal, scale=scale,
+                    block_q=block_q, block_k=block_k, interpret=interpret)
             return ring_attention(q, k, v, axis_name=sp, causal=causal,
                                   scale=scale)
         return jax.shard_map(island, mesh=mesh,
