@@ -95,7 +95,14 @@ class DataLoader:
         return [idx[t * b : (t + 1) * b] for t in range(steps)]
 
     def __iter__(self) -> Iterator:
-        for row in self._index_matrix():
+        return self.iter_from(0)
+
+    def iter_from(self, start_batch: int) -> Iterator:
+        """This epoch's batches starting at batch index ``start_batch``:
+        earlier rows are skipped at the INDEX level — no dataset reads, no
+        collation — which is what makes checkpoint-resume fast-forward
+        (examples/train_transformer_lm.py) O(1) per skipped batch."""
+        for row in self._index_matrix()[start_batch:]:
             yield self.collate([self.dataset[int(i)] for i in row])
 
     def __len__(self) -> int:

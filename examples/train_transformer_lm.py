@@ -84,6 +84,15 @@ def parse_args(argv=None):
     p.add_argument("--eval", action="store_true",
                    help="Hold out 10%% of the data; report validation "
                         "loss and perplexity after training.")
+    p.add_argument("--save", default=None, type=str, metavar="DIR",
+                   help="Checkpoint directory (atomic, retention-managed; "
+                        "utils/checkpoint.py).")
+    p.add_argument("--save-every", default=50, type=int,
+                   help="Steps between checkpoints when --save is set.")
+    p.add_argument("--resume", action="store_true",
+                   help="Restore the latest checkpoint from --save and "
+                        "continue (exact continuation: the data stream "
+                        "fast-forwards to the saved step).")
     return p.parse_args(argv)
 
 
@@ -192,6 +201,27 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
                                  remat=args.remat, dtype=dtype)
     params = model.init(jax.random.PRNGKey(0))
     optimizer = optim.adamw(args.lr)
+    opt_state = optimizer.init(params)
+
+    # ---- checkpoint/resume (utils/checkpoint.py): restore on the host
+    # BEFORE device placement so the same code path serves both layouts
+    start_step = 0
+    ckpt_mgr = None
+    if args.save:
+        from distributed_pytorch_tpu.utils.checkpoint import (
+            CheckpointManager, restore_checkpoint)
+        ckpt_mgr = CheckpointManager(args.save, interval=args.save_every,
+                                     keep=3, async_save=True)
+        if args.resume:
+            ck = restore_checkpoint(args.save, like_params=params,
+                                    like_opt_state=opt_state)
+            params, opt_state = ck.params, ck.opt_state
+            start_step = ck.step + 1
+            if not quiet:
+                dist.print_primary(f"resumed from step {ck.step} "
+                                   f"({args.save})")
+    elif args.resume:
+        raise ValueError("--resume requires --save DIR")
 
     def loss_fn(p, batch):
         x, y = batch
@@ -202,14 +232,13 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
     if args.fsdp and is_distributed:
         mesh = context.get_mesh()
         specs = fsdp_param_specs(params, world)
-        opt_state = optimizer.init(params)
         params, opt_state = shard_model_and_opt(params, opt_state, mesh,
                                                 specs)
         step_fn = make_fsdp_train_step(loss_fn, optimizer, mesh, specs)
         place = lambda b: shard_batch_spec(b, mesh, P("dp", None))
     else:
         params = dist.replicate(params)
-        opt_state = dist.replicate(optimizer.init(params))
+        opt_state = dist.replicate(opt_state)
         step_fn = make_train_step(loss_fn, optimizer)
         place = dist.shard_batch
 
@@ -233,14 +262,19 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
         pending.clear()
         return last
 
-    step = 0
-    epoch = 0
+    step = start_step
+    # resume lands mid-epoch: restart that epoch's (set_epoch-seeded,
+    # deterministic) stream from the right batch index — skipping happens
+    # at the index level (loader.iter_from), so fast-forward is free
+    epoch = step // len(loader)
+    skip = step % len(loader)
+    last_saved = None
     t_run0 = None
     timed_steps = 0
     trace_active = False
     while step < args.steps:
         loader.set_epoch(epoch)
-        for batch in loader:
+        for batch in loader.iter_from(skip):
             if step >= args.steps:
                 break
             if args.trace and step == min(5, args.steps - 1):
@@ -259,10 +293,21 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
                     t_run0 = (time.perf_counter(), step)  # past compile
                 if not quiet:
                     dist.print_primary(f"step {step:>5}  loss {loss:.4f}")
+            if ckpt_mgr is not None and \
+                    ckpt_mgr.save(step, params, opt_state,
+                                  extra={"epoch": epoch}):
+                last_saved = step
             step += 1
         epoch += 1
+        skip = 0
     sync_pending()
     jax.block_until_ready(params)
+    if ckpt_mgr is not None:
+        if step > start_step and last_saved != step - 1:
+            ckpt_mgr.save(step - 1, params, opt_state,
+                          extra={"epoch": (step - 1) // len(loader)},
+                          force=True)
+        ckpt_mgr.wait()
 
     if t_run0 is not None and step - t_run0[1] > 0 and not quiet:
         dt = time.perf_counter() - t_run0[0]
