@@ -1,0 +1,115 @@
+"""Single-chip autoregressive decode benchmark: tokens/sec with the
+compiled KV-cache path (models/generate.py).
+
+The reference has no inference path at all; this measures ours where it
+matters — per-token decode latency/throughput on the flagship-class model.
+Decode is bandwidth-bound (each step streams the params + KV cache once),
+so the companion number to MFU here is achieved HBM bandwidth:
+
+    bytes/step ~= param_bytes + kv_cache_bytes(current length)
+    achieved GB/s = bytes/step * tokens/step / step_time
+
+Usage: python benchmarks/decode_tpu.py [--small]
+Prints a human table plus one JSON line for tooling.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+# Public spec-sheet HBM bandwidth per chip (bytes/s).
+HBM_BW = {
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+}
+
+
+def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
+        prompt_len=128, max_new=256, batch=8, dtype=jnp.bfloat16) -> dict:
+    from benchmarks.mfu_transformer import count_params
+    from distributed_pytorch_tpu import models
+    from distributed_pytorch_tpu.models import make_generate_fn
+    from distributed_pytorch_tpu.models.generate import prefill
+    from distributed_pytorch_tpu.utils.profiler import StepTimer
+
+    max_seq = prompt_len + max_new
+    model = models.TransformerLM(vocab=vocab, dim=dim, n_layers=n_layers,
+                                 n_heads=n_heads, max_seq=max_seq,
+                                 dtype=dtype)
+    params = model.init(jax.random.PRNGKey(0))
+    n_params = count_params(params)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
+                                0, vocab, dtype=jnp.int32)
+
+    gen = jax.jit(make_generate_fn(model, max_new))
+    rng = jax.random.PRNGKey(2)
+
+    timer = StepTimer(warmup=1)               # warmup run owns the compile
+    timer.measure(gen, params, prompt, rng, n=5)
+    t_total = timer.summary()["median_s"]
+
+    # prefill timed separately so the decode metrics are decode-only:
+    # gen() = one prefill (which also yields the FIRST new token's logits)
+    # + (max_new - 1) scanned decode steps.
+    pf = jax.jit(lambda p, toks: prefill(model, p, toks, max_seq))
+    pf_timer = StepTimer(warmup=1)
+    pf_timer.measure(pf, params, prompt, n=5)
+    t_prefill = pf_timer.summary()["median_s"]
+    decode_steps = max_new - 1
+    t_decode = max(t_total - t_prefill, 1e-9)
+
+    tok_s_e2e = batch * max_new / t_total
+    tok_s_decode = batch * decode_steps / t_decode
+    bpe = jnp.dtype(dtype).itemsize
+    # each decode step streams the params plus the FULL preallocated cache
+    # (decode attends over max_len under a position mask — static shapes)
+    kv_bytes = n_layers * 2 * batch * dim * max_seq * bpe
+    bytes_per_step = n_params * bpe + kv_bytes
+    achieved_bw = bytes_per_step * decode_steps / t_decode
+
+    dev = jax.devices()[0]
+    peak_bw = HBM_BW.get(dev.device_kind)
+    return {
+        "device": dev.device_kind,
+        "config": {"dim": dim, "n_layers": n_layers, "n_heads": n_heads,
+                   "vocab": vocab, "prompt_len": prompt_len,
+                   "max_new": max_new, "batch": batch,
+                   "dtype": str(jnp.dtype(dtype).name)},
+        "n_params": n_params,
+        "wall_s_median": round(t_total, 4),
+        "prefill_ms": round(t_prefill * 1e3, 3),
+        "e2e_tokens_per_sec": round(tok_s_e2e, 1),
+        "decode_tokens_per_sec": round(tok_s_decode, 1),
+        "decode_per_token_latency_ms": round(1e3 * t_decode / decode_steps,
+                                             3),
+        "est_achieved_hbm_gbps": round(achieved_bw / 1e9, 1),
+        "peak_hbm_gbps": round(peak_bw / 1e9, 1) if peak_bw else None,
+        "est_hbm_utilization": round(achieved_bw / peak_bw, 3)
+        if peak_bw else None,
+    }
+
+
+def main(argv):
+    if "--small" in argv:
+        rec = run(dim=128, n_layers=2, n_heads=4, vocab=512,
+                  prompt_len=16, max_new=32, batch=2)
+    else:
+        rec = run()
+    print(json.dumps(rec, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
