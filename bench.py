@@ -316,6 +316,9 @@ def _stage_main(stage: str) -> int:
         print(json.dumps(mfu_run()))
     elif stage == "min_ddp":
         print(json.dumps(bench_min_ddp()))
+    elif stage == "decode":
+        from benchmarks.decode_tpu import run as decode_run
+        print(json.dumps(decode_run()))
     else:
         print(json.dumps({"error": f"unknown stage {stage!r}"}))
         return 2
@@ -342,6 +345,7 @@ def main():
         else:
             rec["error"] = f"mfu stage: {mfu_rec.get('error', 'no result')}"
         rec["min_ddp"] = _run_stage("min_ddp", timeout_s=900)
+        rec["decode"] = _run_stage("decode", timeout_s=1200)
     else:
         rec["error"] = "no healthy TPU backend after retries"
 
