@@ -85,24 +85,50 @@ def wait_for_backend(max_tries: int = 4, base_sleep_s: float = 30.0) -> dict:
     return {}
 
 
+def run_json_subprocess(argv, timeout_s: int, *, label: str,
+                        env: dict = None) -> dict:
+    """Run a subprocess with a hard timeout and parse its LAST stdout
+    line as JSON. Single implementation of the
+    parseable-record-no-matter-what contract — used by this script's
+    stage runner and dp8 bench, and by benchmarks/run_all_tpu.py. On any
+    failure (nonzero exit, timeout, unparseable output) returns an
+    ``error`` record carrying the output tails instead of raising."""
+    base_env = {**os.environ,
+                "PYTHONPATH": REPO + os.pathsep
+                + os.environ.get("PYTHONPATH", "")}
+    if env:
+        base_env.update(env)
+    try:
+        out = subprocess.run(argv, capture_output=True, text=True,
+                             timeout=timeout_s, env=base_env)
+    except subprocess.TimeoutExpired as e:
+        # TimeoutExpired carries the partial output (text decoded when
+        # the child wrote any) — keep it: on a flaky backend the progress
+        # lines before the wedge are exactly the diagnostics needed
+        rec = {"error": f"{label} timed out after {timeout_s}s"}
+        for name in ("stdout", "stderr"):
+            v = getattr(e, name, None)
+            if v:
+                if isinstance(v, bytes):
+                    v = v.decode(errors="replace")
+                rec[f"{name}_tail"] = v.strip()[-800:]
+        return rec
+    if out.returncode == 0 and out.stdout.strip():
+        try:
+            return json.loads(out.stdout.strip().splitlines()[-1])
+        except json.JSONDecodeError as e:
+            return {"error": f"{label} emitted unparseable output: {e}",
+                    "stdout_tail": out.stdout.strip()[-800:]}
+    return {"error": (out.stderr or "no output").strip()[-500:]}
+
+
 def _run_stage(stage: str, timeout_s: int) -> dict:
     """Re-invoke this script for one measurement stage in a subprocess
     with a hard timeout — the tunnel can wedge mid-run, and the
     parseable-JSON-on-failure contract must survive that."""
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--stage", stage],
-            capture_output=True, text=True, timeout=timeout_s,
-            env={**os.environ,
-                 "PYTHONPATH": REPO + os.pathsep
-                 + os.environ.get("PYTHONPATH", "")})
-        if out.returncode == 0 and out.stdout.strip():
-            return json.loads(out.stdout.strip().splitlines()[-1])
-        return {"error": (out.stderr or "no output").strip()[-500:]}
-    except subprocess.TimeoutExpired:
-        return {"error": f"stage {stage} timed out after {timeout_s}s"}
-    except json.JSONDecodeError as e:
-        return {"error": f"stage {stage} emitted unparseable output: {e}"}
+    return run_json_subprocess(
+        [sys.executable, os.path.abspath(__file__), "--stage", stage],
+        timeout_s, label=f"stage {stage}")
 
 
 # ---------------------------------------------------------------------------
@@ -288,21 +314,9 @@ print(json.dumps({"steps_per_sec": round(n / (time.perf_counter() - t0), 1),
 
 
 def bench_dp8() -> dict:
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", _DP8_CODE], capture_output=True,
-            text=True, timeout=600,
-            env={**os.environ,
-                 "PYTHONPATH": REPO + os.pathsep
-                 + os.environ.get("PYTHONPATH", ""),
-                 "JAX_PLATFORMS": "cpu", "DPX_CPU_DEVICES": "8"})
-        if out.returncode == 0 and out.stdout.strip():
-            return json.loads(out.stdout.strip().splitlines()[-1])
-        return {"error": (out.stderr or "no output").strip()[-500:]}
-    except subprocess.TimeoutExpired:
-        return {"error": "dp8 bench timed out"}
-    except json.JSONDecodeError as e:
-        return {"error": f"dp8 bench emitted unparseable output: {e}"}
+    return run_json_subprocess(
+        [sys.executable, "-c", _DP8_CODE], 600, label="dp8 bench",
+        env={"JAX_PLATFORMS": "cpu", "DPX_CPU_DEVICES": "8"})
 
 
 # ---------------------------------------------------------------------------

@@ -107,7 +107,8 @@ def main(argv):
                   prompt_len=16, max_new=32, batch=2)
     else:
         rec = run()
-    print(json.dumps(rec, indent=2))
+    # one compact line: collectors parse the last stdout line as JSON
+    print(json.dumps(rec))
     return 0
 
 

@@ -160,7 +160,8 @@ def main(argv):
                   batch=4, steps=5, remat=remat)
     else:
         rec = run(remat=remat)
-    print(json.dumps(rec, indent=2))
+    # one compact line: collectors parse the last stdout line as JSON
+    print(json.dumps(rec))
     return 0
 
 

@@ -5,58 +5,38 @@ healthy, this script collects everything BASELINE.md lists as pending:
 
 1. flash-attention compiled validation + speedup table
    (benchmarks/flash_attention_tpu.py)
-2. flagship MFU, with a small config sweep (batch x remat) to report the
-   best achievable number (benchmarks/mfu_transformer.py)
-3. KV-cache decode throughput (benchmarks/decode_tpu.py)
-4. the headline bench record (bench.py)
+2. the remat arm of the flagship MFU measurement
+   (benchmarks/mfu_transformer.py --remat; the default-config arm comes
+   from bench.py below)
+3. the headline bench record (bench.py — embeds default MFU, min_ddp,
+   and decode)
 
-Each stage runs as a subprocess with a hard timeout (a mid-run tunnel
-wedge must not take the collector down) and everything is appended as
-JSON lines to --out (default benchmarks/tpu_results.jsonl) for transfer
-into BASELINE.md.
+A TPU-health probe gates everything: without a healthy chip no stage
+launches (a CPU fallback would grind the flagship through interpret-mode
+pallas for hours). Each stage runs as a subprocess with a hard timeout (a
+mid-run tunnel wedge must not take the collector down) and everything is
+appended as JSON lines to --out (default benchmarks/tpu_results.jsonl)
+for transfer into BASELINE.md.
 
 Usage: python benchmarks/run_all_tpu.py [--quick] [--out FILE]
 """
 
 import json
 import os
-import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  (the shared subprocess/JSON plumbing)
 
 
 def run_stage(name: str, argv, timeout_s: int) -> dict:
     t0 = time.time()
-    try:
-        out = subprocess.run(argv, capture_output=True, text=True,
-                             timeout=timeout_s, cwd=REPO)
-    except subprocess.TimeoutExpired:
-        return {"stage": name, "ok": False,
-                "error": f"timeout after {timeout_s}s"}
-    rec = {"stage": name, "ok": out.returncode == 0,
-           "wall_s": round(time.time() - t0, 1)}
-    # take the last JSON-parseable line as the stage's record
-    payload = None
-    for line in reversed(out.stdout.strip().splitlines()):
-        try:
-            payload = json.loads(line)
-            break
-        except json.JSONDecodeError:
-            continue
-    if payload is None:
-        # some stages pretty-print one JSON object over many lines
-        try:
-            start = out.stdout.index("{")
-            payload = json.loads(out.stdout[start:])
-        except (ValueError, json.JSONDecodeError):
-            pass
-    if payload is not None:
-        rec["result"] = payload
-    elif not rec["ok"]:
-        rec["error"] = (out.stderr or "no output").strip()[-800:]
-    rec["stdout_tail"] = out.stdout.strip()[-1500:]
+    payload = bench.run_json_subprocess(argv, timeout_s, label=name)
+    rec = {"stage": name, "ok": "error" not in payload,
+           "wall_s": round(time.time() - t0, 1), "result": payload}
     return rec
 
 
@@ -72,20 +52,30 @@ def main(argv):
         out_path = argv[i + 1]
     py = sys.executable
 
-    # bench.py already embeds the default-config MFU, min_ddp and decode
-    # stages — don't re-measure them standalone (every duplicated minute
-    # on the flaky tunnel is another chance to wedge mid-collection). The
-    # outer timeout must exceed bench.py's own internal worst case
-    # (probe retries + per-stage subprocess timeouts + CPU baselines),
-    # or a late wedge would SIGKILL it and lose its partial record.
+    info = bench.wait_for_backend(max_tries=2, base_sleep_s=15.0)
+    if not info:
+        print(json.dumps({"error": "no healthy TPU backend; not running "
+                          "any on-chip stage"}))
+        return 1
+    print(f"# TPU healthy: {info.get('kind')}", flush=True)
+
+    # bench.py embeds the default-config MFU, min_ddp and decode stages —
+    # don't re-measure them standalone (every duplicated minute on the
+    # flaky tunnel is another chance to wedge mid-collection). The outer
+    # timeout must exceed bench.py's own internal worst case (probe
+    # retries + per-stage subprocess timeouts + CPU baselines), or a late
+    # wedge would SIGKILL it and lose its partial record.
+    def path(rel):
+        return os.path.join(REPO, rel)
+
     stages = [("flash_attention",
-               [py, "benchmarks/flash_attention_tpu.py"], 2400),
-              ("bench_headline", [py, "bench.py"], 7200)]
+               [py, path("benchmarks/flash_attention_tpu.py")], 2400),
+              ("bench_headline", [py, path("bench.py")], 7200)]
     if not quick:
         # MFU sweep arm: remat trades activation HBM for FLOPs
         stages.insert(1, ("mfu_remat",
-                          [py, "benchmarks/mfu_transformer.py", "--remat"],
-                          1800))
+                          [py, path("benchmarks/mfu_transformer.py"),
+                           "--remat"], 1800))
 
     results = []
     with open(out_path, "a") as f:

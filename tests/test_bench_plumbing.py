@@ -1,0 +1,105 @@
+"""The driver-facing contracts: bench.py's stage/error plumbing (the
+parseable-JSON-on-failure promise BENCH_r{N}.json depends on) and the
+__graft_entry__ compile check. No chip needed — the on-chip measurement
+content is exercised by benchmarks/ when the backend is healthy."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def test_unknown_stage_emits_json_and_rc2():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--stage", "nope"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 2
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "error" in rec
+
+
+def test_run_stage_parses_last_json_line(monkeypatch):
+    """_run_stage must survive noisy stdout and take the last JSON line."""
+    real_run = subprocess.run
+
+    def fake_run(argv, **kw):
+        class R:
+            returncode = 0
+            stdout = "warning: blah\n{\"x\": 1}\n"
+            stderr = ""
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    try:
+        assert bench._run_stage("mfu", timeout_s=5) == {"x": 1}
+    finally:
+        monkeypatch.setattr(subprocess, "run", real_run)
+
+
+def test_run_stage_failure_yields_error_record(monkeypatch):
+    def fake_run(argv, **kw):
+        class R:
+            returncode = 1
+            stdout = ""
+            stderr = "boom\n"
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    rec = bench._run_stage("mfu", timeout_s=5)
+    assert "boom" in rec["error"]
+
+
+def test_run_stage_timeout_yields_error_record(monkeypatch):
+    def fake_run(argv, **kw):
+        raise subprocess.TimeoutExpired(argv, kw.get("timeout"))
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    rec = bench._run_stage("mfu", timeout_s=5)
+    assert "timed out" in rec["error"]
+
+
+def test_probe_requires_tpu_platform(monkeypatch):
+    """A CPU fallback must not count as a healthy backend (it would run
+    the flagship bench on the host in interpret-mode pallas)."""
+    def fake_run(argv, **kw):
+        class R:
+            returncode = 0
+            stdout = '{"platform": "cpu", "kind": "cpu"}\n'
+            stderr = ""
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    assert bench.probe_backend() == {}
+
+
+def test_wait_for_backend_bounded(monkeypatch):
+    calls = []
+
+    def fake_probe(timeout_s=120):
+        calls.append(1)
+        return {}
+
+    monkeypatch.setattr(bench, "probe_backend", fake_probe)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench.wait_for_backend(max_tries=3, base_sleep_s=0.0) == {}
+    assert len(calls) == 3
+
+
+def test_graft_entry_compiles_single_device():
+    """entry() must stay jittable — the driver compile-checks it."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(REPO, "__graft_entry__.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn, args = mod.entry()
+    out = jax.jit(fn).lower(*args).compile()
+    assert out is not None
