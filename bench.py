@@ -86,13 +86,18 @@ def wait_for_backend(max_tries: int = 4, base_sleep_s: float = 30.0) -> dict:
 
 
 def run_json_subprocess(argv, timeout_s: int, *, label: str,
-                        env: dict = None) -> dict:
+                        env: dict = None,
+                        keep_stdout_tail: bool = False) -> dict:
     """Run a subprocess with a hard timeout and parse its LAST stdout
     line as JSON. Single implementation of the
     parseable-record-no-matter-what contract — used by this script's
     stage runner and dp8 bench, and by benchmarks/run_all_tpu.py. On any
     failure (nonzero exit, timeout, unparseable output) returns an
-    ``error`` record carrying the output tails instead of raising."""
+    ``error`` record carrying whatever the child did produce — a stage
+    that prints its record and then exits nonzero (e.g. a failed
+    numerics validation) keeps its measurements, marked with ``error``
+    and ``rc``. ``keep_stdout_tail`` preserves the human-readable tail
+    (tables) alongside the parsed record."""
     base_env = {**os.environ,
                 "PYTHONPATH": REPO + os.pathsep
                 + os.environ.get("PYTHONPATH", "")}
@@ -113,13 +118,26 @@ def run_json_subprocess(argv, timeout_s: int, *, label: str,
                     v = v.decode(errors="replace")
                 rec[f"{name}_tail"] = v.strip()[-800:]
         return rec
-    if out.returncode == 0 and out.stdout.strip():
+
+    payload = None
+    if out.stdout.strip():
         try:
-            return json.loads(out.stdout.strip().splitlines()[-1])
-        except json.JSONDecodeError as e:
-            return {"error": f"{label} emitted unparseable output: {e}",
-                    "stdout_tail": out.stdout.strip()[-800:]}
-    return {"error": (out.stderr or "no output").strip()[-500:]}
+            payload = json.loads(out.stdout.strip().splitlines()[-1])
+        except json.JSONDecodeError:
+            payload = None
+    if isinstance(payload, dict):
+        if out.returncode != 0:
+            payload.setdefault(
+                "error", f"{label} exited rc={out.returncode}")
+            payload["rc"] = out.returncode
+    elif out.returncode == 0 and payload is not None:
+        payload = {"value": payload}
+    else:
+        payload = {"error": (out.stderr or "no parseable output")
+                   .strip()[-500:] or f"{label} produced no output"}
+    if keep_stdout_tail:
+        payload["stdout_tail"] = out.stdout.strip()[-1500:]
+    return payload
 
 
 def _run_stage(stage: str, timeout_s: int) -> dict:

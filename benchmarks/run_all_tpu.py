@@ -34,7 +34,8 @@ import bench  # noqa: E402  (the shared subprocess/JSON plumbing)
 
 def run_stage(name: str, argv, timeout_s: int) -> dict:
     t0 = time.time()
-    payload = bench.run_json_subprocess(argv, timeout_s, label=name)
+    payload = bench.run_json_subprocess(argv, timeout_s, label=name,
+                                        keep_stdout_tail=True)
     rec = {"stage": name, "ok": "error" not in payload,
            "wall_s": round(time.time() - t0, 1), "result": payload}
     return rec
@@ -54,8 +55,13 @@ def main(argv):
 
     info = bench.wait_for_backend(max_tries=2, base_sleep_s=15.0)
     if not info:
-        print(json.dumps({"error": "no healthy TPU backend; not running "
-                          "any on-chip stage"}))
+        rec = {"stage": "tpu_health_gate", "ok": False,
+               "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+               "result": {"error": "no healthy TPU backend; not running "
+                          "any on-chip stage"}}
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec))
         return 1
     print(f"# TPU healthy: {info.get('kind')}", flush=True)
 

@@ -1,0 +1,137 @@
+"""Long-context LM training: sequence parallelism + ring flash attention.
+
+The long-context showcase the reference cannot express at all (SURVEY.md
+§2.4: no attention, no sequence dimension): the sequence axis is sharded
+over the ``sp`` mesh axis, each device holds S/sp of every example, and
+attention runs as a ring — k/v blocks ppermute around the ``sp`` ring
+while the pallas flash kernel computes each hop in O(S_local) memory
+(parallel/sequence.py:ring_flash_attention). Per-device attention cost
+stays flat as the context grows with the ring size; everything else
+(embedding, MLP, loss) is ordinary GSPMD sharding the partitioner lays
+out from the batch/param specs.
+
+Runs on the 8-device virtual CPU mesh (tests) or a real slice unchanged:
+
+  python examples/train_long_context.py --steps 20 --seq-len 2048 --sp 4
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import distributed_pytorch_tpu as dist
+from distributed_pytorch_tpu import models, optim
+from distributed_pytorch_tpu.ops.losses import cross_entropy_per_example
+from distributed_pytorch_tpu.parallel import (make_gspmd_ring_attn_fn,
+                                              make_spmd_train_step,
+                                              shard_batch_spec)
+from distributed_pytorch_tpu.parallel.tensor import (
+    shard_params, transformer_lm_param_specs)
+from distributed_pytorch_tpu.runtime import context
+from distributed_pytorch_tpu.utils import MetricsLogger
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="Sequence-parallel long-context LM training")
+    p.add_argument("--steps", default=20, type=int)
+    p.add_argument("--seq-len", default=2048, type=int)
+    p.add_argument("--batch-size", default=2, type=int,
+                   help="GLOBAL batch (sharded over the dp axis).")
+    p.add_argument("--sp", default=0, type=int,
+                   help="Ring size (sequence shards); 0 = all visible "
+                        "devices. The rest of the device count becomes "
+                        "the dp axis.")
+    p.add_argument("--dim", default=256, type=int)
+    p.add_argument("--n-layers", default=4, type=int)
+    p.add_argument("--n-heads", default=8, type=int)
+    p.add_argument("--lr", default=3e-4, type=float)
+    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--block-q", default=128, type=int)
+    p.add_argument("--block-k", default=128, type=int)
+    p.add_argument("--log", default=None, type=str)
+    return p.parse_args(argv)
+
+
+def main(argv=None, quiet=False, history=None):
+    args = parse_args(argv)
+    n_dev = max(len(context.visible_devices()), 1)
+    sp = args.sp or n_dev
+    if n_dev % sp:
+        raise ValueError(f"sp={sp} must divide the {n_dev} devices")
+    dp = n_dev // sp
+    if args.seq_len % sp:
+        raise ValueError(f"--seq-len {args.seq_len} must divide by sp={sp}")
+    if args.batch_size % dp:
+        raise ValueError(f"--batch-size {args.batch_size} must divide by "
+                         f"dp={dp}")
+    mesh = context.init_mesh(dp=dp, sp=sp)
+    if not quiet:
+        dist.print_primary(f"mesh: dp={dp} x sp={sp}  "
+                           f"seq {args.seq_len} ({args.seq_len // sp}"
+                           f"/device)")
+
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    attn_fn = make_gspmd_ring_attn_fn(mesh, core="flash",
+                                      block_q=args.block_q,
+                                      block_k=args.block_k)
+    model = models.TransformerLM(vocab=256, dim=args.dim,
+                                 n_layers=args.n_layers,
+                                 n_heads=args.n_heads,
+                                 max_seq=args.seq_len, attn_fn=attn_fn,
+                                 dtype=dtype)
+    params = shard_params(model.init(jax.random.PRNGKey(0)),
+                          transformer_lm_param_specs(model), mesh)
+    optimizer = optim.adamw(args.lr)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return cross_entropy_per_example(model.apply(p, x), y).mean(), {}
+
+    step = make_spmd_train_step(loss_fn, optimizer, donate=False)
+
+    # seeded synthetic byte stream, (B, S+1) windows
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 256,
+                        (args.batch_size, args.seq_len + 1)).astype(np.int32)
+    batch = shard_batch_spec((toks[:, :-1], toks[:, 1:]), mesh,
+                             P("dp", "sp"))
+
+    logger = MetricsLogger(args.log)
+    tokens_per_step = args.batch_size * args.seq_len
+    out = step(params, opt_state, batch)     # compile
+    jax.block_until_ready(out.loss)
+    t0 = time.perf_counter()
+    p_, o_ = out.params, out.opt_state
+    for s in range(1, args.steps):
+        out = step(p_, o_, batch)
+        p_, o_ = out.params, out.opt_state
+        loss = float(out.loss)
+        logger.log(s, loss=loss)
+        if history is not None:
+            history.append(loss)
+        if not quiet and (s % 5 == 0 or s == args.steps - 1):
+            dist.print_primary(f"step {s:>4}  loss {loss:.4f}")
+    if args.steps > 1:
+        dt = time.perf_counter() - t0
+        sps = (args.steps - 1) / dt
+        if not quiet:
+            dist.print_primary(
+                f"done: {sps:.2f} steps/s, "
+                f"{sps * tokens_per_step:,.0f} tokens/s")
+    logger.close()
+    dist.cleanup()
+
+
+if __name__ == "__main__":
+    main()

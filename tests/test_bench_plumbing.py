@@ -27,8 +27,6 @@ def test_unknown_stage_emits_json_and_rc2():
 
 def test_run_stage_parses_last_json_line(monkeypatch):
     """_run_stage must survive noisy stdout and take the last JSON line."""
-    real_run = subprocess.run
-
     def fake_run(argv, **kw):
         class R:
             returncode = 0
@@ -37,10 +35,23 @@ def test_run_stage_parses_last_json_line(monkeypatch):
         return R()
 
     monkeypatch.setattr(subprocess, "run", fake_run)
-    try:
-        assert bench._run_stage("mfu", timeout_s=5) == {"x": 1}
-    finally:
-        monkeypatch.setattr(subprocess, "run", real_run)
+    assert bench._run_stage("mfu", timeout_s=5) == {"x": 1}
+
+
+def test_nonzero_exit_keeps_printed_record(monkeypatch):
+    """A stage that prints its record then exits nonzero (failed numerics
+    validation) must keep its measurements, marked with error + rc."""
+    def fake_run(argv, **kw):
+        class R:
+            returncode = 2
+            stdout = '{"numerics_ok": false, "rows": [1, 2]}\n'
+            stderr = ""
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    rec = bench.run_json_subprocess(["x"], 5, label="flash")
+    assert rec["rows"] == [1, 2]
+    assert rec["rc"] == 2 and "error" in rec
 
 
 def test_run_stage_failure_yields_error_record(monkeypatch):

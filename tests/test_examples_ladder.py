@@ -131,3 +131,19 @@ def test_transformer_lm_checkpoint_resume_exact(tmp_path):
     assert len(resumed) == 4
     np.testing.assert_array_equal(np.asarray(resumed),
                                   np.asarray(full[5:9]))
+
+
+def test_long_context_sp_ring_flash():
+    """Sequence-parallel long-context training: dp x sp mesh with the
+    ring-flash attention island; loss finite and decreasing-ish over a
+    few steps."""
+    import train_long_context
+
+    h = []
+    train_long_context.main(
+        ["--steps", "6", "--seq-len", "128", "--sp", "4",
+         "--batch-size", "2", "--dim", "32", "--n-layers", "1",
+         "--n-heads", "4", "--block-q", "16", "--block-k", "16"],
+        quiet=True, history=h)
+    assert len(h) == 5
+    assert all(np.isfinite(x) for x in h)
