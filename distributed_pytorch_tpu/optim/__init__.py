@@ -93,4 +93,4 @@ def adamw(lr: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
 from . import schedules  # noqa: E402
 from .schedules import (accumulate, clip_by_global_norm, constant,  # noqa: E402
                         cosine_decay, linear_warmup, warmup_cosine,
-                        with_clipping, with_schedule)
+                        with_clipping, with_master_f32, with_schedule)

@@ -73,12 +73,31 @@ def with_schedule(opt_factory: Callable[[float], Optimizer],
     build the underlying optimizer for a given lr in a way that uses lr
     only as a scalar multiplier (true of :func:`optim.sgd` /
     :func:`optim.adamw`) — the factory is traced once with lr=1 and the
-    scheduled lr scales the parameter delta."""
+    scheduled lr scales the parameter delta.
+
+    Stateful-parameter wrappers break that assumption: a
+    :func:`with_master_f32` INSIDE the factory would store the full lr=1
+    update in its master copy, silently ignoring the schedule. That
+    composition is rejected at init; wrap the other way around —
+    ``with_master_f32(with_schedule(adamw, sched))``."""
     unit = opt_factory(1.0)
 
+    def _has_master(state) -> bool:
+        if isinstance(state, MasterState):
+            return True
+        if isinstance(state, tuple):
+            return any(_has_master(x) for x in state)
+        return False
+
     def init(params):
-        return ScheduledState(step=jnp.zeros((), jnp.int32),
-                              inner=unit.init(params))
+        inner = unit.init(params)
+        if _has_master(inner):
+            raise ValueError(
+                "with_schedule(factory) cannot wrap with_master_f32: the "
+                "master copy would absorb the unscaled lr=1 update and "
+                "the schedule would be ignored. Compose as "
+                "with_master_f32(with_schedule(adamw, schedule)) instead.")
+        return ScheduledState(step=jnp.zeros((), jnp.int32), inner=inner)
 
     def update(grads, state, params):
         lr = schedule(state.step)
@@ -151,5 +170,41 @@ def accumulate(opt: Optimizer, every: int) -> Optimizer:
             return params, AccumState(count, acc, state.inner)
 
         return jax.lax.cond(count >= every, apply, skip, None)
+
+    return Optimizer(init, update)
+
+
+class MasterState(NamedTuple):
+    master: Any          # float32 master copy of low-precision params
+    inner: Any
+
+
+def with_master_f32(opt: Optimizer) -> Optimizer:
+    """Float32 master weights for low-precision training.
+
+    bfloat16 parameters lose every update smaller than ~2^-8 of the
+    weight's magnitude to rounding (8 mantissa bits), which stalls late
+    training. The standard mixed-precision recipe keeps the authoritative
+    copy in float32: the inner optimizer updates the MASTER, and the
+    working (bf16) params handed back to the model are its cast. Leaves
+    that are already float32 pass through untouched (no double storage).
+
+    The working params keep their dtype, so the compiled train step's
+    matmuls stay low-precision — only the update math changes.
+    """
+    def _to_master(p):
+        return p.astype(jnp.float32) if p.dtype == jnp.bfloat16 else p
+
+    def init(params):
+        master = jax.tree_util.tree_map(_to_master, params)
+        return MasterState(master=master, inner=opt.init(master))
+
+    def update(grads, state, params):
+        grads32 = jax.tree_util.tree_map(
+            lambda g, m: g.astype(m.dtype), grads, state.master)
+        new_master, inner = opt.update(grads32, state.inner, state.master)
+        new_params = jax.tree_util.tree_map(
+            lambda m, p: m.astype(p.dtype), new_master, params)
+        return new_params, MasterState(master=new_master, inner=inner)
 
     return Optimizer(init, update)

@@ -69,13 +69,20 @@ def fsdp_param_specs(params, n_shards: int, *, axis: str = "dp",
 
 def opt_state_specs(opt_state, param_specs):
     """Spec tree for an optimizer state: param-shaped subtrees (moments,
-    velocities) inherit the param specs — this is what shards the
-    optimizer (ZeRO-1) — scalars (step counters) replicate."""
-    if isinstance(opt_state, AdamWState):
-        return AdamWState(step=P(), mu=param_specs, nu=param_specs)
+    velocities, accumulators, f32 master copies) inherit the param specs
+    — this is what shards the optimizer (ZeRO-1) — everything else
+    (step counters) replicates. One generic rule instead of a per-type
+    ladder: any NamedTuple state recurses field-wise, so arbitrarily
+    composed wrappers (schedule(accumulate(master_f32(adamw)))) keep
+    every param-sized buffer sharded without this function knowing their
+    types."""
     p_struct = jax.tree_util.tree_structure(param_specs)
     if jax.tree_util.tree_structure(opt_state) == p_struct:
-        return param_specs  # e.g. sgd momentum: one param-shaped tree
+        return param_specs  # param-shaped subtree: moments, master, acc
+    if isinstance(opt_state, tuple) and hasattr(opt_state, "_fields"):
+        return type(opt_state)(*(
+            opt_state_specs(getattr(opt_state, f), param_specs)
+            for f in opt_state._fields))
     return jax.tree_util.tree_map(lambda _: P(), opt_state)
 
 

@@ -69,6 +69,11 @@ def parse_args(argv=None):
     p.add_argument("--remat", action="store_true",
                    help="Rematerialize each block in backward (less "
                         "activation memory, ~1/3 more FLOPs).")
+    p.add_argument("--master-f32", action="store_true",
+                   help="With --bf16: keep float32 master weights in the "
+                        "optimizer state (standard mixed-precision recipe; "
+                        "raw bf16 params drop updates smaller than ~2^-8 "
+                        "of the weight).")
     p.add_argument("--trace", default=None, type=str,
                    help="Capture an XProf trace of steps 5-10 into DIR.")
     p.add_argument("--log", default=None, type=str,
@@ -201,6 +206,8 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
                                  remat=args.remat, dtype=dtype)
     params = model.init(jax.random.PRNGKey(0))
     optimizer = optim.adamw(args.lr)
+    if args.master_f32:
+        optimizer = optim.with_master_f32(optimizer)
     opt_state = optimizer.init(params)
 
     # ---- checkpoint/resume (utils/checkpoint.py): restore on the host

@@ -200,3 +200,34 @@ class TestRealMultiProcess:
         assert all(p.returncode == 0 for p in procs), "\n".join(outs)
         assert any("proc 0 ok" in o for o in outs)
         assert any("proc 1 ok" in o for o in outs)
+
+
+def test_fsdp_shards_master_f32_and_accum_states():
+    """Composed optimizer wrappers (master-f32, accumulation) must keep
+    their param-sized buffers FSDP-sharded, not silently replicated."""
+    mesh = _mesh8()
+    try:
+        from distributed_pytorch_tpu.optim import (accumulate, adamw,
+                                                   constant,
+                                                   with_master_f32,
+                                                   with_schedule)
+
+        params = {"w": jnp.zeros((64, 64), jnp.bfloat16)}
+        specs = fsdp_param_specs(params, 8, min_size=16)
+        opt = accumulate(with_master_f32(adamw(1e-3)), 2)
+        state = opt.init(params)
+        s = opt_state_specs(state, specs)
+        # acc buffer, master copy, and both moments all carry the param spec
+        assert s.acc == specs
+        assert s.inner.master == specs
+        assert s.inner.inner.mu == specs and s.inner.inner.nu == specs
+        assert s.count == P() and s.inner.inner.step == P()
+
+        # scheduled optimizers shard their inner moments too
+        opt2 = with_schedule(adamw, constant(1e-3))
+        s2 = opt_state_specs(opt2.init(params), specs)
+        assert s2.inner.mu == specs and s2.inner.nu == specs
+        assert s2.step == P()
+    finally:
+        import distributed_pytorch_tpu as dist
+        dist.cleanup()

@@ -254,3 +254,66 @@ class TestPrefetch:
                 break
         assert not any(t.name == "dpx-prefetch" and t.is_alive()
                        for t in threading.enumerate())
+
+
+def test_master_f32_rescues_bf16_training():
+    """bf16 params silently drop updates smaller than ~2^-8 of the weight
+    magnitude; with_master_f32 must track the f32 trajectory while raw
+    bf16 stalls. Also: working params keep bf16, master state is f32."""
+    from distributed_pytorch_tpu.optim import adamw, with_master_f32
+
+    target = 1.05
+    steps, lr = 300, 1e-4  # per-step update ~lr << bf16 ulp at w~1.0
+
+    def grad_at(w):
+        return jax.tree_util.tree_map(
+            lambda x: 2 * (x.astype(jnp.float32) - target).astype(x.dtype),
+            w)
+
+    def train(w0, opt):
+        state = opt.init(w0)
+        w = w0
+        for _ in range(steps):
+            w, state = opt.update(grad_at(w), state, w)
+        return w, state
+
+    w0_f32 = {"w": jnp.ones((64,), jnp.float32)}
+    w0_bf16 = {"w": jnp.ones((64,), jnp.bfloat16)}
+
+    w_f32, _ = train(w0_f32, adamw(lr, weight_decay=0.0))
+    w_raw, _ = train(w0_bf16, adamw(lr, weight_decay=0.0))
+    w_master, st = train(w0_bf16, with_master_f32(adamw(lr,
+                                                        weight_decay=0.0)))
+
+    assert w_master["w"].dtype == jnp.bfloat16      # working dtype kept
+    assert st.master["w"].dtype == jnp.float32      # master is f32
+
+    ref = np.asarray(w_f32["w"], np.float32)
+    err_raw = np.abs(np.asarray(w_raw["w"], np.float32) - ref).mean()
+    err_master = np.abs(np.asarray(st.master["w"],
+                                   np.float32) - ref).mean()
+    moved = np.abs(ref - 1.0).mean()
+    assert moved > 5e-3, "f32 reference must actually move"
+    # raw bf16 lost (almost) all progress; master tracks f32 closely
+    assert err_raw > 0.5 * moved, (err_raw, moved)
+    assert err_master < 0.05 * moved, (err_master, moved)
+
+
+def test_master_f32_composition_with_schedule():
+    """with_master_f32 must wrap OUTSIDE with_schedule; the inside-out
+    composition (which would silently ignore the schedule) is rejected."""
+    from distributed_pytorch_tpu.optim import (adamw, constant,
+                                               with_master_f32,
+                                               with_schedule)
+
+    params = {"w": jnp.ones((4,), jnp.bfloat16)}
+    good = with_master_f32(with_schedule(adamw, constant(1e-3)))
+    state = good.init(params)
+    w, state = good.update({"w": jnp.ones((4,), jnp.bfloat16)}, state,
+                           params)
+    assert w["w"].dtype == jnp.bfloat16
+
+    bad = with_schedule(lambda lr: with_master_f32(adamw(lr)),
+                        constant(1e-3))
+    with pytest.raises(ValueError, match="with_master_f32"):
+        bad.init(params)
