@@ -7,8 +7,10 @@ pool, fc. ``small_input=True`` swaps the stem for the common CIFAR variant
 (3x3/1, no maxpool). BatchNorm running stats thread through an explicit
 state pytree: ``init(key) -> (params, state)``,
 ``apply(params, x, state=state, train=...) -> (logits, new_state)`` —
-per-device batch statistics under DP, matching torch DDP's default
-(unsynced) BatchNorm.
+per-device batch statistics under DP by default, matching torch DDP's
+default (unsynced) BatchNorm; ``sync_bn=True`` computes batch statistics
+over the global batch across the ``dp`` axis (torch ``nn.SyncBatchNorm``),
+which matters at small per-device batches.
 """
 
 from __future__ import annotations
@@ -23,15 +25,16 @@ from ..nn.core import Linear, Module, Params, relu
 
 
 class BasicBlock(Module):
-    def __init__(self, in_ch: int, out_ch: int, stride: int = 1):
+    def __init__(self, in_ch: int, out_ch: int, stride: int = 1,
+                 bn_axis: str = None):
         self.conv1 = Conv2d(in_ch, out_ch, 3, stride=stride, padding=1)
-        self.bn1 = BatchNorm2d(out_ch)
+        self.bn1 = BatchNorm2d(out_ch, axis_name=bn_axis)
         self.conv2 = Conv2d(out_ch, out_ch, 3, stride=1, padding=1)
-        self.bn2 = BatchNorm2d(out_ch)
+        self.bn2 = BatchNorm2d(out_ch, axis_name=bn_axis)
         self.downsample = None
         if stride != 1 or in_ch != out_ch:
             self.downsample = (Conv2d(in_ch, out_ch, 1, stride=stride),
-                               BatchNorm2d(out_ch))
+                               BatchNorm2d(out_ch, axis_name=bn_axis))
 
     def init(self, key) -> Params:
         ks = jax.random.split(key, 3)
@@ -68,18 +71,20 @@ class BasicBlock(Module):
 
 class ResNet18(Module):
     def __init__(self, n_classes: int = 10, in_ch: int = 3,
-                 small_input: bool = False):
+                 small_input: bool = False, sync_bn: bool = False,
+                 bn_axis: str = "dp"):
         self.small_input = small_input
+        axis = bn_axis if sync_bn else None
         if small_input:
             self.stem = Conv2d(in_ch, 64, 3, stride=1, padding=1)
         else:
             self.stem = Conv2d(in_ch, 64, 7, stride=2, padding=3)
-        self.bn_stem = BatchNorm2d(64)
+        self.bn_stem = BatchNorm2d(64, axis_name=axis)
         cfg = [(64, 64, 1), (64, 128, 2), (128, 256, 2), (256, 512, 2)]
         self.stages = []
         for (cin, cout, stride) in cfg:
-            self.stages.append([BasicBlock(cin, cout, stride),
-                                BasicBlock(cout, cout, 1)])
+            self.stages.append([BasicBlock(cin, cout, stride, bn_axis=axis),
+                                BasicBlock(cout, cout, 1, bn_axis=axis)])
         self.fc = Linear(512, n_classes)
 
     def init(self, key) -> Tuple[Params, dict]:

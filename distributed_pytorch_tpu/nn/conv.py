@@ -65,13 +65,24 @@ class Conv2d(Module):
 class BatchNorm2d(Module):
     """BatchNorm over N,H,W with running-stat state (torch semantics:
     train mode uses batch stats and updates running stats with momentum
-    0.1; eval mode uses running stats)."""
+    0.1; eval mode uses running stats).
+
+    ``axis_name`` turns it into **SyncBatchNorm** (torch
+    ``nn.SyncBatchNorm`` under DDP): inside a ``shard_map`` over that
+    mesh axis, batch statistics are computed over the GLOBAL batch (one
+    psum of the per-shard sum/sum-of-squares), and every replica updates
+    identical running stats. Outside any binding of the axis (world-1
+    runs, plain jit) it degrades to local statistics — the framework's
+    0/1/N contract. Note the pure-GSPMD path needs no flag: there the
+    model sees global shapes, so plain ``jnp.mean`` already reduces over
+    the whole batch."""
 
     def __init__(self, ch: int, eps: float = 1e-5, momentum: float = 0.1,
-                 dtype=jnp.float32):
+                 axis_name: Optional[str] = None, dtype=jnp.float32):
         self.ch = ch
         self.eps = eps
         self.momentum = momentum
+        self.axis_name = axis_name
         self.dtype = dtype
 
     def init(self, key) -> Params:
@@ -84,14 +95,32 @@ class BatchNorm2d(Module):
                 "var": jnp.ones((self.ch,), self.dtype),
                 "count": jnp.zeros((), jnp.int32)}
 
+    def _batch_stats(self, x):
+        """(mean, var, n) over N,H,W — cross-replica when ``axis_name``
+        is bound (sum/sum-of-squares psum: one collective, the standard
+        sync-BN form), local otherwise."""
+        n = x.shape[0] * x.shape[1] * x.shape[2]
+        if self.axis_name is None:
+            return jnp.mean(x, axis=(0, 1, 2)), jnp.var(x, axis=(0, 1, 2)), n
+        s = jnp.sum(x, axis=(0, 1, 2))
+        ss = jnp.sum(jnp.square(x), axis=(0, 1, 2))
+        try:
+            s = lax.psum(s, self.axis_name)
+            ss = lax.psum(ss, self.axis_name)
+            n = n * lax.psum(1, self.axis_name)
+        except NameError:
+            pass  # axis not bound here: local stats (0/1-device runs)
+        mean = s / n
+        # E[x^2]-E[x]^2 can go slightly negative from cancellation when
+        # |mean| >> std; clamp like torch SyncBatchNorm or rsqrt NaNs
+        return mean, jnp.maximum(ss / n - jnp.square(mean), 0.0), n
+
     def apply(self, params: Params, x, *, state=None, train: bool = False, **_):
         if train:
-            mean = jnp.mean(x, axis=(0, 1, 2))
-            var = jnp.var(x, axis=(0, 1, 2))
+            mean, var, n = self._batch_stats(x)
             new_state = None
             if state is not None:
                 m = self.momentum
-                n = x.shape[0] * x.shape[1] * x.shape[2]
                 # torch tracks unbiased running var
                 unbiased = var * n / max(n - 1, 1)
                 new_state = {

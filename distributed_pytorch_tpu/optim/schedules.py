@@ -83,11 +83,9 @@ def with_schedule(opt_factory: Callable[[float], Optimizer],
     unit = opt_factory(1.0)
 
     def _has_master(state) -> bool:
-        if isinstance(state, MasterState):
-            return True
-        if isinstance(state, tuple):
-            return any(_has_master(x) for x in state)
-        return False
+        leaves = jax.tree_util.tree_leaves(
+            state, is_leaf=lambda x: isinstance(x, MasterState))
+        return any(isinstance(l, MasterState) for l in leaves)
 
     def init(params):
         inner = unit.init(params)

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..optim import AdamWState, Optimizer
+from ..optim import Optimizer
 from .spmd import SpmdStepOutput
 from .tensor import shard_params
 
@@ -67,7 +67,7 @@ def fsdp_param_specs(params, n_shards: int, *, axis: str = "dp",
         is_leaf=lambda x: x is None)
 
 
-def opt_state_specs(opt_state, param_specs):
+def opt_state_specs(opt_state, param_specs, params=None):
     """Spec tree for an optimizer state: param-shaped subtrees (moments,
     velocities, accumulators, f32 master copies) inherit the param specs
     — this is what shards the optimizer (ZeRO-1) — everything else
@@ -75,20 +75,32 @@ def opt_state_specs(opt_state, param_specs):
     ladder: any NamedTuple state recurses field-wise, so arbitrarily
     composed wrappers (schedule(accumulate(master_f32(adamw)))) keep
     every param-sized buffer sharded without this function knowing their
-    types."""
+    types. Pass ``params`` when available: structure alone cannot tell a
+    scalar step counter from a single-bare-leaf params tree, so the
+    param-shaped test then also requires matching leaf shapes."""
     p_struct = jax.tree_util.tree_structure(param_specs)
-    if jax.tree_util.tree_structure(opt_state) == p_struct:
+
+    def param_shaped(state):
+        if jax.tree_util.tree_structure(state) != p_struct:
+            return False
+        if params is None:
+            return True
+        return all(jnp.shape(a) == jnp.shape(b)
+                   for a, b in zip(jax.tree_util.tree_leaves(state),
+                                   jax.tree_util.tree_leaves(params)))
+
+    if param_shaped(opt_state):
         return param_specs  # param-shaped subtree: moments, master, acc
     if isinstance(opt_state, tuple) and hasattr(opt_state, "_fields"):
         return type(opt_state)(*(
-            opt_state_specs(getattr(opt_state, f), param_specs)
+            opt_state_specs(getattr(opt_state, f), param_specs, params)
             for f in opt_state._fields))
     return jax.tree_util.tree_map(lambda _: P(), opt_state)
 
 
 def shard_model_and_opt(params, opt_state, mesh: Mesh, param_specs):
     """Place params + optimizer state on the mesh per the FSDP layout."""
-    o_specs = opt_state_specs(opt_state, param_specs)
+    o_specs = opt_state_specs(opt_state, param_specs, params=params)
     return (shard_params(params, param_specs, mesh),
             shard_params(opt_state, o_specs, mesh))
 
@@ -112,7 +124,7 @@ def make_fsdp_train_step(loss_fn: Callable, optimizer: Optimizer,
             tree, specs, is_leaf=lambda x: x is None)
 
     def step(params, opt_state, batch):
-        o_specs = opt_state_specs(opt_state, param_specs)
+        o_specs = opt_state_specs(opt_state, param_specs, params=params)
         (loss, metrics), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, batch)
         grads = constrain(grads, param_specs)        # reduce-scatter point

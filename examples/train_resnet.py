@@ -46,6 +46,10 @@ def parse_args(argv=None):
     p.add_argument("--data-size", default=2048, type=int,
                    help="Synthetic dataset size when --data-dir is unset.")
     p.add_argument("--bf16", action="store_true")
+    p.add_argument("--sync-bn", action="store_true",
+                   help="Cross-replica BatchNorm statistics over the dp "
+                        "axis (torch nn.SyncBatchNorm); default matches "
+                        "torch DDP's per-device BN.")
     p.add_argument("--limit-steps", default=None, type=int,
                    help="Cap steps per epoch (smoke runs).")
     p.add_argument("--eval", action="store_true",
@@ -114,7 +118,8 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
             f"exceeds the {len(dataset)}-sample dataset (drop_last): "
             "no full batch to train on")
 
-    model = models.ResNet18(n_classes=10, small_input=True)
+    model = models.ResNet18(n_classes=10, small_input=True,
+                            sync_bn=args.sync_bn)
     params, state = model.init(jax.random.PRNGKey(0))
     if args.bf16:
         params = jax.tree_util.tree_map(

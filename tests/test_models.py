@@ -179,3 +179,100 @@ def test_transformer_remat_same_values_and_grads():
     mem1 = profiler.compiled_memory(jax.grad(loss(m1)), params)
     if mem0.get("temp_size_bytes") and mem1.get("temp_size_bytes"):
         assert mem1["temp_size_bytes"] <= mem0["temp_size_bytes"]
+
+
+class TestSyncBatchNorm:
+    def test_sync_bn_matches_full_batch_stats(self, group8):
+        """SyncBN inside an 8-way shard_map == local BN on the gathered
+        global batch: same outputs, same (replica-identical) running
+        stats."""
+        from jax.sharding import PartitionSpec as P
+
+        from distributed_pytorch_tpu.nn.conv import BatchNorm2d
+        from distributed_pytorch_tpu.runtime import context
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((16, 4, 4, 3)) * 3 + 1,
+                        jnp.float32)
+        bn_sync = BatchNorm2d(3, axis_name="dp")
+        bn_local = BatchNorm2d(3)
+        params = bn_sync.init(jax.random.PRNGKey(0))
+        state = bn_sync.init_state()
+
+        want_y, want_state = bn_local.apply(params, x, state=state,
+                                            train=True)
+
+        mesh = context.get_mesh()
+
+        def island(x):
+            y, ns = bn_sync.apply(params, x, state=state, train=True)
+            return y, ns["mean"], ns["var"]
+
+        y, nm, nv = jax.jit(jax.shard_map(
+            island, mesh=mesh,
+            in_specs=P("dp"), out_specs=(P("dp"), P("dp"), P("dp")),
+            check_vma=False))(x)
+        # outputs equal the full-batch normalization
+        np.testing.assert_allclose(np.asarray(y), np.asarray(want_y),
+                                   rtol=2e-4, atol=2e-5)
+        # every shard's running stats equal the full-batch update
+        nm = np.asarray(nm).reshape(8, -1)
+        nv = np.asarray(nv).reshape(8, -1)
+        for r in range(8):
+            np.testing.assert_allclose(nm[r], np.asarray(want_state["mean"]),
+                                       rtol=2e-4, atol=2e-5)
+            np.testing.assert_allclose(nv[r], np.asarray(want_state["var"]),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_sync_bn_degrades_outside_shard_map(self):
+        """axis_name set but no axis bound (world-1 / plain jit): local
+        statistics, no error — the 0/1/N contract."""
+        from distributed_pytorch_tpu.nn.conv import BatchNorm2d
+
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((4, 2, 2, 3)), jnp.float32)
+        bn_sync = BatchNorm2d(3, axis_name="dp")
+        bn_local = BatchNorm2d(3)
+        params = bn_sync.init(jax.random.PRNGKey(0))
+        y_sync, _ = jax.jit(lambda x: bn_sync.apply(params, x,
+                                                    train=True))(x)
+        y_local, _ = bn_local.apply(params, x, train=True)
+        np.testing.assert_allclose(np.asarray(y_sync),
+                                   np.asarray(y_local),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_resnet_sync_bn_trains(self, group8):
+        """ResNet18(sync_bn=True) trains under the stateful DP step."""
+        from distributed_pytorch_tpu import optim
+        from distributed_pytorch_tpu.ops.losses import cross_entropy
+        from distributed_pytorch_tpu.parallel import (
+            make_stateful_train_step, stack_state)
+        import distributed_pytorch_tpu as dist
+
+        model = models.ResNet18(n_classes=4, small_input=True,
+                                sync_bn=True)
+        params, state = model.init(jax.random.PRNGKey(0))
+        opt = optim.adamw(1e-3)
+        opt_state = opt.init(params)
+
+        def loss_fn(p, st, batch):
+            x, y = batch
+            logits, ns = model.apply(p, x, state=st, train=True)
+            return cross_entropy(logits, y), (ns, {})
+
+        step = make_stateful_train_step(loss_fn, opt, donate=False)
+        rng = np.random.default_rng(0)
+        x = dist.shard_batch(
+            rng.standard_normal((16, 8, 8, 3)).astype(np.float32))
+        y = dist.shard_batch(rng.integers(0, 4, 16).astype(np.int32))
+        params_r = dist.replicate(params)
+        opt_r = dist.replicate(opt_state)
+        state_s = stack_state(state)
+        losses = []
+        out = step(params_r, state_s, opt_r, (x, y))
+        losses.append(float(jnp.mean(out.loss)))
+        for _ in range(4):
+            out = step(out.params, out.state, out.opt_state, (x, y))
+            losses.append(float(jnp.mean(out.loss)))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
