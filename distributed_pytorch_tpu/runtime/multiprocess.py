@@ -10,9 +10,18 @@ wired to the NATIVE host process group (native/dpxhost.cpp) for
 collectives — the c10d/gloo replacement — and propagates child failures to
 the parent like ``join=True``.
 
-Children are forced onto the CPU XLA backend (the accelerator is owned by
-the SPMD controller path; per-rank host processes are the CPU-fallback
-execution model, reference ``distributed.py:57-58``/gloo).
+Device ownership: by default children are forced onto the CPU XLA
+backend — the accelerator belongs to the single-controller SPMD front
+door (two processes cannot share one TPU chip), so per-rank host
+processes are the CPU-fallback execution model (reference
+``distributed.py:57-58``/gloo). On a MULTI-chip host the torch-style
+one-process-per-chip model is available by opt-in:
+``DPX_MULTIPROC_ACCEL=tpu`` gives child rank r exclusive ownership of
+chip r (``TPU_VISIBLE_DEVICES=r``, the TPU analog of the reference's
+``CUDA_VISIBLE_DEVICES`` remapping, reference ``distributed.py:88-91``:
+rank i owns local device i). This environment has a single tunneled
+chip, so that mode is plumbing-tested (children see the right env) but
+its multi-chip execution is validated only by the env contract.
 """
 
 from __future__ import annotations
@@ -32,6 +41,29 @@ _CHILD_ENV = {
     "JAX_PLATFORMS": "cpu",
     "PALLAS_AXON_POOL_IPS": "",
 }
+
+MULTIPROC_ACCEL_ENV = "DPX_MULTIPROC_ACCEL"
+
+
+def _child_env_for_rank(rank: int) -> dict:
+    """Per-rank child environment: CPU by default; with
+    ``DPX_MULTIPROC_ACCEL=tpu`` rank r owns LOCAL chip r exclusively.
+    Unknown values raise — a typo must not silently demote a multi-chip
+    run to CPU."""
+    accel = os.environ.get(MULTIPROC_ACCEL_ENV, "").strip().lower()
+    if accel == "tpu":
+        return {"JAX_PLATFORMS": "tpu",
+                "TPU_VISIBLE_DEVICES": str(rank),
+                # each single-chip process is its own one-proc runtime
+                "TPU_CHIPS_PER_PROCESS_BOUNDS": "1,1,1",
+                "TPU_PROCESS_BOUNDS": "1,1,1",
+                # local chips only: never a shared remote pool tunnel
+                "PALLAS_AXON_POOL_IPS": ""}
+    if accel not in ("", "cpu"):
+        raise ValueError(
+            f"{MULTIPROC_ACCEL_ENV}={accel!r} not supported (use 'tpu', "
+            "'cpu', or unset)")
+    return dict(_CHILD_ENV)
 
 
 def _worker_shim(rank: int, world_size: int, master_port: int,
@@ -67,26 +99,33 @@ def launch_multiprocess(worker_fn: Callable, nprocs: int, *args,
 
     ctx = mp.get_context("spawn")
     err_q = ctx.Queue()
-    child_env = {**_CHILD_ENV, WORKER_TAG_ENV: tag}
-    saved = {k: os.environ.get(k) for k in child_env}
     procs = []
     register_active_tag(tag)
     try:
         try:
-            os.environ.update(child_env)
             for rank in range(nprocs):
-                p = ctx.Process(
-                    target=_worker_shim,
-                    args=(rank, nprocs, port, worker_fn, args, err_q),
-                    daemon=False)
-                p.start()
-                procs.append(p)
-        finally:
-            for k, v in saved.items():
-                if v is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = v
+                child_env = {**_child_env_for_rank(rank),
+                             WORKER_TAG_ENV: tag}
+                saved = {k: os.environ.get(k) for k in child_env}
+                try:
+                    os.environ.update(child_env)
+                    p = ctx.Process(
+                        target=_worker_shim,
+                        args=(rank, nprocs, port, worker_fn, args, err_q),
+                        daemon=False)
+                    p.start()
+                    procs.append(p)
+                finally:
+                    for k, v in saved.items():
+                        if v is None:
+                            os.environ.pop(k, None)
+                        else:
+                            os.environ[k] = v
+        except BaseException:
+            # a failed start must not leave earlier ranks hanging in the
+            # rendezvous waiting for peers that never launched
+            ProcessSupervisor(procs, err_q, grace_s=grace_s).terminate_all()
+            raise
 
         ProcessSupervisor(procs, err_q, grace_s=grace_s).join()
     finally:

@@ -208,3 +208,56 @@ def test_host_ddp_loss_parity_vs_single_process():
 
     np.testing.assert_allclose(results[0], results[1], rtol=1e-6)
     np.testing.assert_allclose(ref, results[0], rtol=2e-5, atol=1e-6)
+
+
+def _env_reporter(rank, world, out_dir):
+    import json
+    import os
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump({"JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS"),
+                   "TPU_VISIBLE_DEVICES":
+                       os.environ.get("TPU_VISIBLE_DEVICES")}, f)
+
+
+class TestPerRankDeviceAssignment:
+    def test_default_children_are_cpu(self, tmp_path):
+        import json
+
+        from distributed_pytorch_tpu.runtime import launch_multiprocess
+
+        launch_multiprocess(_env_reporter, 2, str(tmp_path))
+        for r in range(2):
+            with open(tmp_path / f"rank{r}.json") as f:
+                env = json.load(f)
+            # JAX_PLATFORMS=cpu is what keeps children off the chip;
+            # TPU_VISIBLE_DEVICES is deliberately left alone (ambient)
+            assert env["JAX_PLATFORMS"] == "cpu"
+
+    def test_accel_optin_assigns_chip_per_rank(self, tmp_path, monkeypatch):
+        """DPX_MULTIPROC_ACCEL=tpu: rank r's child owns chip r (the
+        torch one-process-per-device model; reference rank->device
+        mapping, distributed.py:88-91). Plumbing contract only — this
+        host has one chip, so the env is asserted, not the execution."""
+        import json
+
+        from distributed_pytorch_tpu.runtime import launch_multiprocess
+        from distributed_pytorch_tpu.runtime.multiprocess import (
+            MULTIPROC_ACCEL_ENV)
+
+        monkeypatch.setenv(MULTIPROC_ACCEL_ENV, "tpu")
+        launch_multiprocess(_env_reporter, 2, str(tmp_path))
+        for r in range(2):
+            with open(tmp_path / f"rank{r}.json") as f:
+                env = json.load(f)
+            assert env["JAX_PLATFORMS"] == "tpu"
+            assert env["TPU_VISIBLE_DEVICES"] == str(r)
+
+
+    def test_unknown_accel_value_raises(self, monkeypatch):
+        from distributed_pytorch_tpu.runtime import launch_multiprocess
+        from distributed_pytorch_tpu.runtime.multiprocess import (
+            MULTIPROC_ACCEL_ENV)
+
+        monkeypatch.setenv(MULTIPROC_ACCEL_ENV, "gpu")
+        with pytest.raises(ValueError, match="not supported"):
+            launch_multiprocess(_env_reporter, 2, "/tmp")
