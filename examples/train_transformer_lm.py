@@ -29,7 +29,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import distributed_pytorch_tpu as dist
 from distributed_pytorch_tpu import models, optim
-from distributed_pytorch_tpu.data import DataLoader, SyntheticLM
+from distributed_pytorch_tpu.data import (DataLoader, SyntheticLM,
+                                          device_prefetch)
 from distributed_pytorch_tpu.ops import make_flash_attn_fn
 from distributed_pytorch_tpu.ops.losses import cross_entropy_per_example
 from distributed_pytorch_tpu.parallel import (fsdp_param_specs,
@@ -78,6 +79,10 @@ def parse_args(argv=None):
                    help="Capture an XProf trace of steps 5-10 into DIR.")
     p.add_argument("--log", default=None, type=str,
                    help="Line-JSON metrics file.")
+    p.add_argument("--prefetch", default=0, type=int, metavar="N",
+                   help="Prefetch N batches onto device from a background "
+                        "thread (H2D overlaps compute; on remote-tunneled "
+                        "chips the transfer can cost more than the step).")
     p.add_argument("--log-every", default=10, type=int,
                    help="Steps between host syncs (loss fetch + log). "
                         "Between boundaries the loop never blocks, so "
@@ -281,30 +286,44 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
     trace_active = False
     while step < args.steps:
         loader.set_epoch(epoch)
-        for batch in loader.iter_from(skip):
-            if step >= args.steps:
-                break
-            if args.trace and step == min(5, args.steps - 1):
-                profiler.start_trace(args.trace)
-                trace_active = True
-            out = step_fn(params, opt_state, place(batch))
-            params, opt_state = out[0], out[1]
-            pending.append((step, out.loss))
-            if trace_active and (step >= 10 or step == args.steps - 1):
-                jax.block_until_ready(out.loss)
-                profiler.stop_trace()
-                trace_active = False
-            if step % args.log_every == 0 or step == args.steps - 1:
-                loss = sync_pending()
-                if t_run0 is None and step >= 1:
-                    t_run0 = (time.perf_counter(), step)  # past compile
-                if not quiet:
-                    dist.print_primary(f"step {step:>5}  loss {loss:.4f}")
-            if ckpt_mgr is not None and \
-                    ckpt_mgr.save(step, params, opt_state,
-                                  extra={"epoch": epoch}):
-                last_saved = step
-            step += 1
+        # one placement seam: batches leave the iterator device-resident
+        # either way, so the step call is uniform
+        if args.prefetch > 0:
+            it = device_prefetch(loader.iter_from(skip),
+                                 size=args.prefetch, place=place)
+        else:
+            it = map(place, loader.iter_from(skip))
+        try:
+            for batch in it:
+                if step >= args.steps:
+                    break
+                if args.trace and step == min(5, args.steps - 1):
+                    profiler.start_trace(args.trace)
+                    trace_active = True
+                out = step_fn(params, opt_state, batch)
+                params, opt_state = out[0], out[1]
+                pending.append((step, out.loss))
+                if trace_active and (step >= 10 or step == args.steps - 1):
+                    jax.block_until_ready(out.loss)
+                    profiler.stop_trace()
+                    trace_active = False
+                if step % args.log_every == 0 or step == args.steps - 1:
+                    loss = sync_pending()
+                    if t_run0 is None and step >= 1:
+                        t_run0 = (time.perf_counter(), step)  # past compile
+                    if not quiet:
+                        dist.print_primary(
+                            f"step {step:>5}  loss {loss:.4f}")
+                if ckpt_mgr is not None and \
+                        ckpt_mgr.save(step, params, opt_state,
+                                      extra={"epoch": epoch}):
+                    last_saved = step
+                step += 1
+        finally:
+            # breaking at --steps must stop the prefetch worker and free
+            # its queued device batches before eval/generate allocate
+            if hasattr(it, "close"):
+                it.close()
         epoch += 1
         skip = 0
     sync_pending()

@@ -147,3 +147,16 @@ def test_long_context_sp_ring_flash():
         quiet=True, history=h)
     assert len(h) == 5
     assert all(np.isfinite(x) for x in h)
+
+
+def test_transformer_lm_prefetch():
+    """--prefetch N: batches arrive on device from the background thread;
+    losses match the unprefetched run exactly (same data order)."""
+    h0, h1 = [], []
+    args = ["--steps", "6", "--batch-size", "1", "--seq-len", "16",
+            "--dim", "16", "--n-layers", "1", "--n-heads", "2",
+            "--data-size", "64", "--log-every", "1"]
+    dist.launch(train_transformer_lm.main_worker, args, True, h0)
+    dist.launch(train_transformer_lm.main_worker,
+                args + ["--prefetch", "2"], True, h1)
+    np.testing.assert_array_equal(np.asarray(h0), np.asarray(h1))
