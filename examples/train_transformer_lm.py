@@ -54,6 +54,11 @@ def parse_args(argv=None):
     p.add_argument("--n-layers", default=4, type=int)
     p.add_argument("--n-heads", default=8, type=int)
     p.add_argument("--lr", default=3e-4, type=float)
+    p.add_argument("--warmup-steps", default=0, type=int,
+                   help="Linear warmup into cosine decay over --steps "
+                        "(the standard LM schedule); 0 = constant lr.")
+    p.add_argument("--clip-norm", default=0.0, type=float,
+                   help="Clip gradients by global L2 norm; 0 = off.")
     p.add_argument("--text", default=None, type=str,
                    help="Local text file OR directory to byte-tokenize "
                         "(vocab=256; a directory concatenates its "
@@ -210,8 +215,21 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
                                  max_seq=args.seq_len, attn_fn=attn_fn,
                                  remat=args.remat, dtype=dtype)
     params = model.init(jax.random.PRNGKey(0))
-    optimizer = optim.adamw(args.lr)
+    if args.warmup_steps >= args.steps > 0:
+        raise ValueError(
+            f"--warmup-steps {args.warmup_steps} must be < --steps "
+            f"{args.steps} (the cosine phase would never run)")
+    if args.warmup_steps > 0:
+        optimizer = optim.with_schedule(
+            optim.adamw,
+            optim.warmup_cosine(args.lr, args.warmup_steps, args.steps))
+    else:
+        optimizer = optim.adamw(args.lr)
+    if args.clip_norm > 0:
+        optimizer = optim.with_clipping(optimizer, args.clip_norm)
     if args.master_f32:
+        # master wraps OUTSIDE the schedule (with_schedule rejects the
+        # reverse composition)
         optimizer = optim.with_master_f32(optimizer)
     opt_state = optimizer.init(params)
 
