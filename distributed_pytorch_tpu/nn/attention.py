@@ -20,19 +20,30 @@ import jax.numpy as jnp
 from .core import Dropout, LayerNorm, Linear, Module, Params, gelu
 
 
-def dense_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
+def dense_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    window: Optional[int] = None):
     """Reference attention: softmax(q k^T / sqrt(d)) v.
 
     q,k,v: (B, H, S, Dh). Softmax in float32 regardless of input dtype.
-    This is the single-device path; ``parallel.sequence.ring_attention``
-    computes the same function with K/V sharded around the mesh ring.
+    ``window`` (requires ``causal``): sliding-window attention — row i
+    sees keys (i+off-window, i+off] only (off aligns cross-length
+    diagonals). This is the single-device path;
+    ``parallel.sequence.ring_attention`` computes the same function with
+    K/V sharded around the mesh ring, and ``ops.flash_attention`` is the
+    O(S)-memory kernel equivalent.
     """
     *_, s_q, dh = q.shape
     s_k = k.shape[-2]
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     scale = scale if scale is not None else 1.0 / math.sqrt(dh)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        if window is not None:
+            mask &= ~jnp.tril(jnp.ones((s_q, s_k), dtype=bool),
+                              k=s_k - s_q - window)
         logits = jnp.where(mask, logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)

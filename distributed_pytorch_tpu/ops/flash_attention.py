@@ -70,20 +70,31 @@ def _pad_seq(x, block, axis):
     return jnp.pad(x, widths)
 
 
-def _frontier_ok(iq, ik, *, block_q, block_k, q_len, k_len):
+def _frontier_ok(iq, ik, *, block_q, block_k, q_len, k_len, window=None):
     """Whether k-tile ``ik`` intersects the causal-visible region of q-tile
     ``iq``. The ``k_len - q_len`` offset aligns the causal diagonal when
     s_q != s_k (query block i attends through absolute key position
-    i + k_len - q_len). Single source of truth for fwd and both bwd kernels —
-    the masks must never desynchronize or gradients silently break."""
-    return ik * block_k <= (iq + 1) * block_q - 1 + (k_len - q_len)
+    i + k_len - q_len). With a sliding ``window`` the band has a LOWER
+    edge too (row r sees cols (r+off-window, r+off]), so tiles entirely
+    below it are skipped — that skip is what makes windowed attention
+    O(S*window) instead of O(S^2/2). Single source of truth for fwd and
+    both bwd kernels — the masks must never desynchronize or gradients
+    silently break."""
+    off = k_len - q_len
+    ok = ik * block_k <= (iq + 1) * block_q - 1 + off
+    if window is not None:
+        # tile's last col >= the tile's first row's lowest visible col
+        ok = jnp.logical_and(
+            ok, ik * block_k + block_k - 1 >= iq * block_q + off - window + 1)
+    return ok
 
 
 def _tile_mask(iq, ik, *, block_q, block_k, q_len, k_len, causal,
-               mask_pad_rows):
+               mask_pad_rows, window=None):
     """Boolean (block_q, block_k) mask of logits to suppress: padded key
-    columns, the causal future, and (in backward only, where padded q rows
-    would otherwise leak into the dK/dV accumulators) padded query rows.
+    columns, the causal future, positions below the sliding window's
+    lower edge, and (in backward only, where padded q rows would
+    otherwise leak into the dK/dV accumulators) padded query rows.
     In forward, padded-row outputs are sliced away on the host instead."""
     rows = iq * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
@@ -94,6 +105,9 @@ def _tile_mask(iq, ik, *, block_q, block_k, q_len, k_len, causal,
         masked = jnp.logical_or(masked, rows >= q_len)
     if causal:
         masked = jnp.logical_or(masked, cols > rows + (k_len - q_len))
+    if window is not None:
+        masked = jnp.logical_or(
+            masked, cols <= rows + (k_len - q_len) - window)
     return masked
 
 
@@ -103,7 +117,8 @@ def _tile_mask(iq, ik, *, block_q, block_k, q_len, k_len, causal,
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, block_q, block_k, n_k, q_len, k_len):
+                *, scale, causal, window, block_q, block_k, n_k, q_len,
+                k_len):
     iq, ik = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -121,7 +136,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         s = jnp.where(
             _tile_mask(iq, ik, block_q=block_q, block_k=block_k,
                        q_len=q_len, k_len=k_len, causal=causal,
-                       mask_pad_rows=False),
+                       mask_pad_rows=False, window=window),
             _MASK, s)
 
         m_old = m_scr[:, :1]                               # (bq, 1)
@@ -137,7 +152,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     if causal:
         @pl.when(_frontier_ok(iq, ik, block_q=block_q, block_k=block_k,
-                              q_len=q_len, k_len=k_len))
+                              q_len=q_len, k_len=k_len, window=window))
         def _():
             _body()
     else:
@@ -162,7 +177,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                                       lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               window=None):
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
     bq, bk = _block_sizes(s_q, s_k, block_q, block_k)
@@ -174,8 +190,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     n_q, n_k = sq_p // bq, sk_p // bk
 
     kern = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        n_k=n_k, q_len=s_q, k_len=s_k)
+        _fwd_kernel, scale=scale, causal=causal, window=window,
+        block_q=bq, block_k=bk, n_k=n_k, q_len=s_q, k_len=s_k)
     o3, lse3 = pl.pallas_call(
         kern,
         grid=(b * h, n_q, n_k),
@@ -210,22 +226,23 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _recompute_p(q_ref, k_ref, lse_ref, iq, ik, *, scale, causal, block_q,
-                 block_k, q_len, k_len):
+def _recompute_p(q_ref, k_ref, lse_ref, iq, ik, *, scale, causal, window,
+                 block_q, block_k, q_len, k_len):
     """p = exp(qk*scale - lse) for one tile, masked to exact zeros."""
     s = jax.lax.dot_general(
         q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
     masked = _tile_mask(iq, ik, block_q=block_q, block_k=block_k,
                         q_len=q_len, k_len=k_len, causal=causal,
-                        mask_pad_rows=True)
+                        mask_pad_rows=True, window=window)
     p = jnp.exp(jnp.where(masked, _MASK, s) - lse_ref[0][:, :1])
     return jnp.where(masked, 0.0, p)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, block_q, block_k, n_q, q_len, k_len):
+                    *, scale, causal, window, block_q, block_k, n_q, q_len,
+                    k_len):
     ik, iq = pl.program_id(1), pl.program_id(2)
 
     @pl.when(iq == 0)
@@ -235,8 +252,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def _body():
         p = _recompute_p(q_ref, k_ref, lse_ref, iq, ik, scale=scale,
-                         causal=causal, block_q=block_q, block_k=block_k,
-                         q_len=q_len, k_len=k_len)
+                         causal=causal, window=window, block_q=block_q,
+                         block_k=block_k, q_len=q_len, k_len=k_len)
         do = do_ref[0].astype(jnp.float32)
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -251,7 +268,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if causal:
         @pl.when(_frontier_ok(iq, ik, block_q=block_q, block_k=block_k,
-                              q_len=q_len, k_len=k_len))
+                              q_len=q_len, k_len=k_len, window=window))
         def _():
             _body()
     else:
@@ -265,7 +282,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr,
-                   *, scale, causal, block_q, block_k, n_k, q_len, k_len):
+                   *, scale, causal, window, block_q, block_k, n_k, q_len,
+                   k_len):
     iq, ik = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -274,8 +292,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def _body():
         p = _recompute_p(q_ref, k_ref, lse_ref, iq, ik, scale=scale,
-                         causal=causal, block_q=block_q, block_k=block_k,
-                         q_len=q_len, k_len=k_len)
+                         causal=causal, window=window, block_q=block_q,
+                         block_k=block_k, q_len=q_len, k_len=k_len)
         dp = jax.lax.dot_general(
             do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
@@ -286,7 +304,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if causal:
         @pl.when(_frontier_ok(iq, ik, block_q=block_q, block_k=block_k,
-                              q_len=q_len, k_len=k_len))
+                              q_len=q_len, k_len=k_len, window=window))
         def _():
             _body()
     else:
@@ -298,7 +316,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
-               interpret, g_lse=None):
+               interpret, g_lse=None, window=None):
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
     bq, bk = _block_sizes(s_q, s_k, block_q, block_k)
@@ -330,7 +348,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
     row_spec = pl.BlockSpec((1, bq, _STATS), lambda bh, ik, iq: (bh, iq, 0))
     dkv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, n_q=n_q,
+                          window=window, block_q=bq, block_k=bk, n_q=n_q,
                           q_len=s_q, k_len=s_k),
         grid=(b * h, n_k, n_q),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
@@ -349,7 +367,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
     row_spec2 = pl.BlockSpec((1, bq, _STATS), lambda bh, iq, ik: (bh, iq, 0))
     dq3 = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, n_k=n_k,
+                          window=window, block_q=bq, block_k=bk, n_k=n_k,
                           q_len=s_q, k_len=s_k),
         grid=(b * h, n_q, n_k),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
@@ -371,21 +389,26 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret,
+               window):
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                      window=window)
 
 
-def _flash_lse_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+def _flash_lse_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                       window):
+    o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                        window=window)
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_lse_vjp_bwd(causal, scale, block_q, block_k, interpret, res, gs):
+def _flash_lse_vjp_bwd(causal, scale, block_q, block_k, interpret, window,
+                       res, gs):
     q, k, v, o, lse = res
     g_o, g_lse = gs
     return _flash_bwd(q, k, v, o, lse, g_o, causal, scale, block_q,
-                      block_k, interpret, g_lse=g_lse)
+                      block_k, interpret, g_lse=g_lse, window=window)
 
 
 _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
@@ -394,7 +417,8 @@ _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 def flash_attention_with_lse(q, k, v, *, causal: bool = False,
                              scale: Optional[float] = None,
                              block_q: int = 128, block_k: int = 128,
-                             interpret: Optional[bool] = None):
+                             interpret: Optional[bool] = None,
+                             window: Optional[int] = None):
     """Like :func:`flash_attention` but also returns the per-row
     log-sum-exp ``lse`` (B, H, Sq) — DIFFERENTIABLY (the lse cotangent is
     folded into the backward kernels' delta term). This is the building
@@ -406,16 +430,23 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = False,
 
     which is how ring flash attention (parallel/sequence.py) accumulates
     a device's queries over the rotating k/v blocks."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (sliding-window "
+                         "attention is a causal-decoder pattern)")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     *_, dh = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(dh)
     return _flash_lse(q, k, v, causal, float(scale), int(block_q),
-                      int(block_k), interpret)
+                      int(block_k), interpret,
+                      int(window) if window is not None else None)
 
 
 def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    window: Optional[int] = None):
     """Memory-efficient attention: softmax(q k^T * scale) v, blockwise.
 
     Drop-in for :func:`nn.attention.dense_attention` (same signature,
@@ -427,27 +458,30 @@ def flash_attention(q, k, v, *, causal: bool = False,
     code path runs in CPU tests (conftest's 8-device CPU mesh) and
     compiled on real chips.
     """
-    *_, dh = q.shape
-    scale = scale if scale is not None else 1.0 / math.sqrt(dh)
+    o, _ = flash_attention_with_lse(
+        q, k, v, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, interpret=interpret, window=window)
     # single vjp path: the unused lse output gets a zero cotangent, which
     # the backward folds away for free (delta - 0)
-    o, _ = _flash_lse(q, k, v, causal, float(scale), int(block_q),
-                      int(block_k), interpret)
     return o
 
 
 def make_flash_attn_fn(block_q: int = 128, block_k: int = 128,
-                       interpret: Optional[bool] = None):
+                       interpret: Optional[bool] = None,
+                       window: Optional[int] = None):
     """An ``attn_fn`` for :class:`nn.attention.MultiHeadAttention` /
     model constructors: models built with this compute attention through
-    the pallas kernel instead of the dense einsum path."""
+    the pallas kernel instead of the dense einsum path. ``window`` bakes
+    sliding-window (local) attention into the model — O(S*window)
+    compute and the long-context default for causal decoders."""
 
     def attn_fn(q, k, v, *, causal=False, scale=None):
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k,
-                               interpret=interpret)
+                               interpret=interpret, window=window)
 
-    # computes exactly softmax(qk)v — cached decode (models/generate.py)
-    # may substitute its inline core for this one
-    attn_fn.dense_equivalent = True
+    # full-window flash computes exactly softmax(qk)v, so cached decode
+    # (models/generate.py) may substitute its inline core; a sliding
+    # window changes the function and must not be silently swapped
+    attn_fn.dense_equivalent = window is None
     return attn_fn

@@ -110,3 +110,48 @@ def test_mha_with_flash_attn_fn():
     got = mha_flash.apply(params, x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("s_q,s_k,window,bq,bk", [
+    (64, 64, 16, 16, 16),   # window spans exactly one tile
+    (50, 50, 7, 16, 16),    # ragged length, window not tile-aligned
+    (64, 64, 1, 16, 16),    # degenerate: attend to self only
+    (48, 48, 100, 16, 16),  # window larger than sequence == plain causal
+    (32, 64, 8, 16, 16),    # cross lengths: off > 0 shifts the band
+    (24, 48, 5, 8, 8),      # cross lengths, ragged, small blocks
+])
+def test_sliding_window_matches_dense(s_q, s_k, window, bq, bk):
+    """Causal sliding-window attention: values AND grads match the dense
+    masked reference (the lower-edge tile skip must agree with the mask
+    in both backward kernels too, including the cross-length offset that
+    shifts the whole band when s_q != s_k)."""
+    q, k, v = _qkv(jax.random.PRNGKey(7), s_q=s_q, s_k=s_k)
+    want = dense_attention(q, k, v, causal=True, window=window)
+    got = flash_attention(q, k, v, causal=True, window=window,
+                          block_q=bq, block_k=bk)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+    def lf(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       window=window, block_q=bq,
+                                       block_k=bk) ** 2)
+
+    def ld(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True,
+                                       window=window) ** 2)
+
+    g = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+    w = jax.grad(ld, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g, w, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_window_requires_causal():
+    q, k, v = _qkv(jax.random.PRNGKey(8))
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=8)
+    with pytest.raises(ValueError, match="causal"):
+        dense_attention(q, k, v, causal=False, window=8)
