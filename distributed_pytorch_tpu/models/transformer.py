@@ -56,12 +56,18 @@ class TransformerLM(Module):
         }
 
     def apply(self, params: Params, tokens, *, rng=None, train: bool = False,
-              pos_offset=0, **_):
+              pos_offset=0, return_hidden: bool = False, **_):
         """tokens: (B, S) int32 → logits (B, S, vocab).
 
         ``pos_offset`` shifts position ids — under sequence parallelism each
         device holds a local block whose global positions start at
-        ``axis_index(sp) * S_local``."""
+        ``axis_index(sp) * S_local``.
+
+        ``return_hidden=True`` returns the post-final-norm hidden states
+        (B, S, dim) *instead of* logits, skipping the vocab projection — the
+        input contract of ``ops.losses.fused_linear_cross_entropy`` (pass
+        ``params["head"]["w"]`` as its weight), which streams the projection
+        chunkwise so the full (B, S, vocab) logits never materialize."""
         b, s = tokens.shape
         x = self.tok.apply(params["tok"], tokens)
         x = x + self.pos.apply(params["pos"], pos_offset + jnp.arange(s))
@@ -79,4 +85,6 @@ class TransformerLM(Module):
                 run_block = jax.checkpoint(run_block)
             x = run_block(params["blocks"][i], x)
         x = self.ln_f.apply(params["ln_f"], x)
+        if return_hidden:
+            return x
         return self.head.apply(params["head"], x)

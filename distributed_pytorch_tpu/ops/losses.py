@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 def cross_entropy_per_example(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
@@ -22,3 +23,54 @@ def cross_entropy_per_example(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.n
 def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Mean cross-entropy — torch ``CrossEntropyLoss()`` default reduction."""
     return jnp.mean(cross_entropy_per_example(logits, labels))
+
+
+def fused_linear_cross_entropy(hidden: jnp.ndarray, w: jnp.ndarray,
+                               labels: jnp.ndarray, *,
+                               chunk_rows: int = 512) -> jnp.ndarray:
+    """Mean CE of ``softmax(hidden @ w)`` vs ``labels`` without ever
+    materializing the full ``(N, vocab)`` logits.
+
+    For a language model the vocab projection dominates activation memory:
+    at batch 8 x seq 1024 x vocab 32k the logits are 1 GiB in f32, and the
+    standard loss keeps them (plus their cotangent) live across the whole
+    backward. This streams row chunks through a ``lax.scan`` whose body is
+    ``jax.checkpoint``-ed, so the forward saves only the scan inputs and the
+    backward recomputes one ``(chunk, vocab)`` logits tile at a time —
+    activation memory drops from O(N*V) to O(chunk*V), buying batch size
+    (and therefore MFU) on memory-bound configs.
+
+    Each chunk is still a ``(chunk, d) @ (d, vocab)`` matmul — large enough
+    to keep the MXU saturated (use ``chunk_rows`` >= 256). The matmul
+    accumulates in f32 (``preferred_element_type``), which for bf16 inputs
+    is *more* precise than the unfused bf16-logits path at identical MXU
+    cost.
+
+    ``hidden``: (..., d); ``w``: (d, vocab) — the (in, out) layout of
+    ``nn.core.Linear``; ``labels``: integer ids, shape ``hidden.shape[:-1]``.
+    """
+    d = hidden.shape[-1]
+    h = hidden.reshape(-1, d)
+    y = labels.reshape(-1).astype(jnp.int32)
+    n = h.shape[0]
+    c = min(int(chunk_rows), n)
+    n_chunks = -(-n // c)
+    pad = n_chunks * c - n
+    if pad:
+        h = jnp.concatenate([h, jnp.zeros((pad, d), h.dtype)])
+        y = jnp.concatenate([y, jnp.zeros((pad,), y.dtype)])
+    valid = (jnp.arange(n_chunks * c) < n).astype(jnp.float32)
+
+    def body(total, inp):
+        h_i, y_i, m_i = inp
+        logits = jnp.matmul(h_i, w, preferred_element_type=jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        true_logit = jnp.take_along_axis(logits, y_i[:, None], axis=-1)[:, 0]
+        return total + jnp.sum((logz - true_logit) * m_i), None
+
+    total, _ = lax.scan(
+        jax.checkpoint(body),
+        jnp.zeros((), jnp.float32),
+        (h.reshape(n_chunks, c, d), y.reshape(n_chunks, c),
+         valid.reshape(n_chunks, c)))
+    return total / n

@@ -72,6 +72,10 @@ def parse_args(argv=None):
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3 layout instead of replicated DP.")
+    p.add_argument("--fused-ce", action="store_true",
+                   help="stream the vocab projection through "
+                        "fused_linear_cross_entropy: the (B,S,vocab) logits "
+                        "never materialize (frees HBM for batch/seq)")
     p.add_argument("--remat", action="store_true",
                    help="Rematerialize each block in backward (less "
                         "activation memory, ~1/3 more FLOPs).")
@@ -253,10 +257,22 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
     elif args.resume:
         raise ValueError("--resume requires --save DIR")
 
-    def loss_fn(p, batch):
-        x, y = batch
-        per_ex = cross_entropy_per_example(model.apply(p, x), y)
-        return per_ex.mean(), {"nll": per_ex}
+    if args.fused_ce:
+        from distributed_pytorch_tpu.ops.losses import \
+            fused_linear_cross_entropy
+
+        def loss_fn(p, batch):
+            x, y = batch
+            hid = model.apply(p, x, return_hidden=True)
+            loss = fused_linear_cross_entropy(hid, p["head"]["w"], y)
+            # per-example nll is unavailable by design (the full logits
+            # never exist); report the batch mean per example instead
+            return loss, {"nll": jnp.broadcast_to(loss, (x.shape[0],))}
+    else:
+        def loss_fn(p, batch):
+            x, y = batch
+            per_ex = cross_entropy_per_example(model.apply(p, x), y)
+            return per_ex.mean(), {"nll": per_ex}
 
     world = max(world_size, 1)
     if args.fsdp and is_distributed:
@@ -373,9 +389,22 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
             dist.print_primary("eval: holdout smaller than one global "
                                "batch; skipping")
         else:
-            def eval_fn(p, batch):
-                x, y = batch
-                return cross_entropy_per_example(model.apply(p, x), y)
+            if args.fused_ce:
+                # eval must not materialize the full logits either — a
+                # batch that only fits in HBM because of --fused-ce would
+                # OOM here after the whole training run. Broadcasting the
+                # local-batch mean to per-example shape keeps the
+                # make_eval_step contract; with drop_last all shards are
+                # equal-sized, so the mean of means is the exact mean.
+                def eval_fn(p, batch):
+                    x, y = batch
+                    hid = model.apply(p, x, return_hidden=True)
+                    loss = fused_linear_cross_entropy(hid, p["head"]["w"], y)
+                    return jnp.broadcast_to(loss, (x.shape[0],))
+            else:
+                def eval_fn(p, batch):
+                    x, y = batch
+                    return cross_entropy_per_example(model.apply(p, x), y)
 
             # FSDP-sharded params work unchanged (eval_fn is replicated
             # code; the partitioner gathers as needed)
