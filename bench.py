@@ -187,33 +187,53 @@ def bench_min_ddp(n_steps: int = 2000, fused_chunk: int = 100) -> dict:
     xs, ys = _batches(fused_chunk)
     xs, ys = jnp.asarray(xs), jnp.asarray(ys)
 
+    # All fences below are HOST MATERIALIZATIONS (np.asarray of a scalar):
+    # on the tunneled backend jax.block_until_ready can resolve on enqueue
+    # (see benchmarks/fence_probe.py), which made every r02 number a
+    # dispatch-rate measurement. A fetch cannot complete before the value
+    # exists, and chaining steps through params makes the final fetch wait
+    # for the whole run.
+    from distributed_pytorch_tpu.utils.profiler import (fetch_fence,
+                                                        time_steps_amortized)
+
     # per-step path FIRST (the honest number for the reference's per-step
-    # semantics): one jitted call per step, loss materialized every step.
+    # semantics): one jitted call per step, chained; one fetch at the end.
     step = make_train_step(loss_fn, opt, donate=False)
     b0 = (xs[0], ys[0])
     out = step(params, opt_state, b0)
-    jax.block_until_ready(out.loss)
+    fetch_fence(out.loss)
     m = min(n_steps, 500)
+    s_per_step, out = time_steps_amortized(
+        lambda o: step(o.params, o.opt_state, b0), out, m,
+        lambda o: o.loss)
+    per_step_sps = 1.0 / s_per_step
+
+    # per-step latency with the loss materialized on the host EVERY step
+    # (the reference's literal eager semantics, min_DDP.py:110-130) — on a
+    # tunneled backend this is round-trip-bound and says more about the
+    # tunnel than the chip; reported separately for honesty.
     t0 = time.perf_counter()
-    for _ in range(m):
+    for _ in range(20):
         out = step(out.params, out.opt_state, b0)
-    jax.block_until_ready(out.loss)
-    per_step_sps = m / (time.perf_counter() - t0)
+        fetch_fence(out.loss)
+    eager_sps = 20 / (time.perf_counter() - t0)
 
     # scan-fused fast path (different semantics: no per-step host visibility)
     run = make_scan_train_steps(loss_fn, opt, n_steps=fused_chunk)
     p2, o2, losses = run(params, opt_state, (xs, ys))
-    jax.block_until_ready(losses)
+    fetch_fence(losses)
     n_calls = max(n_steps // fused_chunk, 1)
     t0 = time.perf_counter()
     p, o = p2, o2
     for _ in range(n_calls):
         p, o, losses = run(p, o, (xs, ys))
-    jax.block_until_ready(losses)
+    fetch_fence(losses)
     fused_sps = n_calls * fused_chunk / (time.perf_counter() - t0)
 
     return {"steps_per_sec": round(per_step_sps, 1),
-            "fused_steps_per_sec": round(fused_sps, 1)}
+            "per_step_host_loss_steps_per_sec": round(eager_sps, 1),
+            "fused_steps_per_sec": round(fused_sps, 1),
+            "timing_method": "chained dispatch, host-fetch fence"}
 
 
 def bench_torch_cpu_mlp(n_steps: int = 500) -> float:

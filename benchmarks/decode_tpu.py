@@ -42,7 +42,8 @@ def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
     from distributed_pytorch_tpu import models
     from distributed_pytorch_tpu.models import make_generate_fn
     from distributed_pytorch_tpu.models.generate import prefill
-    from distributed_pytorch_tpu.utils.profiler import StepTimer
+    from distributed_pytorch_tpu.utils.profiler import (fetch_fence,
+                                                        time_steps_amortized)
 
     max_seq = prompt_len + max_new
     model = models.TransformerLM(vocab=vocab, dim=dim, n_layers=n_layers,
@@ -56,17 +57,40 @@ def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
     gen = jax.jit(make_generate_fn(model, max_new))
     rng = jax.random.PRNGKey(2)
 
-    timer = StepTimer(warmup=1)               # warmup run owns the compile
-    timer.measure(gen, params, prompt, rng, n=5)
-    t_total = timer.summary()["median_s"]
+    # Amortized timing with host-fetch fencing (block_until_ready can
+    # resolve early on the tunneled backend — benchmarks/fence_probe.py):
+    # successive gen calls are chained through an rng folded with the
+    # previous output, so one final fetch waits for all of them and the
+    # per-call tunnel round trip amortizes over n calls.
+    toks = gen(params, prompt, rng)
+    fetch_fence(toks[:, -1])                  # compile + drain
+
+    def gen_step(state):
+        r, _ = state
+        t = gen(params, prompt, r)
+        return (jax.random.fold_in(r, t[:, -1].sum()), t)
+
+    n_gen = 5
+    t_total, _ = time_steps_amortized(gen_step, (rng, toks), n_gen,
+                                      lambda s: s[1][:, -1])
 
     # prefill timed separately so the decode metrics are decode-only:
     # gen() = one prefill (which also yields the FIRST new token's logits)
-    # + (max_new - 1) scanned decode steps.
+    # + (max_new - 1) scanned decode steps. Chained by perturbing the
+    # prompt with a zero derived from the previous output.
     pf = jax.jit(lambda p, toks: prefill(model, p, toks, max_seq))
-    pf_timer = StepTimer(warmup=1)
-    pf_timer.measure(pf, params, prompt, n=5)
-    t_prefill = pf_timer.summary()["median_s"]
+    out0 = pf(params, prompt)
+    fetch_fence(jax.tree_util.tree_leaves(out0)[0].ravel()[0])
+
+    def pf_step(state):
+        pr, prev = state
+        dep = jax.tree_util.tree_leaves(prev)[0].ravel()[0]
+        pr = pr + (dep * 0).astype(pr.dtype)
+        return (pr, pf(params, pr))
+
+    t_prefill, _ = time_steps_amortized(
+        pf_step, (prompt, out0), 5,
+        lambda s: jax.tree_util.tree_leaves(s[1])[0].ravel()[0])
     decode_steps = max_new - 1
     t_decode = max(t_total - t_prefill, 1e-9)
 

@@ -1,0 +1,116 @@
+"""Characterize the backend's synchronization semantics (tunnel probe).
+
+The axon-tunneled TPU backend produced mutually inconsistent timings
+(BASELINE.md round 3): a per-step MLP at 52us/step, a flat ~72ms floor on
+small attention kernels, and a transformer "step" of 6.8ms that would
+imply 4.4x the chip's peak FLOP/s. This probe decides what a host-side
+fence actually waits for, by timing matmul chains of KNOWN FLOPs three
+ways:
+
+- ``dispatch``: no fence at all (pure enqueue cost)
+- ``block``:    ``jax.block_until_ready`` per call
+- ``fetch``:    ``np.asarray`` of the (scalar) result per call — this
+                materializes bytes on the host and CANNOT resolve before
+                the value exists
+
+and an ``amortized`` mode: K chained calls, one fetch at the end, /K.
+If ``block`` per-call times sit below the analytic minimum (flops/peak)
+while ``fetch`` does not, block_until_ready resolves early on this
+backend and every benchmark must fence by fetching (or amortize).
+
+Usage: python benchmarks/fence_probe.py [--sizes 2048,4096,8192]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+CHAIN = 8  # matmuls per jitted call
+
+
+def make_fn(n):
+    def f(x):
+        y = x
+        for _ in range(CHAIN):
+            y = jnp.matmul(y, x, preferred_element_type=jnp.float32) \
+                   .astype(jnp.bfloat16) / n
+        return jnp.sum(y.astype(jnp.float32))
+    return jax.jit(f)
+
+
+def probe_size(n, peak_flops=197e12, reps=5):
+    f = make_fn(n)
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
+    flops = CHAIN * 2 * n ** 3
+    analytic_min_s = flops / peak_flops
+
+    r = f(x)
+    np.asarray(r)  # warm compile + execute, fully drained
+
+    def timed(fence):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = f(x)
+            if fence == "block":
+                jax.block_until_ready(out)
+            elif fence == "fetch":
+                np.asarray(out)
+            ts.append(time.perf_counter() - t0)
+        if fence == "dispatch":
+            np.asarray(out)  # drain the queue outside the timed region
+        return sorted(ts)[len(ts) // 2]
+
+    t_dispatch = timed("dispatch")
+    t_block = timed("block")
+    t_fetch = timed("fetch")
+
+    # amortized: K dispatches chained by data dependence, one fetch
+    k = 10
+    np.asarray(f(x))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(k):
+        out = f(x)
+    np.asarray(out)
+    t_amort = (time.perf_counter() - t0) / k
+
+    return {"n": n, "tflops_per_call": round(flops / 1e12, 3),
+            "analytic_min_ms": round(analytic_min_s * 1e3, 3),
+            "dispatch_ms": round(t_dispatch * 1e3, 3),
+            "block_ms": round(t_block * 1e3, 3),
+            "fetch_ms": round(t_fetch * 1e3, 3),
+            "amortized_ms": round(t_amort * 1e3, 3),
+            "block_below_physical_min": bool(t_block < analytic_min_s),
+            "fetch_below_physical_min": bool(t_fetch < analytic_min_s)}
+
+
+def main(argv):
+    sizes = [2048, 4096, 8192]
+    if "--sizes" in argv:
+        sizes = [int(s) for s in
+                 argv[argv.index("--sizes") + 1].split(",")]
+    dev = jax.devices()[0]
+    rows = [probe_size(n) for n in sizes]
+    for r in rows:
+        print(f"# n={r['n']}: min {r['analytic_min_ms']}ms  "
+              f"dispatch {r['dispatch_ms']}ms  block {r['block_ms']}ms  "
+              f"fetch {r['fetch_ms']}ms  amortized {r['amortized_ms']}ms",
+              file=sys.stderr)
+    verdict = ("block_until_ready resolves EARLY — fence by fetch/amortize"
+               if any(r["block_below_physical_min"] for r in rows)
+               else "block_until_ready waits for completion")
+    print(json.dumps({"device": dev.device_kind, "verdict": verdict,
+                      "rows": rows}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -78,15 +78,17 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
         n_heads: int = FLAGSHIP["n_heads"], vocab: int = FLAGSHIP["vocab"],
         seq: int = FLAGSHIP["seq"], batch: int = FLAGSHIP["batch"],
         steps: int = 30, dtype=jnp.bfloat16, remat: bool = False,
-        use_flash: bool = True, interpret: Optional[bool] = None) -> dict:
+        use_flash: bool = True, fused_ce: bool = False,
+        interpret: Optional[bool] = None) -> dict:
     from distributed_pytorch_tpu import models, optim
     from distributed_pytorch_tpu.ops import make_flash_attn_fn
-    from distributed_pytorch_tpu.ops.losses import cross_entropy
+    from distributed_pytorch_tpu.ops.losses import (
+        cross_entropy, fused_linear_cross_entropy)
     from distributed_pytorch_tpu.parallel import make_train_step
-    from distributed_pytorch_tpu.utils.profiler import (StepTimer,
-                                                        compiled_stats)
+    from distributed_pytorch_tpu.utils.profiler import (
+        StepTimer, compiled_stats, fetch_fence, time_steps_amortized)
 
-    attn_fn = make_flash_attn_fn(256, 512, interpret=interpret) \
+    attn_fn = make_flash_attn_fn(interpret=interpret) \
         if use_flash else None
     model = models.TransformerLM(vocab=vocab, dim=dim, n_layers=n_layers,
                                  n_heads=n_heads, max_seq=seq,
@@ -96,9 +98,18 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
     opt = optim.adamw(3e-4)
     opt_state = opt.init(params)
 
-    def loss_fn(p, tokens):
-        logits = model.apply(p, tokens[:, :-1]).astype(jnp.float32)
-        return cross_entropy(logits, tokens[:, 1:]), {}
+    if fused_ce:
+        # stream the vocab projection chunkwise — the (B, S, vocab) logits
+        # (1 GiB f32 at the flagship config) never materialize, freeing
+        # HBM for batch (ops/losses.py:fused_linear_cross_entropy)
+        def loss_fn(p, tokens):
+            hid = model.apply(p, tokens[:, :-1], return_hidden=True)
+            return fused_linear_cross_entropy(
+                hid, p["head"]["w"], tokens[:, 1:]), {}
+    else:
+        def loss_fn(p, tokens):
+            logits = model.apply(p, tokens[:, :-1]).astype(jnp.float32)
+            return cross_entropy(logits, tokens[:, 1:]), {}
 
     step = make_train_step(loss_fn, opt, donate=True)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1),
@@ -113,16 +124,29 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
     except Exception:
         xla_flops = 0.0
 
-    timer = StepTimer(warmup=2)
+    # Headline timing: an amortized data-dependent chain with ONE host
+    # materialization at the end. On the tunneled backend here,
+    # block_until_ready can resolve on enqueue (benchmarks/fence_probe.py),
+    # which once produced a physically impossible 4.4 "MFU"; fetching the
+    # final loss transitively waits for all n steps and cannot lie.
     out = step(params, opt_state, tokens)          # compile
-    jax.block_until_ready(out.loss)
-    for _ in range(steps + timer.warmup):
-        with timer.step(fence=None) as h:
+    fetch_fence(out.loss)
+    for _ in range(2):                             # cache warming
+        out = step(out.params, out.opt_state, tokens)
+    fetch_fence(out.loss)
+    step_s, out = time_steps_amortized(
+        lambda o: step(o.params, o.opt_state, tokens), out, steps,
+        lambda o: o.loss)
+
+    # diagnostic: per-step latency with a host-fetch fence each step —
+    # includes one tunnel round trip per step, so it upper-bounds the
+    # true step latency (the gap vs the amortized number is the RTT)
+    lat = StepTimer(warmup=1, fetch=True)
+    for _ in range(5 + lat.warmup):
+        with lat.step() as h:
             out = step(out.params, out.opt_state, tokens)
             h["fence"] = out.loss
-    summ = timer.summary()
-
-    step_s = summ["median_s"]
+    lat_summ = lat.summary()
     tok_per_step = batch * seq
     tokens_per_sec = tok_per_step / step_s
     fwd_fpt = model_flops_per_token(dim, n_layers, vocab, seq)
@@ -139,10 +163,14 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
                    "vocab": vocab, "seq": seq, "batch": batch,
                    "dtype": str(jnp.dtype(dtype).name),
                    "attention": "flash" if use_flash else "dense",
-                   "remat": remat, "optimizer": "adamw"},
+                   "remat": remat, "fused_ce": fused_ce,
+                   "optimizer": "adamw"},
         "n_params": n_params,
-        "steps_timed": summ["steps"],
+        "steps_timed": steps,
+        "timing_method": "amortized_chain_fetch_fence",
         "step_ms_median": round(step_s * 1e3, 3),
+        "per_step_fetch_fenced_ms_median": round(
+            lat_summ["median_s"] * 1e3, 3),
         "tokens_per_sec": round(tokens_per_sec, 1),
         "model_tflops_per_step": round(train_flops_per_step / 1e12, 3),
         "achieved_tflops_per_sec": round(achieved / 1e12, 2),
@@ -153,13 +181,59 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
     }
 
 
+def _flag_val(argv, flag, default, cast=int):
+    if flag in argv:
+        i = argv.index(flag)
+        if i + 1 < len(argv):
+            return cast(argv[i + 1])
+    return default
+
+
+def sweep(arms=None, steps: int = 20) -> dict:
+    """Try several (batch, remat, fused_ce) arms and report the best MFU.
+
+    An arm that OOMs (or otherwise dies) is recorded with its error and
+    skipped — finding the HBM cliff is part of the sweep's job."""
+    if arms is None:
+        arms = [dict(batch=8), dict(batch=8, fused_ce=True),
+                dict(batch=16, fused_ce=True),
+                dict(batch=16, fused_ce=True, remat=True),
+                dict(batch=32, fused_ce=True, remat=True),
+                dict(batch=64, fused_ce=True, remat=True)]
+    results, best = [], None
+    for arm in arms:
+        label = json.dumps(arm, sort_keys=True)
+        try:
+            rec = run(steps=steps, **arm)
+            results.append({"arm": arm, "mfu": rec["mfu"],
+                            "tokens_per_sec": rec["tokens_per_sec"],
+                            "step_ms_median": rec["step_ms_median"]})
+            if best is None or (rec["mfu"] or 0) > (best["mfu"] or 0):
+                best = rec
+        except Exception as e:  # noqa: BLE001 — OOM arms are expected
+            results.append({"arm": arm,
+                            "error": f"{type(e).__name__}: {str(e)[:300]}"})
+        print(f"# arm {label}: {results[-1]}", file=sys.stderr, flush=True)
+    out = dict(best or {"error": "every sweep arm failed"})
+    out["sweep"] = results
+    return out
+
+
 def main(argv):
     remat = "--remat" in argv
-    if "--small" in argv:
+    fused_ce = "--fused-ce" in argv
+    batch = _flag_val(argv, "--batch", None)
+    if "--sweep" in argv:
+        if remat or fused_ce or batch:
+            print("# --sweep runs its own fixed arm grid; "
+                  "--batch/--remat/--fused-ce are ignored", file=sys.stderr)
+        rec = sweep()
+    elif "--small" in argv:
         rec = run(dim=128, n_layers=2, n_heads=4, vocab=512, seq=256,
-                  batch=4, steps=5, remat=remat)
+                  batch=batch or 4, steps=5, remat=remat, fused_ce=fused_ce)
     else:
-        rec = run(remat=remat)
+        rec = run(remat=remat, fused_ce=fused_ce,
+                  **({"batch": batch} if batch else {}))
     # one compact line: collectors parse the last stdout line as JSON
     print(json.dumps(rec))
     return 0

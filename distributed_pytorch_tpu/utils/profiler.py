@@ -22,9 +22,10 @@ from __future__ import annotations
 import contextlib
 import statistics
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
+import numpy as np
 
 
 # ---------------------------------------------------------------------------
@@ -92,10 +93,20 @@ class StepTimer:
     the summary statistics.
     """
 
-    def __init__(self, warmup: int = 1):
+    def __init__(self, warmup: int = 1, fetch: bool = False):
         self.warmup = warmup
+        self.fetch = fetch
         self.times: List[float] = []
         self.warmup_times: List[float] = []
+
+    def _fence(self, x: Any) -> None:
+        if self.fetch:
+            # host materialization — correct even where block_until_ready
+            # resolves early (see fetch_fence); pass a scalar fence so the
+            # transfer is free
+            fetch_fence(x)
+        else:
+            jax.block_until_ready(x)
 
     @contextlib.contextmanager
     def step(self, fence: Any = None):
@@ -106,19 +117,26 @@ class StepTimer:
         finally:
             f = holder.get("fence", fence)
             if f is not None:
-                jax.block_until_ready(f)
+                self._fence(f)
             self._record(time.perf_counter() - t0)
 
-    def measure(self, fn: Callable, *args, n: int = 10, **kwargs):
+    def measure(self, fn: Callable, *args, n: int = 10,
+                fence_of: Optional[Callable] = None, **kwargs):
         """Time ``n`` calls of ``fn`` (plus warmup), fencing each result.
         Returns the last result. Each call runs its own warmup block, so a
         reused timer never counts a fresh function's compile step as a
-        timed sample."""
+        timed sample.
+
+        In fetch mode, pass ``fence_of`` to select a SCALAR from the
+        output to materialize — fetching the whole output pytree of a
+        large-output function would put the device-to-host transfer
+        (~70 ms round trip on the tunneled backend here) inside every
+        timed sample and measure the tunnel instead of the compute."""
         out = None
         for i in range(self.warmup + n):
             t0 = time.perf_counter()
             out = fn(*args, **kwargs)
-            jax.block_until_ready(out)
+            self._fence(fence_of(out) if fence_of is not None else out)
             dt = time.perf_counter() - t0
             (self.warmup_times if i < self.warmup else self.times).append(dt)
         return out
@@ -153,6 +171,46 @@ class StepTimer:
         """items/sec (samples, tokens, images) given a fixed per-step count."""
         s = self.summary()
         return s["steps_per_sec"] * items_per_step if s else 0.0
+
+
+def fetch_fence(x: Any) -> None:
+    """Materialize ``x``'s bytes on the host — the strongest fence.
+
+    ``jax.block_until_ready`` is only as good as the backend's notion of
+    "ready"; on a remote/tunneled backend (the axon TPU path in this
+    environment) it can resolve on enqueue-acknowledge rather than
+    execution completion, silently turning step timings into dispatch
+    timings (benchmarks/fence_probe.py measures this). A device-to-host
+    copy of the value cannot complete before the value exists, so fencing
+    by fetching is correct on every backend. Fetch a SCALAR (e.g. the
+    loss) so the transfer itself costs nothing."""
+    for leaf in jax.tree_util.tree_leaves(x):
+        np.asarray(leaf)
+
+
+def time_steps_amortized(step_fn: Callable, state: Any, n: int,
+                         fence_of: Callable[[Any], Any]) -> Tuple[float, Any]:
+    """Throughput timing that is honest on high-latency backends.
+
+    Runs ``n`` data-dependent iterations ``state = step_fn(state)`` with
+    NO per-step synchronization and ONE host materialization of
+    ``fence_of(final_state)`` at the end. The device executes the steps
+    back-to-back (each step's inputs are the previous step's outputs, so
+    the final fence transitively waits for all n); per-call dispatch
+    latency — which on the tunneled backend here exceeds small-step
+    compute by orders of magnitude — overlaps with device work instead of
+    serializing it.
+
+    ``step_fn`` must already be compiled/warmed on ``state``'s shapes
+    (run one step and fence it first). Returns ``(seconds_per_step,
+    final_state)``. Use for throughput; for per-step latency percentiles
+    use :class:`StepTimer` with a fetch fence and subtract the measured
+    round trip."""
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state = step_fn(state)
+    fetch_fence(fence_of(state))
+    return (time.perf_counter() - t0) / n, state
 
 
 # ---------------------------------------------------------------------------

@@ -244,3 +244,23 @@ class TestProfiler:
     def test_annotate_runs(self):
         with profiler.annotate("region"):
             pass
+
+    def test_fetch_fence_pytree(self):
+        out = jax.jit(lambda x: {"a": x + 1, "b": (x * 2,)})(jnp.ones(4))
+        profiler.fetch_fence(out)  # must not raise, must materialize
+
+    def test_step_timer_fetch_mode(self):
+        timer = profiler.StepTimer(warmup=1, fetch=True)
+        f = jax.jit(lambda x: x @ x)
+        x = jnp.ones((64, 64))
+        timer.measure(f, x, n=3)
+        s = timer.summary()
+        assert s["steps"] == 3 and s["median_s"] > 0
+
+    def test_time_steps_amortized_chains_state(self):
+        f = jax.jit(lambda x: x + 1.0)
+        x0 = jnp.zeros(())
+        per_step, xn = profiler.time_steps_amortized(
+            f, x0, 10, lambda x: x)
+        assert per_step > 0
+        assert float(xn) == 10.0
