@@ -22,10 +22,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from distributed_pytorch_tpu.nn.attention import dense_attention
 from distributed_pytorch_tpu.ops import flash_attention
-from distributed_pytorch_tpu.utils.profiler import StepTimer
+from distributed_pytorch_tpu.utils.profiler import fetch_fence
 
 
 def _qkv(key, b, h, s_q, s_k, d, dtype):
@@ -85,10 +86,37 @@ def validate_numerics():
     return ok
 
 
-def _time_fn(fn, *args, n=20):
-    timer = StepTimer(warmup=2)
-    timer.measure(fn, *args, n=n)
-    return timer.summary()["median_s"]
+R_INNER = 100   # kernel invocations fused into one XLA call
+N_CALLS = 2     # chained dispatches of that call
+
+
+def _time_kernel(scalar_fn, q, k, v):
+    """Per-invocation seconds of ``scalar_fn(q, k, v) -> scalar``, honest
+    on the high-latency tunneled backend: R_INNER serial invocations run
+    inside ONE jitted ``lax.scan`` (the carry perturbs q, so the
+    loop-invariant body cannot be hoisted — and since the carry is
+    ~1e-27, ``q + c`` rounds back to exactly q for any element above one
+    ulp of that, so the perturbation is numerically free while remaining
+    opaque to the compiler), N_CALLS dispatches are chained through that
+    carry, and a single host fetch of the final scalar transitively waits
+    for all of it. Per-call dispatch latency —
+    which dwarfs these kernels' compute — amortizes over N_CALLS*R_INNER
+    invocations instead of gating each one (see fence_probe.py)."""
+    def repeated(q, k, v, c0):
+        def body(c, _):
+            out = scalar_fn(q + c.astype(q.dtype), k, v)
+            return out.astype(jnp.float32) * 1e-30, None
+        c, _ = lax.scan(body, c0, None, length=R_INNER)
+        return c
+    f = jax.jit(repeated)
+
+    c = jnp.zeros((), jnp.float32)
+    fetch_fence(f(q, k, v, c))           # compile + warm, fully drained
+    t0 = time.perf_counter()
+    for _ in range(N_CALLS):
+        c = f(q, k, v, c)
+    fetch_fence(c)
+    return (time.perf_counter() - t0) / (N_CALLS * R_INNER)
 
 
 def speedup_table(dtype=jnp.bfloat16, b=4, h=8, d=64):
@@ -97,9 +125,14 @@ def speedup_table(dtype=jnp.bfloat16, b=4, h=8, d=64):
     for s in (512, 1024, 2048, 4096):
         q, k, v = _qkv(jax.random.PRNGKey(2), b, h, s, s, d, dtype)
 
-        flash_f = jax.jit(lambda q, k, v: flash_attention(
-            q, k, v, causal=True, interpret=False))
-        dense_f = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=True))
+        def fwd_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           interpret=False)
+                           .astype(jnp.float32))
+
+        def fwd_dense(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, causal=True)
+                           .astype(jnp.float32))
 
         def loss_flash(q, k, v):
             return jnp.sum(flash_attention(q, k, v, causal=True,
@@ -110,13 +143,20 @@ def speedup_table(dtype=jnp.bfloat16, b=4, h=8, d=64):
             return jnp.sum(dense_attention(q, k, v, causal=True)
                            .astype(jnp.float32) ** 2)
 
-        flash_g = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
-        dense_g = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))
+        def grad_scalar(loss):
+            g = jax.grad(loss, argnums=(0, 1, 2))
 
-        tf = _time_fn(flash_f, q, k, v)
-        td = _time_fn(dense_f, q, k, v)
-        tfg = _time_fn(flash_g, q, k, v)
-        tdg = _time_fn(dense_g, q, k, v)
+            def f(q, k, v):
+                dq, dk, dv = g(q, k, v)
+                return (jnp.sum(dq.astype(jnp.float32))
+                        + jnp.sum(dk.astype(jnp.float32))
+                        + jnp.sum(dv.astype(jnp.float32)))
+            return f
+
+        tf = _time_kernel(fwd_flash, q, k, v)
+        td = _time_kernel(fwd_dense, q, k, v)
+        tfg = _time_kernel(grad_scalar(loss_flash), q, k, v)
+        tdg = _time_kernel(grad_scalar(loss_dense), q, k, v)
         # causal attention FLOPs: ~half the full 4*B*H*S^2*D (fwd, qk+pv)
         fwd_flops = 4 * b * h * s * s * d / 2
         rows.append({

@@ -1,0 +1,70 @@
+"""On-chip block-size sweep for the flash attention kernel.
+
+The kernel's cost at moderate sequence lengths is dominated by grid-step
+count (per-step fixed overhead + per-tile mask/stat VPU work), not MXU
+time, so (block_q, block_k) is the first-order tuning knob. This sweeps
+tilings per sequence length, timed with the amortized scan-repeat method
+(see flash_attention_tpu._time_kernel) and prints the best per seq —
+those become the kernel's dispatch-table defaults.
+
+Usage: python benchmarks/flash_block_sweep.py [--fwdbwd]
+"""
+
+import itertools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from benchmarks.flash_attention_tpu import _qkv, _time_kernel
+from distributed_pytorch_tpu.ops import flash_attention
+
+
+def main(argv):
+    grad_mode = "--fwdbwd" in argv
+    b, h, d = 4, 8, 64
+    dtype = jnp.bfloat16
+    blocks = [128, 256, 512, 1024]
+    table = {}
+    for s in (512, 1024, 2048, 4096):
+        q, k, v = _qkv(jax.random.PRNGKey(2), b, h, s, s, d, dtype)
+        results = []
+        for bq, bk in itertools.product(blocks, blocks):
+            if bq > s or bk > s:
+                continue
+
+            def fwd(q, k, v, _bq=bq, _bk=bk):
+                return jnp.sum(flash_attention(
+                    q, k, v, causal=True, block_q=_bq, block_k=_bk,
+                    interpret=False).astype(jnp.float32))
+
+            if grad_mode:
+                g = jax.grad(fwd, argnums=(0, 1, 2))
+                fn = lambda q, k, v, _g=g: sum(
+                    jnp.sum(x.astype(jnp.float32)) for x in _g(q, k, v))
+            else:
+                fn = fwd
+            try:
+                t = _time_kernel(fn, q, k, v)
+            except Exception as e:  # noqa: BLE001 — VMEM overflow arms
+                print(f"# s={s} bq={bq} bk={bk}: "
+                      f"{type(e).__name__}", file=sys.stderr, flush=True)
+                continue
+            results.append({"bq": bq, "bk": bk, "ms": round(t * 1e3, 3)})
+            print(f"# s={s} bq={bq} bk={bk}: {t*1e3:.3f}ms",
+                  file=sys.stderr, flush=True)
+        results.sort(key=lambda r: r["ms"])
+        table[s] = results
+        print(f"# s={s} best: {results[0]}", file=sys.stderr, flush=True)
+    print(json.dumps({"mode": "fwdbwd" if grad_mode else "fwd",
+                      "best": {s: r[0] for s, r in table.items()},
+                      "all": table}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

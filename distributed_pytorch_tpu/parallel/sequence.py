@@ -103,7 +103,8 @@ def make_ring_attn_fn(axis_name: str = "sp"):
 def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
                          causal: bool = False,
                          scale: Optional[float] = None,
-                         block_q: int = 128, block_k: int = 128,
+                         block_q: Optional[int] = None,
+                         block_k: Optional[int] = None,
                          interpret: Optional[bool] = None):
     """Ring attention with the pallas FLASH kernel as the per-block core.
 
@@ -157,8 +158,9 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
     return o_acc.astype(q.dtype)
 
 
-def make_ring_flash_attn_fn(axis_name: str = "sp", block_q: int = 128,
-                            block_k: int = 128,
+def make_ring_flash_attn_fn(axis_name: str = "sp",
+                            block_q: Optional[int] = None,
+                            block_k: Optional[int] = None,
                             interpret: Optional[bool] = None):
     """``attn_fn`` drop-in running :func:`ring_flash_attention` — the
     long-context fast path: sequence-parallel ring over ICI with the

@@ -40,7 +40,7 @@ class SpmdStepOutput(NamedTuple):
 
 def make_gspmd_ring_attn_fn(mesh: Mesh, *, dp: str = "dp", tp: str = "tp",
                             sp: str = "sp", core: str = "dense",
-                            block_q: int = 128, block_k: int = 128,
+                            block_q=None, block_k=None,
                             interpret=None):
     """An ``attn_fn`` for use INSIDE a GSPMD-jitted model: a shard_map
     island that runs ring attention over the ``sp`` axis while batch/heads
