@@ -59,9 +59,10 @@ def main(argv):
                   file=sys.stderr, flush=True)
         results.sort(key=lambda r: r["ms"])
         table[s] = results
-        print(f"# s={s} best: {results[0]}", file=sys.stderr, flush=True)
+        print(f"# s={s} best: {results[0] if results else 'ALL FAILED'}",
+              file=sys.stderr, flush=True)
     print(json.dumps({"mode": "fwdbwd" if grad_mode else "fwd",
-                      "best": {s: r[0] for s, r in table.items()},
+                      "best": {s: r[0] for s, r in table.items() if r},
                       "all": table}))
     return 0
 

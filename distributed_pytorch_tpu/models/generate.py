@@ -8,13 +8,16 @@ prefill-then-decode pipeline here is two XLA programs (one prefill, one
 carry — no per-token host round trips, no dynamic shapes.
 
 Design notes (TPU-first):
-- The cache is preallocated at ``max_len`` per layer ((B, H, max, Dh) for
-  K and V); each step writes one slot with ``dynamic_update_slice`` and
-  attends over the full buffer under a position mask. Static shapes keep
-  XLA happy; the masked tail costs FLOPs but no recompilation.
-- Decode attention is a (B, H, 1, max) x (B, H, max, Dh) matmul pair —
-  bandwidth-bound as always for single-token decoding; the cache layout
-  keeps the contraction on the MXU's fast axis.
+- The cache is preallocated at ``max_len`` per layer ((B, Hkv, max, Dh)
+  for K and V — Hkv = ``model.n_kv_heads``, so GQA shrinks the cache by
+  the group factor); each step writes one slot with
+  ``dynamic_update_slice`` and attends over the full buffer under a
+  position mask. Static shapes keep XLA happy; the masked tail costs
+  FLOPs but no recompilation.
+- Decode attention is a (B, Hkv, g, 1, max) x (B, Hkv, max, Dh) grouped
+  matmul pair — bandwidth-bound as always for single-token decoding (GQA
+  cuts exactly that cache bandwidth); the cache layout keeps the
+  contraction on the MXU's fast axis.
 - Sampling (greedy / temperature / top-k) happens on-device inside the
   scan; the host sees only the final (B, steps) token block.
 
@@ -38,8 +41,8 @@ Params = Dict[str, Any]
 
 
 class KVCache(NamedTuple):
-    k: Any        # list-like pytree of (B, H, max_len, Dh) per layer
-    v: Any
+    k: Any        # list-like pytree of (B, Hkv, max_len, Dh) per layer
+    v: Any        # (Hkv = model.n_kv_heads: GQA shrinks the cache)
     length: jnp.ndarray   # () int32 — number of valid positions
 
 
@@ -52,7 +55,8 @@ def init_cache(model: TransformerLM, batch: int, max_len: int,
                dtype=None) -> KVCache:
     dtype = dtype or model.dtype
     dh = model.dim // model.n_heads
-    shape = (batch, model.n_heads, max_len, dh)
+    h_kv = getattr(model, "n_kv_heads", model.n_heads)
+    shape = (batch, h_kv, max_len, dh)
     zeros = lambda: [jnp.zeros(shape, dtype) for _ in range(model.n_layers)]
     return KVCache(k=zeros(), v=zeros(), length=jnp.zeros((), jnp.int32))
 
@@ -109,12 +113,18 @@ def decode_step(model: TransformerLM, params: Params, cache: KVCache,
             cache.v[i], hv.astype(cache.v[i].dtype), (0, 0, idx, 0))
         new_k.append(k)
         new_v.append(v)
-        logits = jnp.einsum("bhqd,bhkd->bhqk", hq, k).astype(
-            jnp.float32) * scale                               # (B,H,1,max)
-        logits = jnp.where(pos_mask[None, None, None, :], logits,
+        # grouped einsum: hq (B,H,1,Dh) vs cache (B,Hkv,max,Dh) — under
+        # GQA the H/Hkv query heads of a group read the same cache head
+        bq, hh, _, dd = hq.shape
+        hkv = k.shape[1]
+        hq_g = hq.reshape(bq, hkv, hh // hkv, 1, dd)
+        logits = jnp.einsum("bngqd,bnkd->bngqk", hq_g, k).astype(
+            jnp.float32) * scale                            # (B,Hkv,g,1,max)
+        logits = jnp.where(pos_mask[None, None, None, None, :], logits,
                            -jnp.inf)
         probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-        o = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        o = jnp.einsum("bngqk,bnkd->bngqd", probs, v) \
+            .reshape(bq, hh, 1, dd)
         x = x + blk.attn.project_out(p["attn"], o)
         x = x + blk.mlp(p, x)
 

@@ -26,12 +26,16 @@ class TransformerLM(Module):
 
     def __init__(self, vocab: int = 256, dim: int = 128, n_layers: int = 2,
                  n_heads: int = 4, max_seq: int = 512, mlp_ratio: int = 4,
-                 dropout: float = 0.0, attn_fn: Optional[Callable] = None,
+                 dropout: float = 0.0, n_kv_heads: Optional[int] = None,
+                 attn_fn: Optional[Callable] = None,
                  remat: bool = False, dtype=jnp.float32):
         self.vocab = vocab
         self.dim = dim
         self.n_layers = n_layers
         self.n_heads = n_heads
+        # GQA: n_kv_heads < n_heads shrinks k/v projections and the
+        # decode KV cache by the group factor (nn/attention.py)
+        self.n_kv_heads = n_kv_heads if n_kv_heads is not None else n_heads
         self.max_seq = max_seq
         self.remat = remat
         self.dtype = dtype
@@ -39,7 +43,8 @@ class TransformerLM(Module):
         self.pos = Embedding(max_seq, dim, dtype=dtype)
         self.blocks = [
             TransformerBlock(dim, n_heads, mlp_ratio, causal=True,
-                             dropout=dropout, attn_fn=attn_fn, dtype=dtype)
+                             dropout=dropout, n_kv_heads=n_kv_heads,
+                             attn_fn=attn_fn, dtype=dtype)
             for _ in range(n_layers)
         ]
         self.ln_f = LayerNorm(dim, dtype=dtype)

@@ -25,7 +25,10 @@ def dense_attention(q, k, v, *, causal: bool = False,
                     window: Optional[int] = None):
     """Reference attention: softmax(q k^T / sqrt(d)) v.
 
-    q,k,v: (B, H, S, Dh). Softmax in float32 regardless of input dtype.
+    q: (B, H, S, Dh); k, v: (B, Hkv, S, Dh) where Hkv divides H —
+    Hkv < H is grouped-query attention (each kv head serves H/Hkv query
+    heads), computed via a grouped einsum so the kv tensors are never
+    repeated in memory. Softmax in float32 regardless of input dtype.
     ``window`` (requires ``causal``): sliding-window attention — row i
     sees keys (i+off-window, i+off] only (off aligns cross-length
     diagonals). This is the single-device path;
@@ -33,12 +36,16 @@ def dense_attention(q, k, v, *, causal: bool = False,
     K/V sharded around the mesh ring, and ``ops.flash_attention`` is the
     O(S)-memory kernel equivalent.
     """
-    *_, s_q, dh = q.shape
-    s_k = k.shape[-2]
+    b, h, s_q, dh = q.shape
+    h_kv, s_k = k.shape[-3], k.shape[-2]
+    if h % h_kv:
+        raise ValueError(f"n_heads {h} not divisible by kv heads {h_kv}")
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
     scale = scale if scale is not None else 1.0 / math.sqrt(dh)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    qg = q.reshape(b, h_kv, h // h_kv, s_q, dh)
+    logits = jnp.einsum("bngqd,bnkd->bngqk", qg, k).astype(jnp.float32) \
+        * scale
     if causal:
         mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
         if window is not None:
@@ -46,7 +53,8 @@ def dense_attention(q, k, v, *, causal: bool = False,
                               k=s_k - s_q - window)
         logits = jnp.where(mask, logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.einsum("bngqk,bnkd->bngqd", probs, v) \
+        .reshape(b, h, s_q, dh)
 
 
 class MultiHeadAttention(Module):
@@ -58,15 +66,24 @@ class MultiHeadAttention(Module):
     """
 
     def __init__(self, dim: int, n_heads: int, *, causal: bool = False,
+                 n_kv_heads: Optional[int] = None,
                  attn_fn: Optional[Callable] = None, dtype=jnp.float32):
         if dim % n_heads:
             raise ValueError(f"dim {dim} not divisible by n_heads {n_heads}")
         self.dim = dim
         self.n_heads = n_heads
+        self.n_kv_heads = n_kv_heads if n_kv_heads is not None else n_heads
+        if n_heads % self.n_kv_heads:
+            raise ValueError(f"n_heads {n_heads} not divisible by "
+                             f"n_kv_heads {self.n_kv_heads}")
         self.head_dim = dim // n_heads
         self.causal = causal
         self.attn_fn = attn_fn or dense_attention
-        self.qkv = Linear(dim, 3 * dim, dtype=dtype)
+        # GQA (n_kv_heads < n_heads) shrinks the k/v projections and the
+        # decode KV cache by n_heads/n_kv_heads; with the default the
+        # parameter tree is identical to classic MHA.
+        kv_dim = self.n_kv_heads * self.head_dim
+        self.qkv = Linear(dim, dim + 2 * kv_dim, dtype=dtype)
         self.out = Linear(dim, dim, dtype=dtype)
 
     def init(self, key) -> Params:
@@ -74,15 +91,18 @@ class MultiHeadAttention(Module):
         return {"qkv": self.qkv.init(k1), "out": self.out.init(k2)}
 
     def project_qkv(self, params: Params, x):
-        """x (B, S, D) → q, k, v each (B, H, S, Dh), via the fused qkv
-        matmul. The single source of truth for the qkv memory layout —
-        the cached decode path (models/generate.py) builds its KV cache
-        through this method."""
+        """x (B, S, D) → q (B, H, S, Dh), k, v (B, Hkv, S, Dh), via the
+        fused qkv matmul. The single source of truth for the qkv memory
+        layout — the cached decode path (models/generate.py) builds its
+        KV cache through this method."""
         b, s, _ = x.shape
-        qkv = self.qkv.apply(params["qkv"], x)           # (B, S, 3D) one matmul
-        qkv = qkv.reshape(b, s, 3, self.n_heads, self.head_dim)
-        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
-        return q, k, v
+        dh, h, hkv = self.head_dim, self.n_heads, self.n_kv_heads
+        qkv = self.qkv.apply(params["qkv"], x)      # (B, S, (H+2Hkv)*Dh)
+        q, k, v = jnp.split(qkv, [h * dh, (h + hkv) * dh], axis=-1)
+
+        def heads(t, n):
+            return t.reshape(b, s, n, dh).transpose(0, 2, 1, 3)
+        return heads(q, h), heads(k, hkv), heads(v, hkv)
 
     def project_out(self, params: Params, o):
         """o (B, H, S, Dh) → output projection (B, S, D)."""
@@ -101,9 +121,11 @@ class TransformerBlock(Module):
 
     def __init__(self, dim: int, n_heads: int, mlp_ratio: int = 4, *,
                  causal: bool = False, dropout: float = 0.0,
+                 n_kv_heads: Optional[int] = None,
                  attn_fn: Optional[Callable] = None, dtype=jnp.float32):
         self.ln1 = LayerNorm(dim, dtype=dtype)
         self.attn = MultiHeadAttention(dim, n_heads, causal=causal,
+                                       n_kv_heads=n_kv_heads,
                                        attn_fn=attn_fn, dtype=dtype)
         self.ln2 = LayerNorm(dim, dtype=dtype)
         self.fc1 = Linear(dim, mlp_ratio * dim, dtype=dtype)

@@ -58,7 +58,7 @@ def _ceil128(s):
     return -(-s // 128) * 128
 
 
-def _block_sizes(s_q, s_k, block_q, block_k, d=64, bwd=False):
+def _block_sizes(s_q, s_k, block_q, block_k, d=64, bwd=False, window=None):
     """Resolve tile sizes. Explicit ints behave as before (clamped to the
     sequence); ``None`` picks the measured-best default for the chip.
 
@@ -68,12 +68,17 @@ def _block_sizes(s_q, s_k, block_q, block_k, d=64, bwd=False):
     14.2ms) and XLA's dense path by up to 8.5x. Backward caps at 512 —
     its three (bq, bk) f32 tiles (p, dp, ds) triple the VMEM bill, and
     (512,512) measured within 8% of the s=1024 optimum. Caps shrink with
-    head_dim since every tile scales with d."""
+    head_dim since every tile scales with d. With sliding-window
+    attention the k cap clamps near the window width instead — a k tile
+    much wider than the band would compute mostly-masked logits and
+    degrade the O(S*window) cost toward O(S*block_k)."""
     cap = (512 if d <= 64 else 256) if bwd else \
         (1024 if d <= 64 else (512 if d <= 128 else 256))
+    cap_k = min(cap, max(128, _ceil128(window))) if window is not None \
+        else cap
     bq = min(cap, _ceil128(s_q)) if block_q is None \
         else max(min(block_q, s_q), 1)
-    bk = min(cap, _ceil128(s_k)) if block_k is None \
+    bk = min(cap_k, _ceil128(s_k)) if block_k is None \
         else max(min(block_k, s_k), 1)
     return bq, bk
 
@@ -200,15 +205,36 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                                       lse_ref.shape[1:])
 
 
+def _kv_head_group(h: int, h_kv: int):
+    """Validate grouped-query head counts; return the group size g.
+
+    GQA (g q-heads share one kv-head) costs the kernels NOTHING extra:
+    the kv BlockSpec index map (:func:`_kv_index`) sends the q-head-major
+    grid index to its kv block — the shared kv tile is simply read by g
+    programs, never replicated in HBM."""
+    if h % h_kv:
+        raise ValueError(f"n_heads {h} not divisible by kv heads {h_kv}")
+    return h // h_kv
+
+
+def _kv_index(bh, h, h_kv, g):
+    """Grid index ``bh = bi*h + hi`` -> kv block ``bi*h_kv + hi//g``.
+    The ONE definition of the GQA head mapping, shared by the forward and
+    both backward kernels' BlockSpecs — if fwd and bwd ever addressed kv
+    differently, gradients would silently be wrong."""
+    return bh // h * h_kv + bh % h // g
+
+
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
                window=None):
     b, h, s_q, d = q.shape
-    s_k = k.shape[2]
-    bq, bk = _block_sizes(s_q, s_k, block_q, block_k, d=d)
+    h_kv, s_k = k.shape[1], k.shape[2]
+    g = _kv_head_group(h, h_kv)
+    bq, bk = _block_sizes(s_q, s_k, block_q, block_k, d=d, window=window)
 
     q3 = _pad_seq(q.reshape(b * h, s_q, d), bq, 1)
-    k3 = _pad_seq(k.reshape(b * h, s_k, d), bk, 1)
-    v3 = _pad_seq(v.reshape(b * h, s_k, d), bk, 1)
+    k3 = _pad_seq(k.reshape(b * h_kv, s_k, d), bk, 1)
+    v3 = _pad_seq(v.reshape(b * h_kv, s_k, d), bk, 1)
     sq_p, sk_p = q3.shape[1], k3.shape[1]
     n_q, n_k = sq_p // bq, sk_p // bk
 
@@ -220,8 +246,12 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
         grid=(b * h, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda bh, iq, ik: (_kv_index(bh, h, h_kv, g),
+                                             ik, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda bh, iq, ik: (_kv_index(bh, h, h_kv, g),
+                                             ik, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
@@ -342,8 +372,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
                interpret, g_lse=None, window=None):
     b, h, s_q, d = q.shape
-    s_k = k.shape[2]
-    bq, bk = _block_sizes(s_q, s_k, block_q, block_k, d=d, bwd=True)
+    h_kv, s_k = k.shape[1], k.shape[2]
+    grp = _kv_head_group(h, h_kv)
+    bq, bk = _block_sizes(s_q, s_k, block_q, block_k, d=d, bwd=True,
+                          window=window)
     interp = _interpret_default(interpret)
 
     # delta_i = sum_d dO_i * O_i — tiny elementwise+reduce; XLA fuses it.
@@ -355,8 +387,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
         delta = delta - g_lse.astype(jnp.float32)
 
     q3 = _pad_seq(q.reshape(b * h, s_q, d), bq, 1)
-    k3 = _pad_seq(k.reshape(b * h, s_k, d), bk, 1)
-    v3 = _pad_seq(v.reshape(b * h, s_k, d), bk, 1)
+    k3 = _pad_seq(k.reshape(b * h_kv, s_k, d), bk, 1)
+    v3 = _pad_seq(v.reshape(b * h_kv, s_k, d), bk, 1)
     g3 = _pad_seq(g.reshape(b * h, s_q, d), bq, 1)
     # Row stats replicated to a narrow (BH, S, _STATS) trailing axis — see
     # the lse layout note in _fwd_kernel.
@@ -368,15 +400,21 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
     n_q, n_k = sq_p // bq, sk_p // bk
 
     q_spec = pl.BlockSpec((1, bq, d), lambda bh, ik, iq: (bh, iq, 0))
-    kv_spec = pl.BlockSpec((1, bk, d), lambda bh, ik, iq: (bh, ik, 0))
+    kv_spec = pl.BlockSpec((1, bk, d),
+                           lambda bh, ik, iq: (_kv_index(bh, h, h_kv, grp),
+                                               ik, 0))
+    dkv_spec = pl.BlockSpec((1, bk, d), lambda bh, ik, iq: (bh, ik, 0))
     row_spec = pl.BlockSpec((1, bq, _STATS), lambda bh, ik, iq: (bh, iq, 0))
+    # dK/dV are written PER Q-HEAD (grid programs may not reduce into a
+    # shared output block) and group-summed by XLA below — one extra
+    # (B, H, Sk, D) temp, only when grp > 1.
     dkv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           window=window, block_q=bq, block_k=bk, n_q=n_q,
                           q_len=s_q, k_len=s_k),
         grid=(b * h, n_k, n_q),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
-        out_specs=[kv_spec, kv_spec],
+        out_specs=[dkv_spec, dkv_spec],
         out_shape=[jax.ShapeDtypeStruct((b * h, sk_p, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, sk_p, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
@@ -387,7 +425,9 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
     dk3, dv3 = dkv
 
     q_spec2 = pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0))
-    kv_spec2 = pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0))
+    kv_spec2 = pl.BlockSpec((1, bk, d),
+                            lambda bh, iq, ik: (_kv_index(bh, h, h_kv, grp),
+                                                ik, 0))
     row_spec2 = pl.BlockSpec((1, bq, _STATS), lambda bh, iq, ik: (bh, iq, 0))
     dq3 = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -405,6 +445,13 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
     dq = dq3[:, :s_q].reshape(b, h, s_q, d)
     dk = dk3[:, :s_k].reshape(b, h, s_k, d)
     dv = dv3[:, :s_k].reshape(b, h, s_k, d)
+    if grp > 1:
+        # sum the g per-q-head partials of each kv group (f32 to avoid
+        # bf16 accumulation error across the group)
+        dk = dk.reshape(b, h_kv, grp, s_k, d).astype(jnp.float32) \
+               .sum(axis=2).astype(k.dtype)
+        dv = dv.reshape(b, h_kv, grp, s_k, d).astype(jnp.float32) \
+               .sum(axis=2).astype(v.dtype)
     return dq, dk, dv
 
 
@@ -479,8 +526,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
 
     Drop-in for :func:`nn.attention.dense_attention` (same signature,
     same result up to float tolerance) with O(S) memory and MXU-tiled
-    pallas kernels. q: (B, H, Sq, Dh); k, v: (B, H, Sk, Dh). Sequence
-    lengths need not divide the block sizes (tiles are padded+masked).
+    pallas kernels. q: (B, H, Sq, Dh); k, v: (B, Hkv, Sk, Dh) with Hkv
+    dividing H — Hkv < H is grouped-query attention, served zero-copy by
+    the kv BlockSpec index maps (do NOT repeat kv heads to H yourself;
+    that materializes exactly the memory GQA removes). Sequence lengths
+    need not divide the block sizes (tiles are padded+masked).
     ``block_q``/``block_k`` default to the measured-best tiling for the
     chip (large tiles — see ``_block_sizes``); pass explicit ints only to
     pin a tiling (tests, VMEM-constrained fusions).

@@ -62,6 +62,11 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     b, h, s_loc, dh = q.shape
+    if k.shape[1] != h:
+        raise NotImplementedError(
+            "dense ring_attention requires equal q/kv head counts; for "
+            "GQA use ring_flash_attention (its flash core reads grouped "
+            "kv heads natively)")
     scale = scale if scale is not None else 1.0 / math.sqrt(dh)
 
     o0 = jnp.zeros((b, h, s_loc, dh), jnp.float32)

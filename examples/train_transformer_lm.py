@@ -53,6 +53,9 @@ def parse_args(argv=None):
     p.add_argument("--dim", default=256, type=int)
     p.add_argument("--n-layers", default=4, type=int)
     p.add_argument("--n-heads", default=8, type=int)
+    p.add_argument("--n-kv-heads", default=None, type=int,
+                   help="grouped-query attention: kv heads < n-heads "
+                        "(shrinks kv projections and the decode KV cache)")
     p.add_argument("--lr", default=3e-4, type=float)
     p.add_argument("--warmup-steps", default=0, type=int,
                    help="Linear warmup into cosine decay over --steps "
@@ -216,6 +219,7 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
     model = models.TransformerLM(vocab=vocab, dim=args.dim,
                                  n_layers=args.n_layers,
                                  n_heads=args.n_heads,
+                                 n_kv_heads=args.n_kv_heads,
                                  max_seq=args.seq_len, attn_fn=attn_fn,
                                  remat=args.remat, dtype=dtype)
     params = model.init(jax.random.PRNGKey(0))
