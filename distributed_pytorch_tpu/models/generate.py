@@ -72,12 +72,16 @@ def prefill(model: TransformerLM, params: Params, tokens,
         raise ValueError(f"prompt length {s} exceeds max_len {max_len}")
     cache = init_cache(model, b, max_len)
     x = model.tok.apply(params["tok"], tokens)
-    x = x + model.pos.apply(params["pos"], jnp.arange(s))
+    positions = jnp.arange(s)
+    if getattr(model, "pos", None) is not None:
+        x = x + model.pos.apply(params["pos"], positions)
     ks, vs = [], []
     for i, blk in enumerate(model.blocks):
         p = params["blocks"][i]
         hq, hk, hv = blk.attn.project_qkv(p["attn"],
                                           blk.ln1.apply(p["ln1"], x))
+        # rope rotates BEFORE caching: the cache holds post-rotation keys
+        hq, hk = blk.attn.maybe_rope(hq, hk, positions)
         o = blk.attn.attn_fn(hq, hk, hv, causal=True)
         x = x + blk.attn.project_out(p["attn"], o)
         x = x + blk.mlp(p, x)
@@ -97,7 +101,8 @@ def decode_step(model: TransformerLM, params: Params, cache: KVCache,
     ``cache.length``. Returns (logits (B, vocab), advanced cache)."""
     idx = cache.length
     x = model.tok.apply(params["tok"], token[:, None])         # (B,1,D)
-    x = x + model.pos.apply(params["pos"], idx[None])
+    if getattr(model, "pos", None) is not None:
+        x = x + model.pos.apply(params["pos"], idx[None])
     scale = 1.0 / math.sqrt(model.dim // model.n_heads)
     max_len = cache.k[0].shape[2]
     pos_mask = (jnp.arange(max_len) <= idx)                    # (max,)
@@ -107,6 +112,7 @@ def decode_step(model: TransformerLM, params: Params, cache: KVCache,
         p = params["blocks"][i]
         hq, hk, hv = blk.attn.project_qkv(p["attn"],
                                           blk.ln1.apply(p["ln1"], x))
+        hq, hk = blk.attn.maybe_rope(hq, hk, idx[None])
         k = jax.lax.dynamic_update_slice(
             cache.k[i], hk.astype(cache.k[i].dtype), (0, 0, idx, 0))
         v = jax.lax.dynamic_update_slice(

@@ -27,8 +27,11 @@ class TransformerLM(Module):
     def __init__(self, vocab: int = 256, dim: int = 128, n_layers: int = 2,
                  n_heads: int = 4, max_seq: int = 512, mlp_ratio: int = 4,
                  dropout: float = 0.0, n_kv_heads: Optional[int] = None,
+                 pos: str = "learned", rope_base: float = 10000.0,
                  attn_fn: Optional[Callable] = None,
                  remat: bool = False, dtype=jnp.float32):
+        if pos not in ("learned", "rope", "none"):
+            raise ValueError(f"pos must be learned|rope|none, got {pos!r}")
         self.vocab = vocab
         self.dim = dim
         self.n_layers = n_layers
@@ -39,11 +42,17 @@ class TransformerLM(Module):
         self.max_seq = max_seq
         self.remat = remat
         self.dtype = dtype
+        # positional scheme: "learned" absolute table (the classic GPT-2
+        # setup), "rope" rotary phases inside attention (no positional
+        # parameters; extrapolates — nn/rotary.py), or "none"
+        self.pos_kind = pos
         self.tok = Embedding(vocab, dim, dtype=dtype)
-        self.pos = Embedding(max_seq, dim, dtype=dtype)
+        self.pos = Embedding(max_seq, dim, dtype=dtype) \
+            if pos == "learned" else None
         self.blocks = [
             TransformerBlock(dim, n_heads, mlp_ratio, causal=True,
                              dropout=dropout, n_kv_heads=n_kv_heads,
+                             rope=(pos == "rope"), rope_base=rope_base,
                              attn_fn=attn_fn, dtype=dtype)
             for _ in range(n_layers)
         ]
@@ -52,13 +61,15 @@ class TransformerLM(Module):
 
     def init(self, key) -> Params:
         ks = jax.random.split(key, self.n_layers + 3)
-        return {
+        p = {
             "tok": self.tok.init(ks[0]),
-            "pos": self.pos.init(ks[1]),
             "blocks": [b.init(k) for b, k in zip(self.blocks, ks[2:-1])],
             "ln_f": self.ln_f.init(ks[-1]),
             "head": self.head.init(ks[-1]),
         }
+        if self.pos is not None:
+            p["pos"] = self.pos.init(ks[1])
+        return p
 
     def apply(self, params: Params, tokens, *, rng=None, train: bool = False,
               pos_offset=0, return_hidden: bool = False, **_):
@@ -75,12 +86,15 @@ class TransformerLM(Module):
         chunkwise so the full (B, S, vocab) logits never materialize."""
         b, s = tokens.shape
         x = self.tok.apply(params["tok"], tokens)
-        x = x + self.pos.apply(params["pos"], pos_offset + jnp.arange(s))
+        positions = pos_offset + jnp.arange(s)
+        if self.pos is not None:
+            x = x + self.pos.apply(params["pos"], positions)
         for i, blk in enumerate(self.blocks):
             r = jax.random.fold_in(rng, i) if rng is not None else None
 
             def run_block(p, x, blk=blk, r=r):
-                return blk.apply(p, x, rng=r, train=train)
+                return blk.apply(p, x, rng=r, train=train,
+                                 positions=positions)
 
             if self.remat:
                 # recompute the block in backward instead of saving its

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from .core import Dropout, LayerNorm, Linear, Module, Params, gelu
+from .rotary import apply_rope
 
 
 def dense_attention(q, k, v, *, causal: bool = False,
@@ -66,7 +67,8 @@ class MultiHeadAttention(Module):
     """
 
     def __init__(self, dim: int, n_heads: int, *, causal: bool = False,
-                 n_kv_heads: Optional[int] = None,
+                 n_kv_heads: Optional[int] = None, rope: bool = False,
+                 rope_base: float = 10000.0,
                  attn_fn: Optional[Callable] = None, dtype=jnp.float32):
         if dim % n_heads:
             raise ValueError(f"dim {dim} not divisible by n_heads {n_heads}")
@@ -78,6 +80,8 @@ class MultiHeadAttention(Module):
                              f"n_kv_heads {self.n_kv_heads}")
         self.head_dim = dim // n_heads
         self.causal = causal
+        self.rope = rope
+        self.rope_base = rope_base
         self.attn_fn = attn_fn or dense_attention
         # GQA (n_kv_heads < n_heads) shrinks the k/v projections and the
         # decode KV cache by n_heads/n_kv_heads; with the default the
@@ -110,8 +114,23 @@ class MultiHeadAttention(Module):
         return self.out.apply(params["out"],
                               o.transpose(0, 2, 1, 3).reshape(b, s, h * dh))
 
-    def apply(self, params: Params, x, **kwargs):
+    def maybe_rope(self, q, k, positions=None):
+        """Rotate q/k when built with ``rope=True`` (no-op otherwise).
+        ``positions`` (S,) default to arange — pass explicit ids for a
+        sequence-parallel shard (global offset) or a cached decode step
+        (the single slot being written). The decode path MUST rotate
+        through this method before caching k: the cache stores
+        post-rotation keys so decode-time q.k phases are correct."""
+        if not self.rope:
+            return q, k
+        if positions is None:
+            positions = jnp.arange(q.shape[2])
+        return (apply_rope(q, positions, self.rope_base),
+                apply_rope(k, positions, self.rope_base))
+
+    def apply(self, params: Params, x, *, positions=None, **kwargs):
         q, k, v = self.project_qkv(params, x)
+        q, k = self.maybe_rope(q, k, positions)
         o = self.attn_fn(q, k, v, causal=self.causal)
         return self.project_out(params, o)
 
@@ -121,11 +140,13 @@ class TransformerBlock(Module):
 
     def __init__(self, dim: int, n_heads: int, mlp_ratio: int = 4, *,
                  causal: bool = False, dropout: float = 0.0,
-                 n_kv_heads: Optional[int] = None,
+                 n_kv_heads: Optional[int] = None, rope: bool = False,
+                 rope_base: float = 10000.0,
                  attn_fn: Optional[Callable] = None, dtype=jnp.float32):
         self.ln1 = LayerNorm(dim, dtype=dtype)
         self.attn = MultiHeadAttention(dim, n_heads, causal=causal,
-                                       n_kv_heads=n_kv_heads,
+                                       n_kv_heads=n_kv_heads, rope=rope,
+                                       rope_base=rope_base,
                                        attn_fn=attn_fn, dtype=dtype)
         self.ln2 = LayerNorm(dim, dtype=dtype)
         self.fc1 = Linear(dim, mlp_ratio * dim, dtype=dtype)
@@ -146,9 +167,11 @@ class TransformerBlock(Module):
                               gelu(self.fc1.apply(params["fc1"],
                                                   self.ln2.apply(params["ln2"], x))))
 
-    def apply(self, params: Params, x, *, rng=None, train: bool = False, **_):
+    def apply(self, params: Params, x, *, rng=None, train: bool = False,
+              positions=None, **_):
         r1, r2 = (jax.random.split(rng) if rng is not None else (None, None))
-        h = self.attn.apply(params["attn"], self.ln1.apply(params["ln1"], x))
+        h = self.attn.apply(params["attn"], self.ln1.apply(params["ln1"], x),
+                            positions=positions)
         x = x + self.drop.apply({}, h, rng=r1, train=train)
         return x + self.drop.apply({}, self.mlp(params, x), rng=r2,
                                    train=train)

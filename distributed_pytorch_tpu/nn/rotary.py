@@ -1,0 +1,47 @@
+"""Rotary position embeddings (RoPE).
+
+Positions enter attention by rotating each (q, k) head vector in 2-D
+planes — relative offsets then appear as phase differences inside the
+q.k dot product, so no positional parameters exist and the scheme
+extrapolates by construction. This is the modern replacement for the
+learned absolute table (``TransformerLM(pos="rope")``); the reference
+repo has no positional scheme at all (its model is an MLP over scalar
+indices, reference ``min_DDP.py:44-48``).
+
+TPU notes: the rotation is a pure elementwise map (two multiplies, one
+shuffle) that XLA fuses into the surrounding qkv projection; it composes
+with the flash/ring kernels untouched because it runs BEFORE attention.
+The half-split ("rotate_half", NeoX/Llama) layout is used: dims [0, d/2)
+pair with [d/2, d), which keeps the shuffle a single concat instead of a
+stride-2 gather (strided lane moves are slow on the VPU).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_angles(positions, head_dim: int, base: float = 10000.0,
+                dtype=jnp.float32):
+    """(cos, sin) tables for ``positions`` (any shape P), each
+    (P..., head_dim/2): angle(p, i) = p * base^(-2i/d)."""
+    if head_dim % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
+    half = head_dim // 2
+    inv_freq = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(ang).astype(dtype), jnp.sin(ang).astype(dtype)
+
+
+def apply_rope(x, positions, base: float = 10000.0):
+    """Rotate head vectors: x (..., H, S, Dh), positions (S,) int.
+
+    Returns x with each head vector rotated by its position's angles in
+    the half-split pairing; dtype preserved (angles computed in f32)."""
+    dh = x.shape[-1]
+    cos, sin = rope_angles(positions, dh, base, dtype=jnp.float32)
+    # broadcast (S, Dh/2) over leading (..., H) axes
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)

@@ -56,6 +56,10 @@ def parse_args(argv=None):
     p.add_argument("--n-kv-heads", default=None, type=int,
                    help="grouped-query attention: kv heads < n-heads "
                         "(shrinks kv projections and the decode KV cache)")
+    p.add_argument("--pos", default="learned",
+                   choices=["learned", "rope", "none"],
+                   help="positional scheme: learned absolute table or "
+                        "rotary embeddings (RoPE, parameter-free)")
     p.add_argument("--lr", default=3e-4, type=float)
     p.add_argument("--warmup-steps", default=0, type=int,
                    help="Linear warmup into cosine decay over --steps "
@@ -219,7 +223,7 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
     model = models.TransformerLM(vocab=vocab, dim=args.dim,
                                  n_layers=args.n_layers,
                                  n_heads=args.n_heads,
-                                 n_kv_heads=args.n_kv_heads,
+                                 n_kv_heads=args.n_kv_heads, pos=args.pos,
                                  max_seq=args.seq_len, attn_fn=attn_fn,
                                  remat=args.remat, dtype=dtype)
     params = model.init(jax.random.PRNGKey(0))
