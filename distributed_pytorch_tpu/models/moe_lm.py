@@ -22,9 +22,11 @@ class MoEBlock(Module):
                  mlp_ratio: int = 4, *, causal: bool = True,
                  capacity_factor: float = 2.0, top_k: int = 1,
                  router_z_coef: float = 0.1,
+                 n_kv_heads: Optional[int] = None, rope: bool = False,
                  attn_fn: Optional[Callable] = None, dtype=jnp.float32):
         self.ln1 = LayerNorm(dim, dtype=dtype)
         self.attn = MultiHeadAttention(dim, n_heads, causal=causal,
+                                       n_kv_heads=n_kv_heads, rope=rope,
                                        attn_fn=attn_fn, dtype=dtype)
         self.ln2 = LayerNorm(dim, dtype=dtype)
         self.router_z_coef = router_z_coef
@@ -37,10 +39,11 @@ class MoEBlock(Module):
         return {"ln1": self.ln1.init(ks[0]), "attn": self.attn.init(ks[1]),
                 "ln2": self.ln2.init(ks[2]), "moe": self.moe.init(ks[2])}
 
-    def apply_with_metrics(self, params: Params, x, **_):
+    def apply_with_metrics(self, params: Params, x, *, positions=None, **_):
         """(y, router metrics dict incl. the combined trainable ``aux``)."""
         x = x + self.attn.apply(params["attn"],
-                                self.ln1.apply(params["ln1"], x))
+                                self.ln1.apply(params["ln1"], x),
+                                positions=positions)
         h, m = self.moe.apply_with_metrics(params["moe"],
                                            self.ln2.apply(params["ln2"], x))
         # trainable aux = load-balancing loss + router z-loss, with
@@ -62,17 +65,23 @@ class MoETransformerLM(Module):
                  n_heads: int = 4, n_experts: int = 4, max_seq: int = 512,
                  mlp_ratio: int = 4, capacity_factor: float = 2.0,
                  top_k: int = 1, router_z_coef: float = 0.1,
+                 n_kv_heads: Optional[int] = None, pos: str = "learned",
                  attn_fn: Optional[Callable] = None, dtype=jnp.float32):
+        if pos not in ("learned", "rope", "none"):
+            raise ValueError(f"pos must be learned|rope|none, got {pos!r}")
         self.vocab = vocab
         self.dim = dim
         self.n_layers = n_layers
         self.n_experts = n_experts
+        self.pos_kind = pos
         self.tok = Embedding(vocab, dim, dtype=dtype)
-        self.pos = Embedding(max_seq, dim, dtype=dtype)
+        self.pos = Embedding(max_seq, dim, dtype=dtype) \
+            if pos == "learned" else None
         self.blocks = [
             MoEBlock(dim, n_heads, n_experts, mlp_ratio,
                      capacity_factor=capacity_factor, top_k=top_k,
-                     router_z_coef=router_z_coef, attn_fn=attn_fn,
+                     router_z_coef=router_z_coef, n_kv_heads=n_kv_heads,
+                     rope=(pos == "rope"), attn_fn=attn_fn,
                      dtype=dtype)
             for _ in range(n_layers)
         ]
@@ -81,13 +90,15 @@ class MoETransformerLM(Module):
 
     def init(self, key) -> Params:
         ks = jax.random.split(key, self.n_layers + 3)
-        return {
+        p = {
             "tok": self.tok.init(ks[0]),
-            "pos": self.pos.init(ks[1]),
             "blocks": [b.init(k) for b, k in zip(self.blocks, ks[2:-1])],
             "ln_f": self.ln_f.init(ks[-1]),
             "head": self.head.init(ks[-1]),
         }
+        if self.pos is not None:
+            p["pos"] = self.pos.init(ks[1])
+        return p
 
     def apply_with_metrics(self, params: Params, tokens, *, pos_offset=0,
                            **_):
@@ -97,10 +108,13 @@ class MoETransformerLM(Module):
         training loop without bypassing the model API."""
         b, s = tokens.shape
         x = self.tok.apply(params["tok"], tokens)
-        x = x + self.pos.apply(params["pos"], pos_offset + jnp.arange(s))
+        positions = pos_offset + jnp.arange(s)
+        if self.pos is not None:
+            x = x + self.pos.apply(params["pos"], positions)
         per_layer = []
         for i, blk in enumerate(self.blocks):
-            x, m = blk.apply_with_metrics(params["blocks"][i], x)
+            x, m = blk.apply_with_metrics(params["blocks"][i], x,
+                                          positions=positions)
             per_layer.append(m)
         x = self.ln_f.apply(params["ln_f"], x)
         metrics = {k: sum(m[k] for m in per_layer) / self.n_layers
@@ -126,10 +140,12 @@ class MoETransformerLM(Module):
                 "moe": moe_param_specs(ep_axis=ep_axis),
             }
 
-        return {
+        specs = {
             "tok": {"emb": P()},
-            "pos": {"emb": P()},
             "blocks": [block_specs() for _ in range(self.n_layers)],
             "ln_f": {"scale": P(), "bias": P()},
             "head": {"w": P(None, t)},
         }
+        if self.pos is not None:
+            specs["pos"] = {"emb": P()}
+        return specs

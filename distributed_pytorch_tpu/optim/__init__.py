@@ -88,6 +88,100 @@ def adamw(lr: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
     return Optimizer(init, update)
 
 
+class AdafactorState(NamedTuple):
+    step: jnp.ndarray
+    vr: Any    # factored row second moments (matrices; () placeholder else)
+    vc: Any    # factored col second moments
+    v: Any     # full second moment (vectors/scalars; () placeholder else)
+
+
+def adafactor(lr: float = None, *, decay_pow: float = 0.8,
+              clip_threshold: float = 1.0, eps1: float = 1e-30,
+              eps2: float = 1e-3, weight_decay: float = 0.0,
+              scale_by_param: bool = None) -> Optimizer:
+    """Adafactor (Shazeer & Stern): Adam-class adaptivity at O(rows+cols)
+    optimizer memory — the TPU-classic choice for big embedding/vocab
+    matrices, where Adam's two full f32 moments triple the parameter HBM.
+
+    For ndim>=2 leaves the second moment is stored FACTORED (a row vector
+    and a column vector over the trailing two axes; their outer product,
+    normalized by the row mean, is the rank-1 maximum-likelihood fit to
+    the full moment); smaller leaves keep a full moment. No first moment.
+    beta2 follows the 1 - t^-decay_pow schedule, updates are RMS-clipped
+    to ``clip_threshold``, and with ``lr=None`` the canonical relative
+    step min(1e-2, 1/sqrt(t)) * max(eps2, RMS(param)) is used
+    (``scale_by_param`` defaults to True exactly when lr is None).
+    Decoupled weight decay as in :func:`adamw`. State is f32; under FSDP
+    the factored vectors replicate (``parallel/fsdp.py:opt_state_specs``)
+    — they are O(rows+cols), which is the whole point.
+    """
+    if scale_by_param is None:
+        scale_by_param = lr is None
+
+    def _flat(params):
+        return jax.tree_util.tree_flatten(params)
+
+    def init(params):
+        leaves, _ = _flat(params)
+        # placeholders must be DISTINCT arrays: donated train steps reject
+        # the same buffer appearing twice in one argument list
+        empty = lambda: jnp.zeros((0,), jnp.float32)
+        vr, vc, v = [], [], []
+        for p in leaves:
+            if p.ndim >= 2:
+                vr.append(jnp.zeros(p.shape[:-1], jnp.float32))
+                vc.append(jnp.zeros(p.shape[:-2] + p.shape[-1:],
+                                    jnp.float32))
+                v.append(empty())
+            else:
+                vr.append(empty())
+                vc.append(empty())
+                v.append(jnp.zeros(jnp.shape(p), jnp.float32))
+        return AdafactorState(step=jnp.zeros((), jnp.int32),
+                              vr=tuple(vr), vc=tuple(vc), v=tuple(v))
+
+    def _rms(x):
+        return jnp.sqrt(jnp.mean(jnp.square(x)) + 1e-30)
+
+    def update(grads, state, params):
+        g_leaves, treedef = _flat(grads)
+        p_leaves = treedef.flatten_up_to(params)
+        step = state.step + 1
+        t = step.astype(jnp.float32)
+        beta2 = 1.0 - t ** (-decay_pow)
+        base_step = lr if lr is not None else jnp.minimum(
+            1e-2, 1.0 / jnp.sqrt(t))
+
+        new_p, new_vr, new_vc, new_v = [], [], [], []
+        for p, g, vr, vc, v in zip(p_leaves, g_leaves, state.vr, state.vc,
+                                   state.v):
+            gf = g.astype(jnp.float32)
+            g2 = jnp.square(gf) + eps1
+            if p.ndim >= 2:
+                vr = beta2 * vr + (1 - beta2) * jnp.mean(g2, axis=-1)
+                vc = beta2 * vc + (1 - beta2) * jnp.mean(g2, axis=-2)
+                r = vr / jnp.mean(vr, axis=-1, keepdims=True)
+                u = gf * jax.lax.rsqrt(r)[..., None] \
+                    * jax.lax.rsqrt(vc)[..., None, :]
+            else:
+                v = beta2 * v + (1 - beta2) * g2
+                u = gf * jax.lax.rsqrt(v)
+            u = u / jnp.maximum(1.0, _rms(u) / clip_threshold)
+            alpha = base_step * (jnp.maximum(eps2, _rms(p.astype(
+                jnp.float32))) if scale_by_param else 1.0)
+            pf = p.astype(jnp.float32) * (1.0 - alpha * weight_decay)
+            new_p.append((pf - alpha * u).astype(p.dtype))
+            new_vr.append(vr)
+            new_vc.append(vc)
+            new_v.append(v)
+
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                AdafactorState(step=step, vr=tuple(new_vr),
+                               vc=tuple(new_vc), v=tuple(new_v)))
+
+    return Optimizer(init, update)
+
+
 # Schedules/transforms import Optimizer from this module, so they load
 # after it is defined.
 from . import schedules  # noqa: E402

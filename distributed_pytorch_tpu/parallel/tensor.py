@@ -38,13 +38,15 @@ def transformer_lm_param_specs(model, tp_axis: str = "tp") -> Dict[str, Any]:
             "fc2": {"w": P(t, None), "b": P()},           # row
         }
 
-    return {
+    specs = {
         "tok": {"emb": P()},
-        "pos": {"emb": P()},
         "blocks": [block_specs() for _ in range(model.n_layers)],
         "ln_f": {"scale": P(), "bias": P()},
         "head": {"w": P(None, t)},                        # vocab-sharded
     }
+    if model.pos is not None:   # no table under pos="rope"/"none"
+        specs["pos"] = {"emb": P()}
+    return specs
 
 
 def shard_params(params, specs, mesh: Mesh):

@@ -60,7 +60,14 @@ def parse_args(argv=None):
                    choices=["learned", "rope", "none"],
                    help="positional scheme: learned absolute table or "
                         "rotary embeddings (RoPE, parameter-free)")
-    p.add_argument("--lr", default=3e-4, type=float)
+    p.add_argument("--lr", default=None, type=float,
+                   help="default: 3e-4 for adamw; unset for adafactor, "
+                        "which then uses its canonical relative-step mode "
+                        "min(1e-2, 1/sqrt(t)) * RMS(param)")
+    p.add_argument("--optimizer", default="adamw",
+                   choices=["adamw", "adafactor"],
+                   help="adafactor: factored second moments, O(rows+cols) "
+                        "optimizer memory (optim.adafactor)")
     p.add_argument("--warmup-steps", default=0, type=int,
                    help="Linear warmup into cosine decay over --steps "
                         "(the standard LM schedule); 0 = constant lr.")
@@ -231,12 +238,21 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
         raise ValueError(
             f"--warmup-steps {args.warmup_steps} must be < --steps "
             f"{args.steps} (the cosine phase would never run)")
+    opt_fn = optim.adafactor if args.optimizer == "adafactor" \
+        else optim.adamw
+    lr = args.lr if args.lr is not None else \
+        (None if args.optimizer == "adafactor" else 3e-4)
     if args.warmup_steps > 0:
+        if lr is None:
+            raise ValueError(
+                "--warmup-steps with adafactor needs an explicit --lr "
+                "(the schedule drives an absolute step size, replacing "
+                "adafactor's relative-step mode)")
         optimizer = optim.with_schedule(
-            optim.adamw,
-            optim.warmup_cosine(args.lr, args.warmup_steps, args.steps))
+            opt_fn,
+            optim.warmup_cosine(lr, args.warmup_steps, args.steps))
     else:
-        optimizer = optim.adamw(args.lr)
+        optimizer = opt_fn(lr)
     if args.clip_norm > 0:
         optimizer = optim.with_clipping(optimizer, args.clip_norm)
     if args.master_f32:

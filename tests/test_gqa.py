@@ -164,3 +164,30 @@ def test_window_clamps_default_k_block():
     assert bk2 == 1024 and bq == bq2
     # explicit ints always win
     assert _block_sizes(4096, 4096, 64, 64, d=64, window=128) == (64, 64)
+
+
+def test_moe_lm_gqa_rope_trains():
+    """MoETransformerLM accepts n_kv_heads + pos='rope' (no pos table in
+    the tree) and its loss decreases."""
+    from distributed_pytorch_tpu import optim
+    from distributed_pytorch_tpu.models.moe_lm import MoETransformerLM
+    from distributed_pytorch_tpu.ops.losses import cross_entropy
+    model = MoETransformerLM(vocab=61, dim=32, n_layers=2, n_heads=4,
+                             n_experts=2, max_seq=32, n_kv_heads=2,
+                             pos="rope")
+    params = model.init(jax.random.PRNGKey(0))
+    assert "pos" not in params
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, 61)
+
+    def loss_fn(p, t):
+        logits, aux = model.apply(p, t[:, :-1])
+        return cross_entropy(logits, t[:, 1:]) + 0.01 * aux
+
+    opt = optim.adamw(1e-3)
+    opt_state = opt.init(params)
+    l0 = None
+    for _ in range(6):
+        loss, grads = jax.value_and_grad(loss_fn)(params, toks)
+        params, opt_state = opt.update(grads, opt_state, params)
+        l0 = float(loss) if l0 is None else l0
+    assert float(loss) < l0
