@@ -369,8 +369,8 @@ def _stage_main(stage: str) -> int:
     elif stage == "min_ddp":
         print(json.dumps(bench_min_ddp()))
     elif stage == "decode":
-        from benchmarks.decode_tpu import run as decode_run
-        print(json.dumps(decode_run()))
+        from benchmarks.decode_tpu import run_gqa_compare
+        print(json.dumps(run_gqa_compare()))
     else:
         print(json.dumps({"error": f"unknown stage {stage!r}"}))
         return 2
@@ -397,7 +397,8 @@ def main():
         else:
             rec["error"] = f"mfu stage: {mfu_rec.get('error', 'no result')}"
         rec["min_ddp"] = _run_stage("min_ddp", timeout_s=900)
-        rec["decode"] = _run_stage("decode", timeout_s=1200)
+        # two full decode benchmarks (MHA + GQA arms) live in this stage
+        rec["decode"] = _run_stage("decode", timeout_s=2400)
     else:
         rec["error"] = "no healthy TPU backend after retries"
 

@@ -9,8 +9,9 @@ so the companion number to MFU here is achieved HBM bandwidth:
     bytes/step ~= param_bytes + kv_cache_bytes(current length)
     achieved GB/s = bytes/step * tokens/step / step_time
 
-Usage: python benchmarks/decode_tpu.py [--small]
-Prints a human table plus one JSON line for tooling.
+Usage: python benchmarks/decode_tpu.py [--small] [--gqa]
+(``--gqa`` adds a grouped-query arm — group 4 at full scale — and the
+decode speedup the shrunken cache buys.) Prints one JSON line.
 """
 
 from __future__ import annotations
@@ -37,7 +38,8 @@ HBM_BW = {
 
 
 def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
-        prompt_len=128, max_new=256, batch=8, dtype=jnp.bfloat16) -> dict:
+        prompt_len=128, max_new=256, batch=8, n_kv_heads=None,
+        dtype=jnp.bfloat16) -> dict:
     from benchmarks.mfu_transformer import count_params
     from distributed_pytorch_tpu import models
     from distributed_pytorch_tpu.models import make_generate_fn
@@ -47,8 +49,8 @@ def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
 
     max_seq = prompt_len + max_new
     model = models.TransformerLM(vocab=vocab, dim=dim, n_layers=n_layers,
-                                 n_heads=n_heads, max_seq=max_seq,
-                                 dtype=dtype)
+                                 n_heads=n_heads, n_kv_heads=n_kv_heads,
+                                 max_seq=max_seq, dtype=dtype)
     params = model.init(jax.random.PRNGKey(0))
     n_params = count_params(params)
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
@@ -98,8 +100,10 @@ def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
     tok_s_decode = batch * decode_steps / t_decode
     bpe = jnp.dtype(dtype).itemsize
     # each decode step streams the params plus the FULL preallocated cache
-    # (decode attends over max_len under a position mask — static shapes)
-    kv_bytes = n_layers * 2 * batch * dim * max_seq * bpe
+    # (decode attends over max_len under a position mask — static shapes);
+    # GQA shrinks the cache rows to n_kv_heads * head_dim
+    kv_dim = (n_kv_heads or n_heads) * (dim // n_heads)
+    kv_bytes = n_layers * 2 * batch * kv_dim * max_seq * bpe
     bytes_per_step = n_params * bpe + kv_bytes
     achieved_bw = bytes_per_step * decode_steps / t_decode
 
@@ -108,6 +112,7 @@ def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
     return {
         "device": dev.device_kind,
         "config": {"dim": dim, "n_layers": n_layers, "n_heads": n_heads,
+                   "n_kv_heads": n_kv_heads or n_heads,
                    "vocab": vocab, "prompt_len": prompt_len,
                    "max_new": max_new, "batch": batch,
                    "dtype": str(jnp.dtype(dtype).name)},
@@ -125,8 +130,26 @@ def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
     }
 
 
+def run_gqa_compare(small: bool = False) -> dict:
+    """MHA vs grouped-query decode at equal model class. Decode is
+    KV-cache-bandwidth-bound, so the speedup quantifies what the
+    group-factor-smaller cache buys (untrained weights, identical
+    compute graph shape). One schema for the small and full arms."""
+    kw = dict(dim=128, n_layers=2, n_heads=4, vocab=512, prompt_len=16,
+              max_new=32, batch=2) if small else {}
+    mha = run(**kw)
+    gqa = run(n_kv_heads=1 if small else 3, **kw)   # group 4
+    return {"mha": mha, "gqa": gqa,
+            "gqa_decode_speedup": round(
+                gqa["decode_tokens_per_sec"]
+                / mha["decode_tokens_per_sec"], 2)}
+
+
 def main(argv):
-    if "--small" in argv:
+    small = "--small" in argv
+    if "--gqa" in argv:
+        rec = run_gqa_compare(small=small)
+    elif small:
         rec = run(dim=128, n_layers=2, n_heads=4, vocab=512,
                   prompt_len=16, max_new=32, batch=2)
     else:

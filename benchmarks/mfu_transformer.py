@@ -13,8 +13,11 @@ model (utils/profiler.compiled_stats) is reported alongside as a
 cross-check. Peak FLOP/s per chip generation is tabled below from public
 spec sheets.
 
-Usage: python benchmarks/mfu_transformer.py            (full, ~100M params)
-       python benchmarks/mfu_transformer.py --small    (CI-sized smoke run)
+Usage: python benchmarks/mfu_transformer.py             (flagship, ~135M)
+       python benchmarks/mfu_transformer.py --small     (CI-sized smoke)
+       python benchmarks/mfu_transformer.py --sweep     (batch/remat/fused-CE arms)
+       python benchmarks/mfu_transformer.py --model medium   (~355M arm)
+       flags: --batch N --remat --fused-ce
 """
 
 from __future__ import annotations
@@ -53,6 +56,10 @@ PEAK_BF16 = {
 # and every consumer (run() defaults, the vs_baseline denominator) follows.
 FLAGSHIP = {"dim": 768, "n_layers": 12, "n_heads": 12, "vocab": 32000,
             "seq": 1024, "batch": 8}
+# GPT-2-medium class (~355M params): bigger matmuls -> higher attainable
+# MFU; an additional reporting arm (--model medium), never the headline.
+MEDIUM = {"dim": 1024, "n_layers": 24, "n_heads": 16, "vocab": 32000,
+          "seq": 1024, "batch": 8}
 
 
 def model_flops_per_token(dim: int, n_layers: int, vocab: int, seq: int,
@@ -231,6 +238,15 @@ def main(argv):
     elif "--small" in argv:
         rec = run(dim=128, n_layers=2, n_heads=4, vocab=512, seq=256,
                   batch=batch or 4, steps=5, remat=remat, fused_ce=fused_ce)
+    elif (model := _flag_val(argv, "--model", "flagship", str)) != "flagship":
+        if model != "medium":
+            print(json.dumps({"error": f"unknown --model {model!r} "
+                              "(choices: medium)"}))
+            return 2
+        cfg = dict(MEDIUM)
+        if batch:
+            cfg["batch"] = batch
+        rec = run(steps=20, remat=remat, fused_ce=fused_ce, **cfg)
     else:
         rec = run(remat=remat, fused_ce=fused_ce,
                   **({"batch": batch} if batch else {}))
