@@ -21,7 +21,7 @@ class MoEBlock(Module):
     def __init__(self, dim: int, n_heads: int, n_experts: int,
                  mlp_ratio: int = 4, *, causal: bool = True,
                  capacity_factor: float = 2.0, top_k: int = 1,
-                 router_z_coef: float = 0.1,
+                 router_z_coef: float = 0.1, router: str = "tokens",
                  n_kv_heads: Optional[int] = None, rope: bool = False,
                  attn_fn: Optional[Callable] = None, dtype=jnp.float32):
         self.ln1 = LayerNorm(dim, dtype=dtype)
@@ -32,7 +32,7 @@ class MoEBlock(Module):
         self.router_z_coef = router_z_coef
         self.moe = MoELayer(dim, n_experts, mlp_ratio,
                             capacity_factor=capacity_factor, top_k=top_k,
-                            dtype=dtype)
+                            router=router, dtype=dtype)
 
     def init(self, key) -> Params:
         ks = jax.random.split(key, 3)
@@ -65,6 +65,7 @@ class MoETransformerLM(Module):
                  n_heads: int = 4, n_experts: int = 4, max_seq: int = 512,
                  mlp_ratio: int = 4, capacity_factor: float = 2.0,
                  top_k: int = 1, router_z_coef: float = 0.1,
+                 router: str = "tokens",
                  n_kv_heads: Optional[int] = None, pos: str = "learned",
                  attn_fn: Optional[Callable] = None, dtype=jnp.float32):
         if pos not in ("learned", "rope", "none"):
@@ -80,7 +81,8 @@ class MoETransformerLM(Module):
         self.blocks = [
             MoEBlock(dim, n_heads, n_experts, mlp_ratio,
                      capacity_factor=capacity_factor, top_k=top_k,
-                     router_z_coef=router_z_coef, n_kv_heads=n_kv_heads,
+                     router_z_coef=router_z_coef, router=router,
+                     n_kv_heads=n_kv_heads,
                      rope=(pos == "rope"), attn_fn=attn_fn,
                      dtype=dtype)
             for _ in range(n_layers)

@@ -8,7 +8,8 @@ whole layer is three einsums XLA maps onto the MXU. Expert weights carry a
 the all-to-all over the ``ep`` mesh axis (the same program a hand-written
 MPI alltoall would compute, derived from layout instead of code).
 
-Routing is top-k (``top_k=1`` = Switch, ``top_k=2`` = GShard): each token
+Two routers. **Token-choice** (default) is top-k (``top_k=1`` = Switch,
+``top_k=2`` = GShard): each token
 is dispatched to its k highest-probability experts, first choices queueing
 ahead of second choices for the fixed per-expert capacity; overflow tokens
 are dropped (contribute zero — the transformer's residual path carries
@@ -25,6 +26,11 @@ Losses/diagnostics returned by :meth:`MoELayer.apply_with_metrics`:
 - ``expert_load`` — (E,) share of the KEPT dispatches handled by each
   expert (sums to 1 whenever anything was kept; dropped slots are
   accounted in ``drop_rate``, not here).
+
+**Expert-choice** (``router="experts"``, Zhou et al. 2022) inverts the
+selection: each expert takes its top-capacity tokens, making load balance
+exact with no auxiliary loss (see :meth:`MoELayer._expert_choice` for the
+batch-dependence caveat).
 """
 
 from __future__ import annotations
@@ -44,15 +50,19 @@ class MoELayer(Module):
 
     def __init__(self, dim: int, n_experts: int, mlp_ratio: int = 4,
                  capacity_factor: float = 1.25, top_k: int = 1,
-                 normalize_gates: bool = True, dtype=jnp.float32):
+                 normalize_gates: bool = True, router: str = "tokens",
+                 dtype=jnp.float32):
         if not 1 <= top_k <= n_experts:
             raise ValueError(f"top_k={top_k} not in [1, {n_experts}]")
+        if router not in ("tokens", "experts"):
+            raise ValueError(f"router must be tokens|experts, got {router!r}")
         self.dim = dim
         self.n_experts = n_experts
         self.hidden = mlp_ratio * dim
         self.capacity_factor = capacity_factor
         self.top_k = top_k
         self.normalize_gates = normalize_gates
+        self.router = router
         self.dtype = dtype
 
     def init(self, key) -> Params:
@@ -81,6 +91,9 @@ class MoELayer(Module):
 
         logits = (xt @ params["gate"]["w"]).astype(jnp.float32)  # (N, E)
         probs = jax.nn.softmax(logits, axis=-1)
+        if self.router == "experts":
+            return self._expert_choice(params, x, xt, probs, logits,
+                                       orig_shape, n)
         top_p, top_i = jax.lax.top_k(probs, k)                   # (N, K)
         gates = top_p
         if k > 1 and self.normalize_gates:
@@ -100,13 +113,7 @@ class MoELayer(Module):
         dispatch = disp_k.sum(axis=1)                            # (N, E, C)
         combine = jnp.einsum("nkec,nk->nec", disp_k, gates)      # (N, E, C)
 
-        expert_in = jnp.einsum("nec,nd->ecd", dispatch,
-                               xt.astype(jnp.float32))           # (E, C, D)
-        h = gelu(jnp.einsum("ecd,edh->ech", expert_in, params["fc1"]["w"])
-                 + params["fc1"]["b"][:, None, :])
-        expert_out = (jnp.einsum("ech,ehd->ecd", h, params["fc2"]["w"])
-                      + params["fc2"]["b"][:, None, :])          # (E, C, D)
-        y = jnp.einsum("nec,ecd->nd", combine, expert_out)
+        y = self._expert_ffn(params, dispatch, combine, xt)
 
         # Switch aux loss over FIRST-choice assignments (eq. 4)
         frac = onehot[:, 0, :].mean(axis=0)
@@ -121,6 +128,53 @@ class MoELayer(Module):
             "z_loss": z_loss,
             "drop_rate": 1.0 - kept.mean(),
             "expert_load": per_expert / jnp.maximum(per_expert.sum(), 1.0),
+        }
+        return y.reshape(orig_shape).astype(x.dtype), metrics
+
+    def _expert_ffn(self, params, dispatch, combine, xt):
+        """Shared dispatch → per-expert GELU MLP → combine block: the
+        routers differ only in how they build the (N, E, C) dispatch and
+        combine tensors."""
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch,
+                               xt.astype(jnp.float32))           # (E, C, D)
+        h = gelu(jnp.einsum("ecd,edh->ech", expert_in, params["fc1"]["w"])
+                 + params["fc1"]["b"][:, None, :])
+        expert_out = (jnp.einsum("ech,ehd->ecd", h, params["fc2"]["w"])
+                      + params["fc2"]["b"][:, None, :])          # (E, C, D)
+        return jnp.einsum("nec,ecd->nd", combine, expert_out)
+
+    def _expert_choice(self, params, x, xt, probs, logits, orig_shape, n):
+        """Expert-choice routing (Zhou et al. 2022): each EXPERT takes
+        its top-capacity tokens by gate score, so load balance is exact
+        by construction — no auxiliary loss, no priority queues; tokens
+        chosen by nobody ride the residual. Capacity uses the same
+        ``capacity_factor * n / e`` budget (``top_k`` does not apply).
+
+        Caveat (as in the paper): selection compares scores ACROSS the
+        batch/sequence, so a token's output depends on its neighbors —
+        fine for training and encoders, not a causal decoding scheme
+        (cached autoregressive decode would see different routing than
+        training; pair it with training-only workloads or accept the
+        mismatch)."""
+        e = self.n_experts
+        # clamp to n: top_k requires k <= the token count (a generous
+        # capacity_factor with few experts would otherwise overshoot)
+        cap = min(max(int(self.capacity_factor * n / e), 1), n)
+        scores = probs.T                                        # (E, N)
+        top_s, top_idx = jax.lax.top_k(scores, cap)             # (E, C)
+        disp = jax.nn.one_hot(top_idx, n, dtype=jnp.float32)    # (E, C, N)
+        dispatch = disp.transpose(2, 0, 1)                      # (N, E, C)
+        combine = (disp * top_s[..., None]).transpose(2, 0, 1)  # (N, E, C)
+        y = self._expert_ffn(params, dispatch, combine, xt)
+
+        picks_per_token = dispatch.sum(axis=(1, 2))             # (N,)
+        metrics = {
+            # balanced by construction; 0 keeps the trainable-aux
+            # contract (loss + c*aux) router-agnostic
+            "aux_loss": jnp.zeros((), jnp.float32),
+            "z_loss": jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2),
+            "drop_rate": jnp.mean(picks_per_token == 0),
+            "expert_load": jnp.full((e,), 1.0 / e, jnp.float32),
         }
         return y.reshape(orig_shape).astype(x.dtype), metrics
 

@@ -191,3 +191,15 @@ def test_moe_lm_gqa_rope_trains():
         params, opt_state = opt.update(grads, opt_state, params)
         l0 = float(loss) if l0 is None else l0
     assert float(loss) < l0
+
+
+def test_window_gqa_compose():
+    """Sliding-window + GQA together: flash matches dense for a banded
+    causal mask with grouped kv heads."""
+    from distributed_pytorch_tpu.nn.attention import dense_attention
+    q, k, v = _qkv(h=4, h_kv=2, s=32, d=8)
+    got = flash_attention(q, k, v, causal=True, window=8,
+                          block_q=8, block_k=8)
+    want = dense_attention(q, k, v, causal=True, window=8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
