@@ -1,0 +1,154 @@
+"""Expert-parallel MoE LM training: experts sharded over the ``ep`` axis.
+
+The sparse-capacity showcase the reference cannot express (SURVEY.md
+§2.4: EP absent): `MoETransformerLM` swaps every block's dense MLP for a
+bank of expert FFNs whose weights carry ``P('ep', ...)`` specs — the
+SPMD partitioner turns the dense dispatch/combine einsums into the
+all-to-all over ``ep`` (parallel/moe.py). Both routers are exposed:
+token-choice top-k (Switch/GShard, trainable load-balancing aux) and
+expert-choice (exact balance by construction, zero aux). Router health
+(drop rate, expert load, z-loss) streams through the model API into the
+line-JSON metrics log — the signals that tune ``--capacity-factor``.
+
+Runs on the 8-device virtual CPU mesh (tests) or a real slice unchanged:
+
+  python examples/train_moe_lm.py --steps 20 --n-experts 4 --top-k 2
+  python examples/train_moe_lm.py --router experts
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import distributed_pytorch_tpu as dist
+from distributed_pytorch_tpu import models, optim
+from distributed_pytorch_tpu.ops.losses import cross_entropy_per_example
+from distributed_pytorch_tpu.parallel import (make_spmd_train_step,
+                                              shard_batch_spec)
+from distributed_pytorch_tpu.parallel.tensor import shard_params
+from distributed_pytorch_tpu.runtime import context
+from distributed_pytorch_tpu.utils import MetricsLogger
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="Expert-parallel MoE LM training")
+    p.add_argument("--steps", default=20, type=int)
+    p.add_argument("--seq-len", default=128, type=int)
+    p.add_argument("--batch-size", default=8, type=int,
+                   help="GLOBAL batch (sharded over the dp axis).")
+    p.add_argument("--ep", default=0, type=int,
+                   help="Expert-parallel axis size; 0 = all visible "
+                        "devices. The rest becomes dp.")
+    p.add_argument("--n-experts", default=0, type=int,
+                   help="0 = one expert per ep-axis device.")
+    p.add_argument("--top-k", default=1, type=int,
+                   help="token-choice routing fan-out (1=Switch, 2=GShard)")
+    p.add_argument("--router", default="tokens",
+                   choices=["tokens", "experts"],
+                   help="experts = expert-choice routing: exact load "
+                        "balance, no aux loss (training-only scheme)")
+    p.add_argument("--capacity-factor", default=2.0, type=float)
+    p.add_argument("--aux-coef", default=0.01, type=float,
+                   help="weight of the combined router aux in the loss")
+    p.add_argument("--dim", default=128, type=int)
+    p.add_argument("--n-layers", default=2, type=int)
+    p.add_argument("--n-heads", default=4, type=int)
+    p.add_argument("--pos", default="learned",
+                   choices=["learned", "rope", "none"])
+    p.add_argument("--lr", default=3e-4, type=float)
+    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--log", default=None, type=str)
+    return p.parse_args(argv)
+
+
+def main(argv=None, quiet=False, history=None):
+    args = parse_args(argv)
+    n_dev = max(len(context.visible_devices()), 1)
+    ep = args.ep or n_dev
+    if n_dev % ep:
+        raise ValueError(f"ep={ep} must divide the {n_dev} devices")
+    dp = n_dev // ep
+    if args.batch_size % dp:
+        raise ValueError(f"--batch-size {args.batch_size} must divide by "
+                         f"dp={dp}")
+    n_experts = args.n_experts or ep
+    if n_experts % ep:
+        raise ValueError(f"--n-experts {n_experts} must divide by ep={ep}")
+    mesh = context.init_mesh(dp=dp, ep=ep)
+    if not quiet:
+        dist.print_primary(f"mesh: dp={dp} x ep={ep}  experts={n_experts} "
+                           f"router={args.router} top_k={args.top_k}")
+
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    model = models.MoETransformerLM(
+        vocab=256, dim=args.dim, n_layers=args.n_layers,
+        n_heads=args.n_heads, n_experts=n_experts, max_seq=args.seq_len,
+        capacity_factor=args.capacity_factor, top_k=args.top_k,
+        router=args.router, pos=args.pos, dtype=dtype)
+    params = shard_params(model.init(jax.random.PRNGKey(0)),
+                          model.param_specs(), mesh)
+    optimizer = optim.adamw(args.lr)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        logits, aux, metrics = model.apply_with_metrics(p, x)
+        nll = cross_entropy_per_example(logits, y).mean()
+        # scalar router diagnostics ride the metrics pytree out of the
+        # compiled step (expert_load is (E,) — log its max as a scalar)
+        diag = {"nll": nll, "aux": aux,
+                "drop_rate": metrics["drop_rate"],
+                "z_loss": metrics["z_loss"],
+                "max_expert_load": jnp.max(metrics["expert_load"])}
+        return nll + args.aux_coef * aux, diag
+
+    step = make_spmd_train_step(loss_fn, optimizer, donate=False)
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 256,
+                        (args.batch_size, args.seq_len + 1)).astype(np.int32)
+    batch = shard_batch_spec((toks[:, :-1], toks[:, 1:]), mesh,
+                             P("dp", None))
+
+    logger = MetricsLogger(args.log)
+    tokens_per_step = args.batch_size * args.seq_len
+    out = step(params, opt_state, batch)     # compile
+    jax.block_until_ready(out.loss)
+    t0 = time.perf_counter()
+    p_, o_ = out.params, out.opt_state
+    for s in range(1, args.steps):
+        out = step(p_, o_, batch)
+        p_, o_ = out.params, out.opt_state
+        loss = float(out.loss)
+        m = {k: float(np.asarray(v).mean()) for k, v in out.metrics.items()}
+        logger.log(s, loss=loss, **m)
+        if history is not None:
+            history.append(loss)
+        if not quiet and (s % 5 == 0 or s == args.steps - 1):
+            dist.print_primary(
+                f"step {s:>4}  loss {loss:.4f}  nll {m['nll']:.4f}  "
+                f"drop {m['drop_rate']:.3f}  "
+                f"max_load {m['max_expert_load']:.3f}")
+    if args.steps > 1:
+        dt = time.perf_counter() - t0
+        sps = (args.steps - 1) / dt
+        if not quiet:
+            dist.print_primary(
+                f"done: {sps:.2f} steps/s, "
+                f"{sps * tokens_per_step:,.0f} tokens/s")
+    logger.close()
+    dist.cleanup()
+
+
+if __name__ == "__main__":
+    main()

@@ -160,3 +160,20 @@ def test_transformer_lm_prefetch():
     dist.launch(train_transformer_lm.main_worker,
                 args + ["--prefetch", "2"], True, h1)
     np.testing.assert_array_equal(np.asarray(h0), np.asarray(h1))
+
+
+@pytest.mark.parametrize("router", ["tokens", "experts"])
+def test_moe_lm_example(router):
+    """Expert-parallel MoE rung: dp x ep mesh, both routers; loss finite
+    and decreasing over a few steps."""
+    import train_moe_lm
+
+    h = []
+    train_moe_lm.main(
+        ["--steps", "6", "--seq-len", "32", "--batch-size", "4",
+         "--ep", "4", "--n-experts", "4", "--dim", "32", "--n-layers", "1",
+         "--n-heads", "4", "--router", router],
+        quiet=True, history=h)
+    assert len(h) == 5
+    assert all(np.isfinite(x) for x in h)
+    assert h[-1] < h[0]
