@@ -112,7 +112,7 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
         def loss_fn(p, tokens):
             hid = model.apply(p, tokens[:, :-1], return_hidden=True)
             return fused_linear_cross_entropy(
-                hid, p["head"]["w"], tokens[:, 1:]), {}
+                hid, model.head_weight(p), tokens[:, 1:]), {}
     else:
         def loss_fn(p, tokens):
             logits = model.apply(p, tokens[:, :-1]).astype(jnp.float32)

@@ -90,7 +90,7 @@ def prefill(model: TransformerLM, params: Params, tokens,
         vs.append(jax.lax.dynamic_update_slice(
             cache.v[i], hv.astype(cache.v[i].dtype), (0, 0, 0, 0)))
     x = model.ln_f.apply(params["ln_f"], x[:, -1:])
-    logits = model.head.apply(params["head"], x)[:, 0]
+    logits = model.project_vocab(params, x)[:, 0]
     return logits, KVCache(k=ks, v=vs,
                            length=jnp.asarray(s, jnp.int32))
 
@@ -135,7 +135,7 @@ def decode_step(model: TransformerLM, params: Params, cache: KVCache,
         x = x + blk.mlp(p, x)
 
     x = model.ln_f.apply(params["ln_f"], x)
-    logits = model.head.apply(params["head"], x)[:, 0]
+    logits = model.project_vocab(params, x)[:, 0]
     return logits, KVCache(k=new_k, v=new_v, length=idx + 1)
 
 

@@ -75,8 +75,11 @@ class MoETransformerLM(Module):
         self.n_layers = n_layers
         self.n_experts = n_experts
         self.pos_kind = pos
-        self.tok = Embedding(vocab, dim, dtype=dtype)
-        self.pos = Embedding(max_seq, dim, dtype=dtype) \
+        # dimension-aware table init (std 1/sqrt(dim)), matching
+        # TransformerLM's tables — an intentional init change from the
+        # earlier unit-std draws (better-conditioned; no tying here)
+        self.tok = Embedding(vocab, dim, std=dim ** -0.5, dtype=dtype)
+        self.pos = Embedding(max_seq, dim, std=dim ** -0.5, dtype=dtype) \
             if pos == "learned" else None
         self.blocks = [
             MoEBlock(dim, n_heads, n_experts, mlp_ratio,

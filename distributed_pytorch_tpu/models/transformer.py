@@ -28,6 +28,7 @@ class TransformerLM(Module):
                  n_heads: int = 4, max_seq: int = 512, mlp_ratio: int = 4,
                  dropout: float = 0.0, n_kv_heads: Optional[int] = None,
                  pos: str = "learned", rope_base: float = 10000.0,
+                 tie_embeddings: bool = False,
                  attn_fn: Optional[Callable] = None,
                  remat: bool = False, dtype=jnp.float32):
         if pos not in ("learned", "rope", "none"):
@@ -46,8 +47,11 @@ class TransformerLM(Module):
         # setup), "rope" rotary phases inside attention (no positional
         # parameters; extrapolates — nn/rotary.py), or "none"
         self.pos_kind = pos
-        self.tok = Embedding(vocab, dim, dtype=dtype)
-        self.pos = Embedding(max_seq, dim, dtype=dtype) \
+        # dimension-aware table init (std 1/sqrt(dim)): behind the first
+        # LayerNorm either scale trains, but with tied embeddings the
+        # table IS the output projection and unit-std rows diverge
+        self.tok = Embedding(vocab, dim, std=dim ** -0.5, dtype=dtype)
+        self.pos = Embedding(max_seq, dim, std=dim ** -0.5, dtype=dtype) \
             if pos == "learned" else None
         self.blocks = [
             TransformerBlock(dim, n_heads, mlp_ratio, causal=True,
@@ -57,7 +61,11 @@ class TransformerLM(Module):
             for _ in range(n_layers)
         ]
         self.ln_f = LayerNorm(dim, dtype=dtype)
-        self.head = Linear(dim, vocab, bias=False, dtype=dtype)
+        # tied embeddings (the GPT-2 recipe): the vocab projection reuses
+        # the token table transposed — no head parameter exists
+        self.tie_embeddings = tie_embeddings
+        self.head = None if tie_embeddings \
+            else Linear(dim, vocab, bias=False, dtype=dtype)
 
     def init(self, key) -> Params:
         ks = jax.random.split(key, self.n_layers + 3)
@@ -65,11 +73,26 @@ class TransformerLM(Module):
             "tok": self.tok.init(ks[0]),
             "blocks": [b.init(k) for b, k in zip(self.blocks, ks[2:-1])],
             "ln_f": self.ln_f.init(ks[-1]),
-            "head": self.head.init(ks[-1]),
         }
+        if self.head is not None:
+            p["head"] = self.head.init(ks[-1])
         if self.pos is not None:
             p["pos"] = self.pos.init(ks[1])
         return p
+
+    def head_weight(self, params):
+        """The (dim, vocab) vocab-projection matrix — the head's weight,
+        or the transposed token table when ``tie_embeddings``. The input
+        contract of ``ops.losses.fused_linear_cross_entropy``."""
+        if self.tie_embeddings:
+            return params["tok"]["emb"].T
+        return params["head"]["w"]
+
+    def project_vocab(self, params, x):
+        """Hidden states (..., dim) → logits (..., vocab). Single source
+        of truth for the output projection (training apply and the cached
+        decode path both route through it)."""
+        return jnp.matmul(x, self.head_weight(params))
 
     def apply(self, params: Params, tokens, *, rng=None, train: bool = False,
               pos_offset=0, return_hidden: bool = False, **_):
@@ -82,7 +105,7 @@ class TransformerLM(Module):
         ``return_hidden=True`` returns the post-final-norm hidden states
         (B, S, dim) *instead of* logits, skipping the vocab projection — the
         input contract of ``ops.losses.fused_linear_cross_entropy`` (pass
-        ``params["head"]["w"]`` as its weight), which streams the projection
+        ``model.head_weight(params)`` as its weight), which streams the projection
         chunkwise so the full (B, S, vocab) logits never materialize."""
         b, s = tokens.shape
         x = self.tok.apply(params["tok"], tokens)
@@ -106,4 +129,4 @@ class TransformerLM(Module):
         x = self.ln_f.apply(params["ln_f"], x)
         if return_hidden:
             return x
-        return self.head.apply(params["head"], x)
+        return self.project_vocab(params, x)

@@ -67,14 +67,21 @@ class Linear(Module):
 
 
 class Embedding(Module):
-    def __init__(self, vocab: int, dim: int, dtype=jnp.float32):
+    def __init__(self, vocab: int, dim: int, std: float = 1.0,
+                 dtype=jnp.float32):
         self.vocab = vocab
         self.dim = dim
+        # N(0, std). The default keeps historical behavior; models whose
+        # table doubles as the output projection (tied embeddings) MUST
+        # use a small std — at std=1 the tied logits come out with
+        # ~sqrt(dim) scale and the loss diverges within a few steps
+        # (TransformerLM passes dim**-0.5 for its tables).
+        self.std = std
         self.dtype = dtype
 
     def init(self, key) -> Params:
-        return {"emb": jax.random.normal(key, (self.vocab, self.dim),
-                                         self.dtype)}
+        return {"emb": self.std * jax.random.normal(
+            key, (self.vocab, self.dim)).astype(self.dtype)}
 
     def apply(self, params: Params, ids, **_):
         return jnp.take(params["emb"], ids, axis=0)

@@ -42,8 +42,16 @@ def transformer_lm_param_specs(model, tp_axis: str = "tp") -> Dict[str, Any]:
         "tok": {"emb": P()},
         "blocks": [block_specs() for _ in range(model.n_layers)],
         "ln_f": {"scale": P(), "bias": P()},
-        "head": {"w": P(None, t)},                        # vocab-sharded
     }
+    if model.head is not None:
+        specs["head"] = {"w": P(None, t)}                 # vocab-sharded
+    else:
+        # tied embeddings: the tok table IS the output projection, so it
+        # takes the vocab sharding (P(t, None) on (V, D) == the head's
+        # P(None, t) on (D, V) transposed) — keeps the projection
+        # column-parallel; the input-side lookup gathers over tp, a
+        # (B, S, D)-sized cost the partitioner inserts
+        specs["tok"] = {"emb": P(t, None)}
     if model.pos is not None:   # no table under pos="rope"/"none"
         specs["pos"] = {"emb": P()}
     return specs

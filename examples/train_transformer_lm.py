@@ -56,6 +56,9 @@ def parse_args(argv=None):
     p.add_argument("--n-kv-heads", default=None, type=int,
                    help="grouped-query attention: kv heads < n-heads "
                         "(shrinks kv projections and the decode KV cache)")
+    p.add_argument("--tie-embeddings", action="store_true",
+                   help="share the token table with the vocab projection "
+                        "(GPT-2 recipe; removes the head matrix)")
     p.add_argument("--pos", default="learned",
                    choices=["learned", "rope", "none"],
                    help="positional scheme: learned absolute table or "
@@ -231,6 +234,7 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
                                  n_layers=args.n_layers,
                                  n_heads=args.n_heads,
                                  n_kv_heads=args.n_kv_heads, pos=args.pos,
+                                 tie_embeddings=args.tie_embeddings,
                                  max_seq=args.seq_len, attn_fn=attn_fn,
                                  remat=args.remat, dtype=dtype)
     params = model.init(jax.random.PRNGKey(0))
@@ -288,7 +292,7 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
         def loss_fn(p, batch):
             x, y = batch
             hid = model.apply(p, x, return_hidden=True)
-            loss = fused_linear_cross_entropy(hid, p["head"]["w"], y)
+            loss = fused_linear_cross_entropy(hid, model.head_weight(p), y)
             # per-example nll is unavailable by design (the full logits
             # never exist); report the batch mean per example instead
             return loss, {"nll": jnp.broadcast_to(loss, (x.shape[0],))}
@@ -423,7 +427,7 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
                 def eval_fn(p, batch):
                     x, y = batch
                     hid = model.apply(p, x, return_hidden=True)
-                    loss = fused_linear_cross_entropy(hid, p["head"]["w"], y)
+                    loss = fused_linear_cross_entropy(hid, model.head_weight(p), y)
                     return jnp.broadcast_to(loss, (x.shape[0],))
             else:
                 def eval_fn(p, batch):

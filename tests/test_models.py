@@ -354,3 +354,77 @@ class TestFusedLinearCrossEntropy:
         for _ in range(5):
             out = step(out.params, out.opt_state, toks)
         assert float(out.loss.mean()) < l0
+
+
+class TestTiedEmbeddings:
+    """tie_embeddings: the vocab projection reuses the token table
+    transposed — no head parameter, logits = h @ emb.T."""
+
+    def _model(self, **kw):
+        return models.TransformerLM(vocab=61, dim=32, n_layers=2, n_heads=4,
+                                    max_seq=32, tie_embeddings=True, **kw)
+
+    def test_no_head_param_and_logits_use_emb(self):
+        model = self._model()
+        params = model.init(jax.random.PRNGKey(0))
+        assert "head" not in params
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 61)
+        hid = model.apply(params, toks, return_hidden=True)
+        logits = model.apply(params, toks)
+        want = np.asarray(hid) @ np.asarray(params["tok"]["emb"]).T
+        np.testing.assert_allclose(np.asarray(logits), want, atol=1e-5)
+
+    def test_trains_and_gradient_flows_through_both_uses(self):
+        from distributed_pytorch_tpu.parallel import make_train_step
+        model = self._model()
+        params = model.init(jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, 61)
+
+        def loss_fn(p, t):
+            return cross_entropy(model.apply(p, t[:, :-1]), t[:, 1:]), {}
+
+        opt = optim.adamw(1e-3)
+        step = make_train_step(loss_fn, opt, donate=False)
+        out = step(params, opt.init(params), toks)
+        l0 = float(out.loss.mean())
+        for _ in range(5):
+            out = step(out.params, out.opt_state, toks)
+        assert float(out.loss.mean()) < l0
+
+    def test_cached_decode_matches_full_forward(self):
+        from distributed_pytorch_tpu.models.generate import make_generate_fn
+        model = self._model(n_kv_heads=2, pos="rope")
+        params = model.init(jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 61)
+        out = np.asarray(make_generate_fn(model, 5)(
+            params, prompt, jax.random.PRNGKey(2)))
+        toks = np.asarray(prompt)
+        want = []
+        for _ in range(5):
+            logits = model.apply(params, jnp.asarray(toks))
+            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+            want.append(nxt)
+            toks = np.concatenate([toks, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(out, np.stack(want, axis=1))
+
+    def test_fused_ce_uses_head_weight(self):
+        from distributed_pytorch_tpu.ops.losses import \
+            fused_linear_cross_entropy
+        model = self._model()
+        params = model.init(jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(3), (2, 9), 0, 61)
+        hid = model.apply(params, toks[:, :-1], return_hidden=True)
+        fused = fused_linear_cross_entropy(hid, model.head_weight(params),
+                                           toks[:, 1:], chunk_rows=8)
+        ref = cross_entropy(model.apply(params, toks[:, :-1]), toks[:, 1:])
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                                   rtol=1e-6)
+
+    def test_param_count_saving(self):
+        tied = self._model().init(jax.random.PRNGKey(0))
+        untied = models.TransformerLM(vocab=61, dim=32, n_layers=2,
+                                      n_heads=4, max_seq=32).init(
+                                          jax.random.PRNGKey(0))
+        n = lambda p: sum(int(np.prod(l.shape))
+                          for l in jax.tree_util.tree_leaves(p))
+        assert n(untied) - n(tied) == 61 * 32

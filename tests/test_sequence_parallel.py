@@ -232,3 +232,34 @@ def test_ring_flash_grads_match_dense(sp_mesh8, causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=5e-4, atol=5e-4,
                                    err_msg=f"d{name}")
+
+
+def test_dp_tp_sp_tied_embeddings_parity():
+    """Tied embeddings under tensor parallelism: the tok table takes the
+    vocab sharding (P('tp', None) — the transposed head sharding), and
+    the mesh loss matches the single-device run exactly."""
+    mesh = context.init_mesh(dp=2, tp=2, sp=2)
+    try:
+        kw = dict(vocab=32, dim=16, n_layers=2, n_heads=2, max_seq=8,
+                  tie_embeddings=True)
+        model = models.TransformerLM(
+            attn_fn=make_gspmd_ring_attn_fn(mesh), **kw)
+        ref_model = models.TransformerLM(**kw)
+        params0 = ref_model.init(jax.random.PRNGKey(0))
+        assert "head" not in params0
+        params = shard_params(params0, transformer_lm_param_specs(model),
+                              mesh)
+        assert params["tok"]["emb"].sharding.spec == P("tp", None)
+        opt = optim.adamw(1e-3)
+
+        toks = np.random.default_rng(0).integers(0, 32, (4, 8)) \
+            .astype(np.int32)
+        step = make_spmd_train_step(_lm_loss(model), opt, donate=False)
+        batch = shard_batch_spec((toks, toks), mesh, P("dp", "sp"))
+        out = step(params, opt.init(params), batch)
+        ref_loss, _ = _lm_loss(ref_model)(params0, (jnp.asarray(toks),
+                                                    jnp.asarray(toks)))
+        np.testing.assert_allclose(float(out.loss), float(ref_loss),
+                                   rtol=2e-5)
+    finally:
+        dist.cleanup()
