@@ -39,11 +39,13 @@ HBM_BW = {
 
 def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
         prompt_len=128, max_new=256, batch=8, n_kv_heads=None,
-        dtype=jnp.bfloat16) -> dict:
+        int8_weights=False, dtype=jnp.bfloat16) -> dict:
     from benchmarks.mfu_transformer import count_params
     from distributed_pytorch_tpu import models
     from distributed_pytorch_tpu.models import make_generate_fn
     from distributed_pytorch_tpu.models.generate import prefill
+    from distributed_pytorch_tpu.ops.quant import (quantize_tree,
+                                                   quantized_bytes)
     from distributed_pytorch_tpu.utils.profiler import (fetch_fence,
                                                         time_steps_amortized)
 
@@ -53,6 +55,9 @@ def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
                                  max_seq=max_seq, dtype=dtype)
     params = model.init(jax.random.PRNGKey(0))
     n_params = count_params(params)
+    if int8_weights:
+        params = quantize_tree(params)
+    param_bytes = quantized_bytes(params)
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
                                 0, vocab, dtype=jnp.int32)
 
@@ -99,12 +104,16 @@ def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
     tok_s_e2e = batch * max_new / t_total
     tok_s_decode = batch * decode_steps / t_decode
     bpe = jnp.dtype(dtype).itemsize
-    # each decode step streams the params plus the FULL preallocated cache
-    # (decode attends over max_len under a position mask — static shapes);
-    # GQA shrinks the cache rows to n_kv_heads * head_dim
+    # each decode step streams the params (int8 bytes when quantized —
+    # an ASSUMPTION the est_achieved_hbm numbers inherit: if XLA hoists
+    # the dequant out of the decode scan, actual traffic is the bf16
+    # bytes; the int8-vs-bf16 tok/s comparison in run_gqa_compare is the
+    # empirical check) plus the FULL preallocated cache (decode attends
+    # over max_len under a position mask — static shapes); GQA shrinks
+    # the cache rows to n_kv_heads * head_dim
     kv_dim = (n_kv_heads or n_heads) * (dim // n_heads)
     kv_bytes = n_layers * 2 * batch * kv_dim * max_seq * bpe
-    bytes_per_step = n_params * bpe + kv_bytes
+    bytes_per_step = param_bytes + kv_bytes
     achieved_bw = bytes_per_step * decode_steps / t_decode
 
     dev = jax.devices()[0]
@@ -115,8 +124,10 @@ def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
                    "n_kv_heads": n_kv_heads or n_heads,
                    "vocab": vocab, "prompt_len": prompt_len,
                    "max_new": max_new, "batch": batch,
+                   "int8_weights": bool(int8_weights),
                    "dtype": str(jnp.dtype(dtype).name)},
         "n_params": n_params,
+        "param_bytes": int(param_bytes),
         "wall_s_median": round(t_total, 4),
         "prefill_ms": round(t_prefill * 1e3, 3),
         "e2e_tokens_per_sec": round(tok_s_e2e, 1),
@@ -131,18 +142,23 @@ def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
 
 
 def run_gqa_compare(small: bool = False) -> dict:
-    """MHA vs grouped-query decode at equal model class. Decode is
-    KV-cache-bandwidth-bound, so the speedup quantifies what the
-    group-factor-smaller cache buys (untrained weights, identical
-    compute graph shape). One schema for the small and full arms."""
+    """MHA vs grouped-query decode vs int8 weights, at equal model class.
+    Decode is bandwidth-bound (params + KV cache stream once per token),
+    so the speedups quantify what the group-factor-smaller cache (GQA)
+    and the halved weight bytes (int8) buy — untrained weights, identical
+    compute graph shape. One schema for the small and full arms."""
     kw = dict(dim=128, n_layers=2, n_heads=4, vocab=512, prompt_len=16,
               max_new=32, batch=2) if small else {}
+    n_kv = 1 if small else 3                         # group 4
     mha = run(**kw)
-    gqa = run(n_kv_heads=1 if small else 3, **kw)   # group 4
-    return {"mha": mha, "gqa": gqa,
+    gqa = run(n_kv_heads=n_kv, **kw)
+    gqa_int8 = run(n_kv_heads=n_kv, int8_weights=True, **kw)
+    base = mha["decode_tokens_per_sec"]
+    return {"mha": mha, "gqa": gqa, "gqa_int8": gqa_int8,
             "gqa_decode_speedup": round(
-                gqa["decode_tokens_per_sec"]
-                / mha["decode_tokens_per_sec"], 2)}
+                gqa["decode_tokens_per_sec"] / base, 2),
+            "gqa_int8_decode_speedup": round(
+                gqa_int8["decode_tokens_per_sec"] / base, 2)}
 
 
 def main(argv):

@@ -82,11 +82,13 @@ class TransformerLM(Module):
 
     def head_weight(self, params):
         """The (dim, vocab) vocab-projection matrix — the head's weight,
-        or the transposed token table when ``tie_embeddings``. The input
-        contract of ``ops.losses.fused_linear_cross_entropy``."""
+        or the transposed token table when ``tie_embeddings``; either may
+        be int8-quantized (ops/quant.py). The input contract of
+        ``ops.losses.fused_linear_cross_entropy``."""
+        from ..ops.quant import resolve_weight
         if self.tie_embeddings:
-            return params["tok"]["emb"].T
-        return params["head"]["w"]
+            return resolve_weight(params["tok"], "emb", self.dtype).T
+        return resolve_weight(params["head"], "w", self.dtype)
 
     def project_vocab(self, params, x):
         """Hidden states (..., dim) → logits (..., vocab). Single source

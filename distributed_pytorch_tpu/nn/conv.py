@@ -50,8 +50,9 @@ class Conv2d(Module):
         return p
 
     def apply(self, params: Params, x, **_):
+        from ..ops.quant import resolve_weight
         y = lax.conv_general_dilated(
-            x, params["w"],
+            x, resolve_weight(params, "w", self.dtype),
             window_strides=(self.stride, self.stride),
             padding=[(self.padding, self.padding)] * 2,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),

@@ -60,7 +60,11 @@ class Linear(Module):
         return p
 
     def apply(self, params: Params, x, **_):
-        y = jnp.matmul(x, params["w"])
+        # params may hold the weight int8-quantized ({"w_q","w_scale"},
+        # ops/quant.py); the dequant fuses into the matmul so HBM streams
+        # the int8 bytes
+        from ..ops.quant import resolve_weight
+        y = jnp.matmul(x, resolve_weight(params, "w", self.dtype))
         if self.bias:
             y = y + params["b"]
         return y
@@ -84,7 +88,12 @@ class Embedding(Module):
             key, (self.vocab, self.dim)).astype(self.dtype)}
 
     def apply(self, params: Params, ids, **_):
-        return jnp.take(params["emb"], ids, axis=0)
+        if "emb" in params:
+            return jnp.take(params["emb"], ids, axis=0)
+        # int8 table (ops/quant.py): gather the int8 rows, dequantize
+        # only what was looked up
+        rows = jnp.take(params["emb_q"], ids, axis=0).astype(self.dtype)
+        return rows * params["emb_scale"].astype(self.dtype)
 
 
 class LayerNorm(Module):

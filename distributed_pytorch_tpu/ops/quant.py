@@ -1,0 +1,107 @@
+"""Weight-only int8 quantization for inference.
+
+Single-token decode streams every parameter once per token — it is
+bandwidth-bound, not FLOP-bound (benchmarks/decode_tpu.py) — so halving
+weight bytes (bf16 -> int8) is a direct decode-throughput lever on TPU,
+orthogonal to the GQA cache shrink. This module implements the standard
+weight-only recipe: symmetric per-channel int8 (scales over the
+contraction axis, one scale per output channel), dequantized on the fly
+into the matmul dtype. Activations stay in bf16/f32 — no calibration
+data needed, and quality loss is the weight rounding error only
+(~0.4% relative per channel at int8).
+
+The quantized representation is a DROP-IN param-tree transform
+(:func:`quantize_tree`): a Linear/Embedding leaf dict ``{"w": ...}``
+becomes ``{"w_q": int8, "w_scale": f32}`` and ``nn.core`` consumes
+either form — every model/call-site works unchanged on a quantized
+tree. The reference has no inference path at all, let alone a quantized
+one (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+# weight matrices smaller than this stay unquantized (LN scales, biases,
+# tiny projections — no bandwidth to win, precision to lose)
+DEFAULT_MIN_SIZE = 4096
+
+
+def quantize_int8(w: jnp.ndarray):
+    """Symmetric per-output-channel int8.
+
+    ``w``: (..., in, out) — scales are max(|w|)/127 over the contraction
+    axis (-2), shape ``w.shape[:-2] + (out,)``. For an Embedding table
+    (vocab, dim) pass it as-is: scales per dim column, i.e. the table is
+    treated as the (vocab -> dim) projection it is; its transposed use as
+    a tied output head dequantizes with the same scales.
+    Returns ``(w_q int8, scale f32)`` with ``w ~= w_q * scale``.
+    """
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2) / 127.0
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[..., None, :]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize(w_q: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
+    """w_q * scale in ``dtype``.
+
+    Intended behavior: XLA fuses the dequant into the consumer matmul so
+    the int8 bytes (not the dequantized values) are what HBM streams.
+    CAVEAT: inside a scan whose iterations all consume the same weights
+    (the cached decode loop), loop-invariant code motion may hoist the
+    dequantized bf16 tensor out of the loop — then each step streams
+    bf16 again and the bandwidth win evaporates. The decode benchmark
+    measures the int8 arm AGAINST the bf16 arm (decode_tpu.py
+    run_gqa_compare) precisely so this shows up empirically; if the
+    speedups ever match, the next step is a pallas matmul that takes the
+    int8 weights directly."""
+    return w_q.astype(dtype) * scale[..., None, :].astype(dtype)
+
+
+def resolve_weight(leaf: Any, key: str, dtype):
+    """Read weight ``key`` from a param dict that may hold it quantized
+    (``{key}_q`` + ``{key}_scale``). The one accessor every consumer
+    (nn.core.Linear/Embedding, TransformerLM.head_weight) goes through."""
+    if key in leaf:
+        return leaf[key]
+    return dequantize(leaf[f"{key}_q"], leaf[f"{key}_scale"], dtype)
+
+
+def quantize_tree(params: Any, *, min_size: int = DEFAULT_MIN_SIZE) -> Any:
+    """Quantize every eligible weight in a param pytree.
+
+    Eligible: a dict entry named ``w`` or ``emb`` whose array has ndim
+    >= 2 and >= ``min_size`` elements. Biases, LayerNorm scales and
+    small matrices pass through. Returns a new tree; use for INFERENCE
+    only (training on int8 weights would quantize the gradient signal
+    away).
+    """
+    def rec(node):
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if (k in ("w", "emb") and hasattr(v, "ndim")
+                        and v.ndim >= 2 and v.size >= min_size):
+                    q, s = quantize_int8(v)
+                    out[f"{k}_q"] = q
+                    out[f"{k}_scale"] = s
+                else:
+                    out[k] = rec(v)
+            return out
+        if isinstance(node, (list, tuple)):
+            return type(node)(rec(v) for v in node)
+        return node
+
+    return rec(params)
+
+
+def quantized_bytes(params: Any) -> int:
+    """Total parameter bytes of a (possibly quantized) tree — the number
+    decode streams per token."""
+    return sum(x.size * x.dtype.itemsize
+               for x in jax.tree_util.tree_leaves(params))

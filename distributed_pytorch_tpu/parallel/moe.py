@@ -89,7 +89,9 @@ class MoELayer(Module):
         e, k = self.n_experts, self.top_k
         cap = max(int(self.capacity_factor * n * k / e), 1)
 
-        logits = (xt @ params["gate"]["w"]).astype(jnp.float32)  # (N, E)
+        from ..ops.quant import resolve_weight
+        gate_w = resolve_weight(params["gate"], "w", self.dtype)
+        logits = (xt @ gate_w).astype(jnp.float32)               # (N, E)
         probs = jax.nn.softmax(logits, axis=-1)
         if self.router == "experts":
             return self._expert_choice(params, x, xt, probs, logits,
@@ -135,11 +137,14 @@ class MoELayer(Module):
         """Shared dispatch → per-expert GELU MLP → combine block: the
         routers differ only in how they build the (N, E, C) dispatch and
         combine tensors."""
+        from ..ops.quant import resolve_weight
+        w1 = resolve_weight(params["fc1"], "w", self.dtype)
+        w2 = resolve_weight(params["fc2"], "w", self.dtype)
         expert_in = jnp.einsum("nec,nd->ecd", dispatch,
                                xt.astype(jnp.float32))           # (E, C, D)
-        h = gelu(jnp.einsum("ecd,edh->ech", expert_in, params["fc1"]["w"])
+        h = gelu(jnp.einsum("ecd,edh->ech", expert_in, w1)
                  + params["fc1"]["b"][:, None, :])
-        expert_out = (jnp.einsum("ech,ehd->ecd", h, params["fc2"]["w"])
+        expert_out = (jnp.einsum("ech,ehd->ecd", h, w2)
                       + params["fc2"]["b"][:, None, :])          # (E, C, D)
         return jnp.einsum("nec,ecd->nd", combine, expert_out)
 
