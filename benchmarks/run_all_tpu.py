@@ -4,12 +4,12 @@ The tunneled TPU backend in this environment comes and goes; when it is
 healthy, this script collects everything BASELINE.md lists as pending:
 
 1. flash-attention compiled validation + speedup table
-   (benchmarks/flash_attention_tpu.py)
+   (benchmarks/flash_attention_tpu.py, adaptive block defaults)
 2. the remat arm of the flagship MFU measurement
-   (benchmarks/mfu_transformer.py --remat; the default-config arm comes
-   from bench.py below)
-3. the headline bench record (bench.py — embeds default MFU, min_ddp,
-   and decode)
+   (benchmarks/mfu_transformer.py --remat; the default-config and
+   --model medium arms come from bench.py below)
+3. the headline bench record (bench.py — embeds flagship MFU, the
+   medium-model MFU arm, min_ddp, and the decode MHA/GQA/int8 arms)
 
 A TPU-health probe gates everything: without a healthy chip no stage
 launches (a CPU fallback would grind the flagship through interpret-mode
