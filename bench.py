@@ -140,6 +140,66 @@ def run_json_subprocess(argv, timeout_s: int, *, label: str,
     return payload
 
 
+RESULTS_LOG = os.path.join(REPO, "benchmarks", "tpu_results.jsonl")
+
+
+def append_result(stage: str, result: dict, *, ok: bool = None,
+                  wall_s: float = None) -> None:
+    """Append one raw benchmark record to the on-chip results log, in the
+    same {stage, ok, wall_s, result, ts} shape run_all_tpu.run_stage
+    writes. Every honest run must leave a raw-JSON trace (round-3
+    lesson: the log held only retracted rows while the real numbers
+    lived in prose)."""
+    rec = {"stage": stage,
+           "ok": bool(result.get("error") is None) if ok is None else ok,
+           "wall_s": round(wall_s, 1) if wall_s is not None else None,
+           "result": result,
+           "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    try:
+        with open(RESULTS_LOG, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError as e:
+        print(f"# could not append to {RESULTS_LOG}: {e}", file=sys.stderr)
+
+
+def last_good_record() -> dict:
+    """Most recent non-retracted on-chip FLAGSHIP-config MFU record from
+    the results log, so a wedged tunnel never again nulls a round's
+    headline: the emitted record points at a raw row a reader can
+    verify. Only the pinned flagship config qualifies — a bench_mfu row
+    (this script's mfu stage) or a composite bench_headline row whose
+    metric is the headline metric; the medium-model arm must never leak
+    into the headline's fallback."""
+    best = {}
+    try:
+        with open(RESULTS_LOG) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if row.get("retracted") or not row.get("ok"):
+                    continue
+                res = row.get("result", {})
+                if row.get("stage") == "bench_mfu":
+                    mfu = res.get("mfu")
+                elif res.get("metric") == "transformer_lm_mfu_single_chip":
+                    mfu = res.get("value")
+                else:
+                    continue
+                if mfu is not None:
+                    best = {"mfu": mfu, "ts": row.get("ts"),
+                            "stage": row.get("stage"),
+                            "device": res.get("device"),
+                            "tokens_per_sec": res.get("tokens_per_sec"),
+                            "source": "benchmarks/tpu_results.jsonl"}
+    except OSError:
+        pass
+    return best
+
+
 def _run_stage(stage: str, timeout_s: int) -> dict:
     """Re-invoke this script for one measurement stage in a subprocess
     with a hard timeout — the tunnel can wedge mid-run, and the
@@ -236,6 +296,18 @@ def bench_min_ddp(n_steps: int = 2000, fused_chunk: int = 100) -> dict:
             "timing_method": "chained dispatch, host-fetch fence"}
 
 
+def _pin_torch_threads(torch) -> None:
+    """Pin torch to a fixed thread count: the round-3 LM baseline spread
+    43.5-63.6 tok/s (+/-46%) across runs from host contention, which made
+    vs_baseline soft. A fixed count keeps the denominator comparable
+    across rounds even when the host is busy."""
+    n = int(os.environ.get("DPX_TORCH_THREADS", "8"))
+    try:
+        torch.set_num_threads(n)
+    except RuntimeError:
+        pass  # already started threading: keep whatever it has
+
+
 def bench_torch_cpu_mlp(n_steps: int = 500) -> float:
     """Measured baseline: the reference's workload in eager torch on this
     host's CPU (the reference's world<=1 branch runs exactly this,
@@ -244,6 +316,7 @@ def bench_torch_cpu_mlp(n_steps: int = 500) -> float:
     import torch.nn as nn
     from distributed_pytorch_tpu.data import DummyDataset
 
+    _pin_torch_threads(torch)
     torch.manual_seed(0)
     model = nn.Sequential(nn.Linear(1, HIDDEN), nn.Linear(HIDDEN, N_CLASSES))
     opt = torch.optim.AdamW(model.parameters(), 1e-4)
@@ -253,25 +326,33 @@ def bench_torch_cpu_mlp(n_steps: int = 500) -> float:
     y = torch.tensor(ds.labels[:BATCH]).long()
     for _ in range(20):
         opt.zero_grad(); crit(model(x), y).backward(); opt.step()
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        opt.zero_grad()
-        loss = crit(model(x), y)
-        loss.backward()
-        opt.step()
-    return n_steps / (time.perf_counter() - t0)
+
+    def one_run():
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            opt.zero_grad()
+            loss = crit(model(x), y)
+            loss.backward()
+            opt.step()
+        return n_steps / (time.perf_counter() - t0)
+
+    # median-of-3: host CPU contention produced +/-46% spread round 3
+    return sorted(one_run() for _ in range(3))[1]
 
 
-def bench_torch_cpu_lm(batch=2, n_steps=2) -> float:
+def bench_torch_cpu_lm(batch=2, n_steps=2, reps=3) -> dict:
     """tokens/s for the flagship LM config in eager torch CPU — the
     vs_baseline denominator for the MFU headline. The model config comes
     from benchmarks.mfu_transformer.FLAGSHIP (single source of truth);
     only batch is reduced — CPU throughput is ~flat in batch and a full
-    flagship batch takes minutes per step here."""
+    flagship batch takes minutes per step here. Thread-pinned,
+    median-of-``reps`` with the spread reported (round-3 runs varied
+    +/-46% under host contention)."""
     import torch
     import torch.nn as nn
 
     from benchmarks.mfu_transformer import FLAGSHIP
+    _pin_torch_threads(torch)
     dim, n_layers, n_heads = (FLAGSHIP["dim"], FLAGSHIP["n_layers"],
                               FLAGSHIP["n_heads"])
     vocab, seq = FLAGSHIP["vocab"], FLAGSHIP["seq"]
@@ -299,11 +380,20 @@ def bench_torch_cpu_lm(batch=2, n_steps=2) -> float:
         opt.step()
 
     one_step()  # warmup
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        one_step()
-    dt = time.perf_counter() - t0
-    return n_steps * batch * seq / dt
+    runs = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            one_step()
+        dt = time.perf_counter() - t0
+        runs.append(n_steps * batch * seq / dt)
+    runs.sort()
+    med = runs[len(runs) // 2]
+    spread = (runs[-1] - runs[0]) / med if med else 0.0
+    return {"tokens_per_sec": round(med, 1),
+            "runs_tokens_per_sec": [round(r, 1) for r in runs],
+            "spread_frac": round(spread, 3),
+            "torch_threads": torch.get_num_threads()}
 
 
 # ---------------------------------------------------------------------------
@@ -394,6 +484,7 @@ def main():
 
     if info:
         mfu_rec = _run_stage("mfu", timeout_s=1800)
+        append_result("bench_mfu", mfu_rec)
         if "mfu" in mfu_rec:
             rec["value"] = mfu_rec["mfu"]
             rec["tokens_per_sec"] = mfu_rec["tokens_per_sec"]
@@ -403,15 +494,30 @@ def main():
         # bigger matmuls, higher attainable MFU — a reporting arm, never
         # the headline (the flagship config is pinned for comparability)
         rec["mfu_medium"] = _run_stage("mfu_medium", timeout_s=1800)
+        append_result("bench_mfu_medium", rec["mfu_medium"])
         rec["min_ddp"] = _run_stage("min_ddp", timeout_s=900)
+        append_result("bench_min_ddp", rec["min_ddp"])
         # two full decode benchmarks (MHA + GQA arms) live in this stage
         rec["decode"] = _run_stage("decode", timeout_s=2400)
+        append_result("bench_decode", rec["decode"])
     else:
         rec["error"] = "no healthy TPU backend after retries"
 
+    if rec["value"] is None:
+        # traceable fallback — covers BOTH failure modes: backend never
+        # appeared, or it appeared and the mfu stage wedged mid-run (the
+        # round-3 killer). The headline stays null (nothing was measured
+        # NOW), but the record carries the last verified on-chip number
+        # + where its raw row lives.
+        lg = last_good_record()
+        if lg:
+            rec["last_good"] = lg
+
     try:
-        tps = bench_torch_cpu_lm()
-        rec["torch_cpu_lm_tokens_per_sec"] = round(tps, 1)
+        lm_base = bench_torch_cpu_lm()
+        tps = lm_base["tokens_per_sec"]
+        rec["torch_cpu_lm_tokens_per_sec"] = tps
+        rec["torch_cpu_lm_baseline_detail"] = lm_base
         if rec.get("tokens_per_sec"):
             rec["vs_baseline"] = round(rec["tokens_per_sec"] / tps, 2)
     except Exception as e:  # noqa: BLE001
@@ -429,6 +535,13 @@ def main():
         pass
 
     rec["dp8"] = bench_dp8()
+
+    # the composite headline record is itself a raw-JSON trace — except
+    # under run_all_tpu, whose bench_headline stage wrapper already logs
+    # this whole record (avoid double rows for one run)
+    if os.environ.get("DPX_BENCH_SELFLOG", "1") != "0":
+        append_result("bench_record", rec,
+                      ok=rec.get("value") is not None)
 
     print(json.dumps(rec))
 

@@ -45,6 +45,15 @@ def make_fn(n):
     return jax.jit(f)
 
 
+def resolve_peak_flops() -> float:
+    """Peak bf16 FLOP/s for the attached chip, from the same table the
+    MFU bench maintains — the analytic_min (and hence the probe's whole
+    verdict) is wrong if computed against another generation's peak."""
+    from benchmarks.mfu_transformer import PEAK_BF16
+    kind = jax.devices()[0].device_kind
+    return PEAK_BF16.get(kind, 197e12)
+
+
 def probe_size(n, peak_flops=197e12, reps=5):
     f = make_fn(n)
     x = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
@@ -98,7 +107,8 @@ def main(argv):
         sizes = [int(s) for s in
                  argv[argv.index("--sizes") + 1].split(",")]
     dev = jax.devices()[0]
-    rows = [probe_size(n) for n in sizes]
+    peak = resolve_peak_flops()
+    rows = [probe_size(n, peak_flops=peak) for n in sizes]
     for r in rows:
         print(f"# n={r['n']}: min {r['analytic_min_ms']}ms  "
               f"dispatch {r['dispatch_ms']}ms  block {r['block_ms']}ms  "

@@ -69,6 +69,10 @@ def resolve_weight(leaf: Any, key: str, dtype):
     (nn.core.Linear/Embedding, TransformerLM.head_weight) goes through."""
     if key in leaf:
         return leaf[key]
+    if f"{key}_q" not in leaf or f"{key}_scale" not in leaf:
+        raise ValueError(
+            f"param dict holds neither '{key}' nor the quantized pair "
+            f"'{key}_q'/'{key}_scale' (keys present: {sorted(leaf)})")
     return dequantize(leaf[f"{key}_q"], leaf[f"{key}_scale"], dtype)
 
 

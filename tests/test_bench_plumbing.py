@@ -103,6 +103,42 @@ def test_wait_for_backend_bounded(monkeypatch):
     assert len(calls) == 3
 
 
+def test_append_and_last_good_roundtrip(tmp_path, monkeypatch):
+    """append_result writes the run_all_tpu row shape; last_good_record
+    surfaces the newest non-retracted FLAGSHIP record only — never the
+    medium arm, never a retracted row (the round-3 null-headline fix)."""
+    log = tmp_path / "results.jsonl"
+    monkeypatch.setattr(bench, "RESULTS_LOG", str(log))
+
+    assert bench.last_good_record() == {}  # no log yet
+
+    bench.append_result("bench_mfu", {"mfu": 0.40, "device": "d",
+                                      "tokens_per_sec": 1.0})
+    bench.append_result("bench_mfu_medium", {"mfu": 0.55, "device": "d"})
+    bench.append_result("bench_mfu", {"error": "wedged"})  # ok=False
+    rows = [json.loads(l) for l in log.read_text().splitlines()]
+    assert [r["ok"] for r in rows] == [True, True, False]
+    assert all(set(r) == {"stage", "ok", "wall_s", "result", "ts"}
+               for r in rows)
+
+    lg = bench.last_good_record()
+    assert lg["mfu"] == 0.40 and lg["stage"] == "bench_mfu"
+
+    # a composite headline row supersedes it; a retracted one never does
+    bench.append_result("bench_headline",
+                        {"metric": "transformer_lm_mfu_single_chip",
+                         "value": 0.45, "unit": "mfu_fraction"})
+    with open(log, "a") as f:
+        f.write(json.dumps({"stage": "bench_headline", "ok": True,
+                            "retracted": True,
+                            "result": {"metric":
+                                       "transformer_lm_mfu_single_chip",
+                                       "value": 7.42}}) + "\n")
+    lg = bench.last_good_record()
+    assert lg["mfu"] == 0.45
+    assert lg["source"] == "benchmarks/tpu_results.jsonl"
+
+
 def test_graft_entry_compiles_single_device():
     """entry() must stay jittable — the driver compile-checks it."""
     import importlib.util
