@@ -547,17 +547,38 @@ def flash_attention(q, k, v, *, causal: bool = False,
     return o
 
 
+# Measured flash/dense crossover (v5e, d=64, causal, honest amortized
+# timing — BASELINE.md round-3 table): seq 512 flash runs 0.87x dense
+# (grid too short to amortize kernel overhead); seq 1024 flash wins
+# 1.51x and the gap widens with seq (8.5x at 4096). Below this many
+# KEYS, the dense einsum is the faster O(S^2) and still cheap in
+# memory, so make_flash_attn_fn dispatches to it.
+FLASH_MIN_SEQ = 1024
+
+
 def make_flash_attn_fn(block_q: Optional[int] = None,
                        block_k: Optional[int] = None,
                        interpret: Optional[bool] = None,
-                       window: Optional[int] = None):
+                       window: Optional[int] = None,
+                       min_seq_flash: Optional[int] = FLASH_MIN_SEQ):
     """An ``attn_fn`` for :class:`nn.attention.MultiHeadAttention` /
     model constructors: models built with this compute attention through
     the pallas kernel instead of the dense einsum path. ``window`` bakes
     sliding-window (local) attention into the model — O(S*window)
-    compute and the long-context default for causal decoders."""
+    compute and the long-context default for causal decoders.
+
+    Below ``min_seq_flash`` keys (default: the measured v5e crossover,
+    ``FLASH_MIN_SEQ``) the call dispatches to the dense einsum instead —
+    same function, faster at short seq — so enabling flash is safe at
+    every sequence length. Shapes are static under jit, so the dispatch
+    costs nothing at runtime. Pass ``min_seq_flash=None`` (or 0) to
+    always run the kernel (tests, kernel benchmarking)."""
 
     def attn_fn(q, k, v, *, causal=False, scale=None):
+        if min_seq_flash and k.shape[-2] < min_seq_flash:
+            from ..nn.attention import dense_attention
+            return dense_attention(q, k, v, causal=causal, scale=scale,
+                                   window=window)
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k,
                                interpret=interpret, window=window)

@@ -103,13 +103,53 @@ def test_mha_with_flash_attn_fn():
 
     mha_dense = MultiHeadAttention(32, 4, causal=True)
     mha_flash = MultiHeadAttention(32, 4, causal=True,
-                                   attn_fn=make_flash_attn_fn(16, 16))
+                                   attn_fn=make_flash_attn_fn(16, 16, min_seq_flash=None))
     params = mha_dense.init(jax.random.PRNGKey(4))
     x = jax.random.normal(jax.random.PRNGKey(5), (2, 48, 32))
     want = mha_dense.apply(params, x)
     got = mha_flash.apply(params, x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_flash_min_seq_crossover_dispatch(monkeypatch):
+    """Below min_seq_flash keys the attn_fn must run the dense einsum
+    (the measured v5e crossover: flash loses to dense at seq 512,
+    BASELINE.md round-3 table); at/above it, the kernel. Verified by
+    counting kernel entries, and the two paths must agree numerically."""
+    import importlib
+    fa = importlib.import_module(
+        "distributed_pytorch_tpu.ops.flash_attention")
+
+    calls = {"kernel": 0}
+    real = fa.flash_attention
+
+    def counting(*a, **kw):
+        calls["kernel"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(fa, "flash_attention", counting)
+    attn_fn = fa.make_flash_attn_fn(16, 16, min_seq_flash=64)
+
+    q, k, v = _qkv(jax.random.PRNGKey(11), s_q=32, s_k=32)
+    short = attn_fn(q, k, v, causal=True)
+    assert calls["kernel"] == 0  # dense path took it
+    np.testing.assert_allclose(
+        np.asarray(short), np.asarray(dense_attention(q, k, v, causal=True)),
+        atol=2e-5, rtol=2e-5)
+
+    q, k, v = _qkv(jax.random.PRNGKey(12), s_q=64, s_k=64)
+    long = attn_fn(q, k, v, causal=True)
+    assert calls["kernel"] == 1  # kernel took it
+    np.testing.assert_allclose(
+        np.asarray(long), np.asarray(dense_attention(q, k, v, causal=True)),
+        atol=2e-5, rtol=2e-5)
+
+    # None disables the fallback entirely
+    always = fa.make_flash_attn_fn(16, 16, min_seq_flash=None)
+    q, k, v = _qkv(jax.random.PRNGKey(13), s_q=32, s_k=32)
+    always(q, k, v, causal=True)
+    assert calls["kernel"] == 2
 
 
 @pytest.mark.parametrize("s_q,s_k,window,bq,bk", [

@@ -146,7 +146,7 @@ class TestGQAModel:
     def test_flash_gqa_model_matches_dense_gqa_model(self):
         from distributed_pytorch_tpu.ops import make_flash_attn_fn
         dense = self._model()
-        flash = self._model(attn_fn=make_flash_attn_fn(16, 16))
+        flash = self._model(attn_fn=make_flash_attn_fn(16, 16, min_seq_flash=None))
         params = dense.init(jax.random.PRNGKey(0))
         toks = jax.random.randint(jax.random.PRNGKey(3), (2, 12), 0, 61)
         a = dense.apply(params, toks)

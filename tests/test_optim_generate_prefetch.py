@@ -179,7 +179,7 @@ class TestGenerate:
         dense = _lm()
         flash = models.TransformerLM(vocab=61, dim=32, n_layers=2,
                                      n_heads=4, max_seq=64,
-                                     attn_fn=make_flash_attn_fn(16, 16))
+                                     attn_fn=make_flash_attn_fn(16, 16, min_seq_flash=None))
         params = dense.init(jax.random.PRNGKey(0))
         prompt = jax.random.randint(jax.random.PRNGKey(5), (1, 6), 0, 61)
         a = make_generate_fn(dense, 5)(params, prompt, jax.random.PRNGKey(6))

@@ -127,7 +127,7 @@ class TestRopeModel:
         """RoPE + GQA + flash kernel together match the dense path."""
         from distributed_pytorch_tpu.ops import make_flash_attn_fn
         dense = self._model(n_kv_heads=2)
-        flash = self._model(n_kv_heads=2, attn_fn=make_flash_attn_fn(16, 16))
+        flash = self._model(n_kv_heads=2, attn_fn=make_flash_attn_fn(16, 16, min_seq_flash=None))
         params = dense.init(jax.random.PRNGKey(0))
         toks = jax.random.randint(jax.random.PRNGKey(3), (2, 12), 0, 61)
         np.testing.assert_allclose(np.asarray(dense.apply(params, toks)),
