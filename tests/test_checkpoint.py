@@ -157,6 +157,76 @@ def test_resume_under_8way_group(tmp_path, group8):
     _tree_eq(params, p2)
 
 
+def test_resume_under_fsdp_sharding(tmp_path):
+    """Sharded analog of test_resume_equivalence: save from an
+    FSDP-sharded (ZeRO-3 layout) train state, restore — which lands
+    unsharded host arrays — RE-SHARD via shard_model_and_opt, and
+    continue. The trajectory must be bit-exact vs the uninterrupted
+    sharded run, and the restored state must actually be laid out
+    sharded again (composing restore_checkpoint with the FSDP layout is
+    exactly what a real resume does)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from distributed_pytorch_tpu.parallel import (fsdp_param_specs,
+                                                  make_fsdp_train_step,
+                                                  shard_batch_spec,
+                                                  shard_model_and_opt)
+    from distributed_pytorch_tpu.runtime import context
+
+    mesh = context.init_mesh(dp=8)
+    model = models.TransformerLM(vocab=64, dim=32, n_layers=2, n_heads=4,
+                                 max_seq=16)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return cross_entropy_per_example(model.apply(p, x), y).mean(), {}
+
+    opt = optim.adamw(1e-2)
+    p_host = model.init(jax.random.PRNGKey(0))
+    specs = fsdp_param_specs(p_host, 8, min_size=128)
+    step = make_fsdp_train_step(loss_fn, opt, mesh, specs, donate=False)
+
+    rng = np.random.default_rng(11)
+    batches = [
+        shard_batch_spec(
+            (rng.integers(0, 64, size=(8, 16)).astype(np.int32),
+             rng.integers(0, 64, size=(8, 16)).astype(np.int32)),
+            mesh, P("dp", None))
+        for _ in range(4)]
+
+    # uninterrupted sharded run
+    params, st = shard_model_and_opt(p_host, opt.init(p_host), mesh, specs)
+    ref_losses = []
+    for b in batches:
+        params, st, loss, _ = step(params, st, b)
+        ref_losses.append(np.asarray(loss))
+    ref_params = params
+
+    # interrupted: 2 steps -> save (from the SHARDED arrays) -> restore
+    # -> re-shard -> 2 more steps
+    params, st = shard_model_and_opt(p_host, opt.init(p_host), mesh, specs)
+    for b in batches[:2]:
+        params, st, loss, _ = step(params, st, b)
+    save_checkpoint(str(tmp_path), 2, params, st)
+
+    ck = restore_checkpoint(str(tmp_path), like_params=p_host,
+                            like_opt_state=opt.init(p_host))
+    params, st = shard_model_and_opt(ck.params, ck.opt_state, mesh, specs)
+
+    # the re-placed tree is really sharded (not silently replicated)
+    big = [x for x in jax.tree_util.tree_leaves(params) if x.size >= 128]
+    spec_leaves = [s for x, s in zip(
+        jax.tree_util.tree_leaves(params),
+        jax.tree_util.tree_leaves(specs)) if x.size >= 128]
+    assert big and any(s != P() for s in spec_leaves)
+    for x, s in zip(big, spec_leaves):
+        assert x.sharding == NamedSharding(mesh, s)
+
+    for i, b in enumerate(batches[2:]):
+        params, st, loss, _ = step(params, st, b)
+        np.testing.assert_array_equal(np.asarray(loss), ref_losses[2 + i])
+    _tree_eq(params, ref_params)
+
+
 def test_manager_interval_retention_async(tmp_path):
     p = {"w": np.arange(4, dtype=np.float32)}
     with CheckpointManager(str(tmp_path), interval=2, keep=2,
