@@ -32,10 +32,10 @@ sys.path.insert(0, REPO)
 import bench  # noqa: E402  (the shared subprocess/JSON plumbing)
 
 
-def run_stage(name: str, argv, timeout_s: int) -> dict:
+def run_stage(name: str, argv, timeout_s: int, env: dict = None) -> dict:
     t0 = time.time()
     payload = bench.run_json_subprocess(argv, timeout_s, label=name,
-                                        keep_stdout_tail=True)
+                                        env=env, keep_stdout_tail=True)
     rec = {"stage": name, "ok": "error" not in payload,
            "wall_s": round(time.time() - t0, 1), "result": payload}
     return rec
@@ -75,19 +75,40 @@ def main(argv):
         return os.path.join(REPO, rel)
 
     stages = [("flash_attention",
-               [py, path("benchmarks/flash_attention_tpu.py")], 2400),
-              ("bench_headline", [py, path("bench.py")], 7200)]
+               [py, path("benchmarks/flash_attention_tpu.py")], 2400,
+               None),
+              # DPX_BENCH_SELFLOG=0: this wrapper logs the composite
+              # record; bench.py must not append a duplicate. Timeout
+              # must cover bench.py's own worst case: four child stages
+              # (1800+1800+900+2400s) + probe retries + the tripled
+              # (median-of-3) CPU baselines — a mid-run wedge burns all
+              # of it, and a SIGKILL here would lose the partial record.
+              ("bench_headline", [py, path("bench.py")], 10800,
+               {"DPX_BENCH_SELFLOG": "0"})]
     if not quick:
         # MFU sweep arm: remat trades activation HBM for FLOPs
         stages.insert(1, ("mfu_remat",
                           [py, path("benchmarks/mfu_transformer.py"),
-                           "--remat"], 1800))
+                           "--remat"], 1800, None))
+        # long-context arm: flagship model at seq 4096 — the regime the
+        # flash kernel's 8.5x win lives in (remat + fused-CE default on)
+        stages.insert(2, ("mfu_long",
+                          [py, path("benchmarks/mfu_transformer.py"),
+                           "--model", "long"], 2400, None))
+        # bottleneck map: ablation attribution of the flagship step at
+        # batch 8 and 32 (answers "why doesn't batch 16-64 beat 8")
+        stages.insert(3, ("step_breakdown",
+                          [py, path("benchmarks/step_breakdown.py")],
+                          2400, None))
+        stages.insert(4, ("step_breakdown_b32",
+                          [py, path("benchmarks/step_breakdown.py"),
+                           "--batch", "32"], 2400, None))
 
     results = []
     with open(out_path, "a") as f:
-        for name, cmd, timeout_s in stages:
+        for name, cmd, timeout_s, env in stages:
             print(f"=== {name} ===", flush=True)
-            rec = run_stage(name, cmd, timeout_s)
+            rec = run_stage(name, cmd, timeout_s, env=env)
             rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
             results.append(rec)
             f.write(json.dumps(rec) + "\n")

@@ -1,0 +1,195 @@
+"""Where does the flagship step's time go? (the MFU bottleneck map)
+
+Ablation-based attribution: time the full train step and a ladder of
+variants with the honest amortized fetch-fenced method, then read the
+components off the differences:
+
+- ``full``        forward + backward + AdamW update  (the flagship step)
+- ``no_opt``      forward + backward only            -> optimizer cost
+- ``fwd``         forward (loss) only                -> backward cost
+- ``attn_stub``   full, attention replaced by identity(v)
+                                                     -> attention cost
+- ``no_head``     full, vocab projection + CE replaced by a mean over
+                  hidden                             -> head+CE cost
+- ``dense_attn``  full, dense-einsum attention core  (flash vs dense at
+                                                       the flagship seq)
+
+Differences of amortized step times are far more robust on the tunneled
+backend than trace parsing (XProf's xplane protos need TF tooling this
+image doesn't ship), and each variant is a REAL compiled step — XLA
+fusion effects stay in.
+
+Also answers the round-3 question "why doesn't batch 16-64 beat batch
+8": run with --batch 8 and --batch 32 and compare which component fails
+to scale sublinearly.
+
+Usage: python benchmarks/step_breakdown.py [--batch N] [--seq N] [--steps N]
+Prints one JSON line; appends nothing (bench.py/run_all_tpu own the log).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from benchmarks.mfu_transformer import (FLAGSHIP, PEAK_BF16,
+                                        model_flops_per_token)
+
+
+def _flag(argv, name, default, cast=int):
+    if name in argv:
+        i = argv.index(name)
+        if i + 1 < len(argv):
+            return cast(argv[i + 1])
+    return default
+
+
+def _time_step(step, params, opt_state, tokens, steps):
+    """Amortized chained timing, one host fetch at the end (the only
+    fencing the tunneled backend cannot lie to — fence_probe.py)."""
+    from distributed_pytorch_tpu.utils.profiler import (fetch_fence,
+                                                        time_steps_amortized)
+    out = step(params, opt_state, tokens)
+    fetch_fence(out.loss)
+    out = step(out.params, out.opt_state, tokens)
+    fetch_fence(out.loss)
+    step_s, _ = time_steps_amortized(
+        lambda o: step(o.params, o.opt_state, tokens), out, steps,
+        lambda o: o.loss)
+    return step_s
+
+
+def _time_fwd(loss_fn, params, tokens, steps):
+    """Forward-only chain: the loss feeds back through a zero-sum trick
+    so each call depends on the previous (no dead-code elimination)."""
+    from distributed_pytorch_tpu.utils.profiler import (fetch_fence,
+                                                        time_steps_amortized)
+
+    @jax.jit
+    def fwd(carry, params, toks):
+        loss, _ = loss_fn(params, toks)
+        return carry + loss
+
+    c = fwd(jnp.float32(0.0), params, tokens)
+    fetch_fence(c)
+    c = fwd(c, params, tokens)
+    fetch_fence(c)
+    step_s, _ = time_steps_amortized(
+        lambda c: fwd(c, params, tokens), c, steps, lambda c: c)
+    return step_s
+
+
+def run(dim=FLAGSHIP["dim"], n_layers=FLAGSHIP["n_layers"],
+        n_heads=FLAGSHIP["n_heads"], vocab=FLAGSHIP["vocab"],
+        seq=FLAGSHIP["seq"], batch=FLAGSHIP["batch"], steps=20,
+        dtype=jnp.bfloat16) -> dict:
+    from distributed_pytorch_tpu import models, optim
+    from distributed_pytorch_tpu.ops import make_flash_attn_fn
+    from distributed_pytorch_tpu.ops.losses import cross_entropy
+    from distributed_pytorch_tpu.parallel import make_train_step
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1),
+                                0, vocab, dtype=jnp.int32)
+    opt = optim.adamw(3e-4)
+
+    def build(attn_fn):
+        model = models.TransformerLM(vocab=vocab, dim=dim,
+                                     n_layers=n_layers, n_heads=n_heads,
+                                     max_seq=seq, attn_fn=attn_fn,
+                                     dtype=dtype)
+        params = model.init(jax.random.PRNGKey(0))
+        return model, params
+
+    def ce_loss(model):
+        def loss_fn(p, toks):
+            logits = model.apply(p, toks[:, :-1]).astype(jnp.float32)
+            return cross_entropy(logits, toks[:, 1:]), {}
+        return loss_fn
+
+    def headless_loss(model):
+        def loss_fn(p, toks):
+            hid = model.apply(p, toks[:, :-1], return_hidden=True)
+            return jnp.mean(hid.astype(jnp.float32) ** 2), {}
+        return loss_fn
+
+    flash = make_flash_attn_fn()
+
+    def attn_identity(q, k, v, *, causal=False, scale=None):
+        # keep a q/k dependence so neither projection is dead code, at
+        # negligible FLOPs vs the real attention matmuls
+        return v + 0.0 * (q + k.repeat(q.shape[-3] // k.shape[-3], -3))
+
+    rows = {}
+    model, params = build(flash)
+    st = opt.init(params)
+
+    rows["full"] = _time_step(make_train_step(ce_loss(model), opt,
+                                              donate=False),
+                              params, st, tokens, steps)
+
+    @jax.jit
+    def fwd_bwd(params, opt_state, toks):
+        (loss, _), grads = jax.value_and_grad(ce_loss(model),
+                                              has_aux=True)(params, toks)
+        # fold grads into the carried loss so the whole backward is live
+        gsum = sum(jnp.sum(jnp.abs(g).astype(jnp.float32) * 0.0)
+                   for g in jax.tree_util.tree_leaves(grads))
+        from distributed_pytorch_tpu.parallel.spmd import SpmdStepOutput
+        return SpmdStepOutput(params, opt_state, loss + gsum, {})
+
+    rows["no_opt"] = _time_step(fwd_bwd, params, st, tokens, steps)
+    rows["fwd"] = _time_fwd(ce_loss(model), params, tokens, steps)
+
+    m2, p2 = build(attn_identity)
+    rows["attn_stub"] = _time_step(
+        make_train_step(ce_loss(m2), opt, donate=False), p2,
+        opt.init(p2), tokens, steps)
+
+    rows["no_head"] = _time_step(
+        make_train_step(headless_loss(model), opt, donate=False),
+        params, st, tokens, steps)
+
+    m3, p3 = build(None)  # dense einsum core
+    rows["dense_attn"] = _time_step(
+        make_train_step(ce_loss(m3), opt, donate=False), p3,
+        opt.init(p3), tokens, steps)
+
+    full = rows["full"]
+    ms = {k: round(v * 1e3, 3) for k, v in rows.items()}
+    attribution = {
+        "attention_ms": round((full - rows["attn_stub"]) * 1e3, 3),
+        "head_ce_ms": round((full - rows["no_head"]) * 1e3, 3),
+        "optimizer_ms": round((full - rows["no_opt"]) * 1e3, 3),
+        "backward_ms": round((rows["no_opt"] - rows["fwd"]) * 1e3, 3),
+        "flash_vs_dense_ms": round((rows["dense_attn"] - full) * 1e3, 3),
+    }
+    dev = jax.devices()[0]
+    peak = PEAK_BF16.get(dev.device_kind)
+    tok = batch * seq
+    fl = 3 * model_flops_per_token(dim, n_layers, vocab, seq) * tok
+    return {"device": dev.device_kind,
+            "config": {"dim": dim, "n_layers": n_layers, "vocab": vocab,
+                       "seq": seq, "batch": batch,
+                       "dtype": str(jnp.dtype(dtype).name)},
+            "steps_timed": steps,
+            "step_ms": ms,
+            "attribution_ms": attribution,
+            "mfu_full": round(fl / rows["full"] / peak, 4) if peak else None}
+
+
+def main(argv):
+    rec = run(batch=_flag(argv, "--batch", FLAGSHIP["batch"]),
+              seq=_flag(argv, "--seq", FLAGSHIP["seq"]),
+              steps=_flag(argv, "--steps", 20))
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
