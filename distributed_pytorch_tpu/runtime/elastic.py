@@ -1,0 +1,112 @@
+"""Elastic training: restart-from-checkpoint supervision.
+
+The reference's failure story ends at detection (its ``join=True`` spawn
+surfaces child errors; recovery is the user re-running the command —
+reference ``README.md:121-125``). :mod:`watchdog` automates the
+detection half (fail-fast supervision, heartbeats, orphan cleanup); this
+module closes the loop with *recovery*: run the training entrypoint in a
+supervised subprocess and, when it dies — crash, OOM-kill, watchdog
+fail-fast, wedged-backend abort — relaunch it up to ``max_restarts``
+times with exponential backoff. Workers make this correct by being
+resume-idempotent: start from ``utils.checkpoint.latest_step`` when a
+checkpoint directory is non-empty (exactly what
+``examples/train_transformer_lm.py --save DIR --resume`` does), so a
+relaunch repeats no optimizer step and the loss trajectory continues
+bit-exactly (tests/test_elastic.py pins this).
+
+The child runs in a fresh OS process (spawn context by default): a
+segfaulted or OOM-killed worker cannot take the supervisor down, and a
+fresh process re-initializes the accelerator runtime cleanly — on the
+tunneled-TPU backend here a wedged client is unrecoverable in-process,
+so process-level restart is the ONLY restart that works.
+
+The restart attempt number is exported to the child as
+``DPX_ELASTIC_ATTEMPT`` (0 on the first launch); ``DPX_ELASTIC=1`` marks
+the child as elastically supervised.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import time
+from typing import Callable, NamedTuple, Optional, Sequence
+
+from .watchdog import WorkerFailure
+
+ATTEMPT_ENV = "DPX_ELASTIC_ATTEMPT"
+ELASTIC_ENV = "DPX_ELASTIC"
+
+
+class ElasticResult(NamedTuple):
+    restarts: int          # how many times the worker was relaunched
+    exitcodes: tuple       # exit code of every attempt (last one is 0)
+
+
+def _child_bootstrap(target, args, child_env):
+    """Module-level (spawn-picklable) child entry. Exports the elastic
+    bookkeeping + caller env IN THE CHILD (the parent's environment must
+    not be mutated — a leaked DPX_ELASTIC would make the supervisor
+    itself claim to be supervised), then applies ``DPX_PLATFORM``
+    (+ ``DPX_CPU_DEVICES`` for cpu) via jax.config before any backend
+    use — env-var platform selection is too late in this environment
+    (site customization pre-imports jax), and a CI/test child must be
+    able to opt out of a wedged TPU."""
+    os.environ.update(child_env)
+    plat = os.environ.get("DPX_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+        n = os.environ.get("DPX_CPU_DEVICES")
+        if plat == "cpu" and n:
+            jax.config.update("jax_num_cpu_devices", int(n))
+    target(*args)
+
+
+def elastic_run(target: Callable, args: Sequence = (), *,
+                max_restarts: int = 3, backoff_s: float = 1.0,
+                ctx_method: str = "spawn",
+                env: Optional[dict] = None) -> ElasticResult:
+    """Run ``target(*args)`` in a subprocess; relaunch on failure.
+
+    ``target`` must be picklable (module-level) and resume-idempotent:
+    on restart it is called with the SAME arguments and is expected to
+    pick up from its latest checkpoint. Returns once an attempt exits 0;
+    raises :class:`watchdog.WorkerFailure` when ``max_restarts``
+    relaunches are exhausted. ``backoff_s`` doubles per restart (a
+    crashing-on-start worker must not busy-loop the host). ``env``
+    entries are exported to the child (on top of the parent's
+    environment)."""
+    ctx = mp.get_context(ctx_method)
+    codes = []
+    for attempt in range(max_restarts + 1):
+        child_env = {ATTEMPT_ENV: str(attempt), ELASTIC_ENV: "1"}
+        if env:
+            child_env.update({k: str(v) for k, v in env.items()})
+        p = ctx.Process(target=_child_bootstrap,
+                        args=(target, tuple(args), child_env))
+        p.start()
+        p.join()
+        codes.append(p.exitcode)
+        if p.exitcode == 0:
+            return ElasticResult(restarts=attempt, exitcodes=tuple(codes))
+        if attempt < max_restarts:
+            sleep = backoff_s * (2 ** attempt)
+            print(f"# elastic: attempt {attempt} exited "
+                  f"{p.exitcode}; relaunching in {sleep:.1f}s "
+                  f"({max_restarts - attempt} restart(s) left)", flush=True)
+            time.sleep(sleep)
+    raise WorkerFailure(
+        f"worker failed {max_restarts + 1} times "
+        f"(exit codes {codes}); giving up")
+
+
+def elastic_attempt() -> int:
+    """The current process's restart attempt number (0 = first launch,
+    also when not running under :func:`elastic_run`)."""
+    return int(os.environ.get(ATTEMPT_ENV, "0"))
+
+
+def is_elastic() -> bool:
+    """Whether this process is supervised by :func:`elastic_run`."""
+    return os.environ.get(ELASTIC_ENV) == "1"

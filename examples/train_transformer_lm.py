@@ -129,6 +129,11 @@ def parse_args(argv=None):
                    help="Restore the latest checkpoint from --save and "
                         "continue (exact continuation: the data stream "
                         "fast-forwards to the saved step).")
+    p.add_argument("--elastic", default=0, type=int, metavar="N",
+                   help="Supervise training in a child process and "
+                        "relaunch up to N times on failure, resuming "
+                        "from the latest --save checkpoint "
+                        "(runtime/elastic.py; requires --save).")
     return p.parse_args(argv)
 
 
@@ -468,5 +473,32 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
     return params
 
 
+def _elastic_entry():
+    """Spawn-side entrypoint for ``--elastic``: run the normal worker,
+    resuming automatically whenever the save dir already holds a
+    checkpoint (the relaunch after a crash must not restart from
+    step 0 — and must not require the user to have typed --resume)."""
+    import sys as _sys
+
+    from distributed_pytorch_tpu.utils.checkpoint import latest_step
+
+    argv = list(_sys.argv[1:])
+    args = parse_args(argv)
+    if args.save and latest_step(args.save) is not None \
+            and "--resume" not in argv:
+        argv.append("--resume")
+    dist.launch(main_worker, argv)
+
+
 if __name__ == "__main__":
-    dist.launch(main_worker)
+    _args = parse_args()
+    if _args.elastic:
+        if not _args.save:
+            raise SystemExit("--elastic requires --save DIR")
+        from distributed_pytorch_tpu.runtime import elastic
+        res = elastic.elastic_run(_elastic_entry,
+                                  max_restarts=_args.elastic)
+        if res.restarts:
+            print(f"finished after {res.restarts} relaunch(es)")
+    else:
+        dist.launch(main_worker)

@@ -1,0 +1,127 @@
+"""Elastic restart-from-checkpoint supervision (runtime/elastic.py):
+crash mid-training, relaunch, resume from the latest checkpoint, and land
+on the bit-exact same final state as an uninterrupted run."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu.runtime import elastic
+from distributed_pytorch_tpu.runtime.watchdog import WorkerFailure
+
+STEPS = 6
+CRASH_AT = 3
+
+
+def _train_worker(workdir: str, crash_on_first: bool):
+    """Module-level (spawn-picklable) training entrypoint: resume from
+    the latest checkpoint when one exists; on the first elastic attempt
+    optionally die mid-run like a preempted/OOM-killed worker."""
+    import jax  # the spawn child re-imports; switch platform before use
+    jax.config.update("jax_platforms", "cpu")
+    os.environ.setdefault("DPX_CPU_DEVICES", "1")
+
+    from distributed_pytorch_tpu import models, optim
+    from distributed_pytorch_tpu.ops.losses import cross_entropy
+    from distributed_pytorch_tpu.parallel import make_train_step
+    from distributed_pytorch_tpu.runtime.elastic import elastic_attempt
+    from distributed_pytorch_tpu.utils.checkpoint import (latest_step,
+                                                          restore_checkpoint,
+                                                          save_checkpoint)
+
+    model = models.DummyModel(in_dim=1, hidden_dim=8, n_classes=4)
+    opt = optim.adamw(1e-2)
+    step_fn = make_train_step(_loss(model), opt, donate=False)
+
+    params = model.init(jax.random.PRNGKey(0))
+    st = opt.init(params)
+    start = 0
+    if latest_step(workdir) is not None:
+        ck = restore_checkpoint(workdir, like_params=params,
+                                like_opt_state=st)
+        params, st, start = ck.params, ck.opt_state, ck.step
+
+    rng = np.random.default_rng(7)
+    batches = [(rng.random((4, 1), dtype=np.float32),
+                rng.integers(0, 4, size=(4,)).astype(np.int32))
+               for _ in range(STEPS)]
+
+    losses = []
+    for s in range(start, STEPS):
+        params, st, loss, _ = step_fn(params, st, batches[s])
+        losses.append(float(np.asarray(loss).sum()))
+        save_checkpoint(workdir, s + 1, params, st)
+        if crash_on_first and elastic_attempt() == 0 and s + 1 == CRASH_AT:
+            os._exit(3)          # hard death: no cleanup, like a SIGKILL
+
+    np.savez(os.path.join(workdir, "final.npz"),
+             **{f"p{i}": np.asarray(l) for i, l in
+                enumerate(jax.tree_util.tree_leaves(params))})
+    with open(os.path.join(workdir, "losses.json"), "a") as f:
+        f.write(json.dumps(losses) + "\n")
+
+
+def _loss(model):
+    import jax.numpy as jnp  # noqa: F401
+    from distributed_pytorch_tpu.ops.losses import cross_entropy
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return cross_entropy(model.apply(p, x), y), {}
+    return loss_fn
+
+
+def _final(workdir):
+    z = np.load(os.path.join(workdir, "final.npz"))
+    return [z[k] for k in sorted(z.files)]
+
+
+def test_crash_resume_matches_uninterrupted(tmp_path):
+    crashed = str(tmp_path / "crashed")
+    straight = str(tmp_path / "straight")
+    os.makedirs(crashed), os.makedirs(straight)
+
+    res = elastic.elastic_run(_train_worker, (crashed, True),
+                              max_restarts=2, backoff_s=0.01,
+                              env={"DPX_ELASTIC_TEST_LEAK": "x"})
+    assert res.restarts == 1
+    assert res.exitcodes == (3, 0)
+    # the supervisor's own environment must be untouched (the child gets
+    # the bookkeeping + caller env; the parent is not supervised)
+    assert not elastic.is_elastic()
+    assert "DPX_ELASTIC_TEST_LEAK" not in os.environ
+
+    res2 = elastic.elastic_run(_train_worker, (straight, False),
+                               max_restarts=0, backoff_s=0.01)
+    assert res2 == elastic.ElasticResult(0, (0,))
+
+    for a, b in zip(_final(crashed), _final(straight)):
+        np.testing.assert_array_equal(a, b)
+
+    # only the resumed attempt reaches the end (attempt 0 hard-died
+    # before its write), and it continued from CRASH_AT, repeating no
+    # step: its losses are exactly the uninterrupted run's tail
+    runs = [json.loads(l)
+            for l in open(os.path.join(crashed, "losses.json"))]
+    assert [len(r) for r in runs] == [STEPS - CRASH_AT]
+    uninterrupted = json.loads(
+        open(os.path.join(straight, "losses.json")).readline())
+    assert runs[0] == pytest.approx(uninterrupted[CRASH_AT:], abs=0)
+
+
+def _always_dies():
+    os._exit(1)
+
+
+def test_gives_up_after_max_restarts():
+    with pytest.raises(WorkerFailure, match="failed 3 times"):
+        elastic.elastic_run(_always_dies, max_restarts=2, backoff_s=0.0)
+
+
+def test_attempt_helpers_default_outside_elastic(monkeypatch):
+    monkeypatch.delenv(elastic.ATTEMPT_ENV, raising=False)
+    monkeypatch.delenv(elastic.ELASTIC_ENV, raising=False)
+    assert elastic.elastic_attempt() == 0
+    assert not elastic.is_elastic()
