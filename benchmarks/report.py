@@ -1,0 +1,167 @@
+"""Render the measured-results section from the raw records log.
+
+BASELINE.md's rule (round 4 on) is that prose tables are regenerated
+from `benchmarks/tpu_results.jsonl` — this is the regenerator. It reads
+every non-retracted `ok` row, keeps the NEWEST record per stage, and
+prints a markdown summary ready to paste into BASELINE.md (plus one JSON
+line for tooling). Retracted rows are listed by stage + reason so the
+retraction trail stays visible.
+
+Usage: python benchmarks/report.py [--log FILE]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_LOG = os.path.join(REPO, "benchmarks", "tpu_results.jsonl")
+
+
+def load_rows(path):
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    try:
+                        rows.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
+    except OSError:
+        pass
+    return rows
+
+
+def latest_per_stage(rows):
+    """Newest non-retracted ok row per stage (file order = time order)."""
+    out = {}
+    for r in rows:
+        if r.get("ok") and not r.get("retracted"):
+            out[r.get("stage", "?")] = r
+    return out
+
+
+def _fmt(v, nd=3):
+    if isinstance(v, float):
+        s = f"{v:.{nd}f}"
+        return s.rstrip("0").rstrip(".") if "." in s else s
+    return str(v)
+
+
+def render(rows) -> str:
+    live = latest_per_stage(rows)
+    lines = ["## Measured (regenerated from benchmarks/tpu_results.jsonl)",
+             ""]
+    if not live:
+        lines.append("*(no non-retracted successful records on file)*")
+
+    def res(stage):
+        return live.get(stage, {}).get("result", {})
+
+    if "bench_mfu" in live:
+        src_stage = "bench_mfu"
+        mfu = res("bench_mfu")
+    else:
+        src_stage = ("bench_headline" if "bench_headline" in live
+                     else "bench_record")
+        mfu = res(src_stage).get("mfu_detail", {})
+    if mfu.get("mfu") is not None:
+        c = mfu.get("config", {})
+        src = f"stage {src_stage}, {live.get(src_stage, {}).get('ts', '?')}"
+        lines += [
+            "| Metric | Value | Source row |",
+            "|---|---|---|",
+            f"| **Flagship MFU** | **{_fmt(mfu['mfu'], 4)}** "
+            f"({_fmt(mfu.get('achieved_tflops_per_sec', 0), 1)} of "
+            f"{_fmt(mfu.get('peak_bf16_tflops', 0), 0)} peak TF/s) | "
+            f"{src} |",
+            f"| Flagship tokens/s | {_fmt(mfu.get('tokens_per_sec', 0))} "
+            f"(step {_fmt(mfu.get('step_ms_median', 0))} ms, "
+            f"batch {c.get('batch')}, seq {c.get('seq')}) | same |",
+        ]
+        med = res("bench_mfu_medium")
+        if med.get("mfu") is not None:
+            lines.append(f"| medium (~355M) MFU | {_fmt(med['mfu'], 4)} | "
+                         f"stage bench_mfu_medium |")
+        lng = res("mfu_long")
+        if lng.get("mfu") is not None:
+            lines.append(
+                f"| long-context (seq 4096) MFU | {_fmt(lng['mfu'], 4)}"
+                f" (hw {_fmt(lng.get('mfu_hw') or 0, 4)}) | "
+                f"stage mfu_long |")
+        lines.append("")
+
+    dec = res("bench_decode")
+    header_done = False
+    for arm in ("mha", "gqa", "gqa_int8"):
+        d = dec.get(arm, {})
+        if d.get("decode_tokens_per_sec"):
+            if not header_done:
+                lines += ["| Decode arm | tok/s | ms/token | est HBM util |",
+                          "|---|---|---|---|"]
+                header_done = True
+            lines.append(
+                f"| {arm} | {_fmt(d['decode_tokens_per_sec'], 1)} | "
+                f"{_fmt(d.get('decode_per_token_latency_ms', 0))} | "
+                f"{_fmt(d.get('est_hbm_utilization', 0))} |")
+    if dec.get("gqa_decode_speedup"):
+        lines.append(f"\nGQA decode speedup {dec['gqa_decode_speedup']}x; "
+                     f"int8 {dec.get('gqa_int8_decode_speedup')}x.")
+
+    fa = res("flash_attention")
+    if fa.get("rows"):
+        lines += ["", "| seq | flash fwd (ms) | dense fwd (ms) | fwd x | "
+                  "flash f+b (ms) | dense f+b (ms) | f+b x |",
+                  "|---|---|---|---|---|---|---|"]
+        for r in fa["rows"]:
+            lines.append(
+                f"| {r['seq']} | {_fmt(r['flash_fwd_ms'], 2)} | "
+                f"{_fmt(r['dense_fwd_ms'], 2)} | "
+                f"{_fmt(r['fwd_speedup'], 2)}x | "
+                f"{_fmt(r['flash_fwdbwd_ms'], 2)} | "
+                f"{_fmt(r['dense_fwdbwd_ms'], 2)} | "
+                f"{_fmt(r['fwdbwd_speedup'], 2)}x |")
+
+    for stage in ("step_breakdown", "step_breakdown_b32"):
+        sb = res(stage)
+        if sb.get("attribution_ms"):
+            a = sb["attribution_ms"]
+            lines += ["", f"Step attribution ({stage}, batch "
+                      f"{sb.get('config', {}).get('batch')}): "
+                      + ", ".join(f"{k} {_fmt(v, 2)}"
+                                  for k, v in a.items())
+                      + f"; full step {_fmt(sb['step_ms']['full'], 2)} ms."]
+
+    retracted = [r for r in rows if r.get("retracted")]
+    if retracted:
+        lines += ["", "Retracted rows (kept for the audit trail):"]
+        for r in retracted:
+            lines.append(f"- {r.get('stage')} ({r.get('ts', '?')}): "
+                         f"{r.get('reason', 'retracted')[:100]}")
+    return "\n".join(lines)
+
+
+def main(argv):
+    path = DEFAULT_LOG
+    if "--log" in argv:
+        i = argv.index("--log")
+        if i + 1 >= len(argv):
+            print("usage: report.py [--log FILE]", file=sys.stderr)
+            return 2
+        path = argv[i + 1]
+    rows = load_rows(path)
+    md = render(rows)
+    print(md)
+    live = latest_per_stage(rows)
+    print(json.dumps({"stages_on_file": sorted(live),
+                      "n_rows": len(rows),
+                      "n_retracted": sum(bool(r.get("retracted"))
+                                         for r in rows)}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

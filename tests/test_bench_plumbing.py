@@ -139,6 +139,39 @@ def test_append_and_last_good_roundtrip(tmp_path, monkeypatch):
     assert lg["source"] == "benchmarks/tpu_results.jsonl"
 
 
+def test_report_renders_latest_nonretracted(tmp_path):
+    """benchmarks/report.py: newest ok row per stage wins; retracted rows
+    appear only in the audit trail."""
+    from benchmarks import report
+
+    log = tmp_path / "log.jsonl"
+    rows = [
+        {"stage": "bench_mfu", "ok": True, "ts": "T1",
+         "result": {"mfu": 0.30, "tokens_per_sec": 1.0,
+                    "step_ms_median": 1.0, "config": {}}},
+        {"stage": "bench_mfu", "ok": True, "ts": "T2",
+         "result": {"mfu": 0.42, "tokens_per_sec": 2.0,
+                    "step_ms_median": 1.0,
+                    "achieved_tflops_per_sec": 82.7,
+                    "peak_bf16_tflops": 197.0,
+                    "config": {"batch": 8, "seq": 1024}}},
+        {"stage": "bench_mfu", "ok": False, "ts": "T3",
+         "result": {"error": "wedged"}},
+        {"stage": "old", "ok": True, "retracted": True,
+         "reason": "dispatch-rate artifact", "result": {"mfu": 7.4}},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+
+    loaded = report.load_rows(str(log))
+    live = report.latest_per_stage(loaded)
+    assert set(live) == {"bench_mfu"}
+    assert live["bench_mfu"]["result"]["mfu"] == 0.42
+
+    md = report.render(loaded)
+    assert "0.42" in md and "7.4" not in md.split("Retracted")[0]
+    assert "dispatch-rate artifact" in md
+
+
 def test_graft_entry_compiles_single_device():
     """entry() must stay jittable — the driver compile-checks it."""
     import importlib.util
