@@ -50,6 +50,7 @@ def test_transformer_lm_byte_corpus(tmp_path):
     assert len(h) == 3 and all(np.isfinite(x) for x in h)
 
 
+@pytest.mark.slow
 def test_resnet_synthetic():
     h = []
     dist.launch(train_resnet.main_worker,
@@ -133,6 +134,7 @@ def test_transformer_lm_checkpoint_resume_exact(tmp_path):
                                   np.asarray(full[5:9]))
 
 
+@pytest.mark.slow
 def test_long_context_sp_ring_flash():
     """Sequence-parallel long-context training: dp x sp mesh with the
     ring-flash attention island; loss finite and decreasing-ish over a
@@ -149,6 +151,7 @@ def test_long_context_sp_ring_flash():
     assert all(np.isfinite(x) for x in h)
 
 
+@pytest.mark.slow
 def test_transformer_lm_prefetch():
     """--prefetch N: batches arrive on device from the background thread;
     losses match the unprefetched run exactly (same data order)."""
@@ -162,6 +165,7 @@ def test_transformer_lm_prefetch():
     np.testing.assert_array_equal(np.asarray(h0), np.asarray(h1))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("router", ["tokens", "experts"])
 def test_moe_lm_example(router):
     """Expert-parallel MoE rung: dp x ep mesh, both routers; loss finite

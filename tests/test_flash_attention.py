@@ -160,6 +160,7 @@ def test_flash_min_seq_crossover_dispatch(monkeypatch):
     (32, 64, 8, 16, 16),    # cross lengths: off > 0 shifts the band
     (24, 48, 5, 8, 8),      # cross lengths, ragged, small blocks
 ])
+@pytest.mark.slow
 def test_sliding_window_matches_dense(s_q, s_k, window, bq, bk):
     """Causal sliding-window attention: values AND grads match the dense
     masked reference (the lower-edge tile skip must agree with the mask

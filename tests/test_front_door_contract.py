@@ -145,6 +145,7 @@ class TestFrontDoorContract:
     def test_spmd_door_matches_canonical(self, group8):
         assert _observe_spmd(8) == canonical(8)
 
+    @pytest.mark.slow
     def test_host_door_matches_canonical(self, tmp_path):
         from distributed_pytorch_tpu.runtime import launch_multiprocess
 
@@ -154,6 +155,7 @@ class TestFrontDoorContract:
             got = json.load(f)
         assert got == canonical(2)
 
+    @pytest.mark.slow
     def test_torch_door_matches_canonical(self, tmp_path):
         from distributed_pytorch_tpu.runtime.launcher import find_free_port
 

@@ -63,6 +63,7 @@ class TestFsdpSpecs:
 
 
 class TestFsdpNumerics:
+    @pytest.mark.slow
     def test_matches_replicated_dp(self):
         """ZeRO-3 is a layout, not math: the loss trajectory must equal
         replicated data parallelism step for step."""

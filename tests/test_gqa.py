@@ -122,6 +122,7 @@ class TestGQAModel:
             out = step(out.params, out.opt_state, toks)
         assert float(out.loss.mean()) < l0
 
+    @pytest.mark.slow
     def test_cache_shrinks_and_decode_matches_full_forward(self):
         """The KV cache allocates n_kv_heads; greedy cached decode equals
         argmax over the full uncached forward — the decode einsum's
@@ -166,6 +167,7 @@ def test_window_clamps_default_k_block():
     assert _block_sizes(4096, 4096, 64, 64, d=64, window=128) == (64, 64)
 
 
+@pytest.mark.slow
 def test_moe_lm_gqa_rope_trains():
     """MoETransformerLM accepts n_kv_heads + pos='rope' (no pos table in
     the tree) and its loss decreases."""

@@ -78,6 +78,7 @@ def _collectives_worker(rank, world, q):
         dist.cleanup()
 
 
+@pytest.mark.slow
 def test_native_collectives_multiprocess():
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
@@ -190,6 +191,7 @@ def _ddp_worker(rank, world, q):
         dist.cleanup()
 
 
+@pytest.mark.slow
 def test_host_ddp_loss_parity_vs_single_process():
     """2-process native-DDP training reproduces the single-process loss
     trajectory on the same global batches (BASELINE loss-curve parity,

@@ -77,6 +77,7 @@ def test_resnet18_shapes_and_state():
                                   np.asarray(new_state["bn_stem"]["mean"]))
 
 
+@pytest.mark.slow
 def test_resnet18_stateful_dp_training(group8):
     model = models.ResNet18(n_classes=4, small_input=True)
     params, state0 = model.init(jax.random.PRNGKey(0))
@@ -137,6 +138,7 @@ def test_scan_fused_steps_match_per_step(group8):
                                np.asarray(p2["lin1"]["w"]), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_transformer_remat_same_values_and_grads():
     """remat=True must be numerically invisible (same logits, same grads)
     and actually install the checkpoint primitive. (The HBM saving shows
@@ -241,6 +243,7 @@ class TestSyncBatchNorm:
                                    np.asarray(y_local),
                                    rtol=2e-5, atol=2e-6)
 
+    @pytest.mark.slow
     def test_resnet_sync_bn_trains(self, group8):
         """ResNet18(sync_bn=True) trains under the stateful DP step."""
         from distributed_pytorch_tpu import optim
@@ -391,6 +394,7 @@ class TestTiedEmbeddings:
             out = step(out.params, out.opt_state, toks)
         assert float(out.loss.mean()) < l0
 
+    @pytest.mark.slow
     def test_cached_decode_matches_full_forward(self):
         from distributed_pytorch_tpu.models.generate import make_generate_fn
         model = self._model(n_kv_heads=2, pos="rope")

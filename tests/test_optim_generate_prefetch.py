@@ -355,6 +355,7 @@ class TestAdafactor:
             params, st = step(params, st)
         assert float(loss(params)) < 0.8 * l0
 
+    @pytest.mark.slow
     def test_trains_lm_jitted(self):
         from distributed_pytorch_tpu.parallel import make_train_step
         from distributed_pytorch_tpu.ops.losses import cross_entropy

@@ -61,6 +61,7 @@ def test_pipeline_matches_sequential():
         dist.cleanup()
 
 
+@pytest.mark.slow
 def test_pipeline_backward_trains():
     """Gradients flow through the pipeline schedule (autodiffed GPipe)."""
     mesh = context.init_mesh(pp=4, dp=2)
@@ -85,6 +86,7 @@ def test_pipeline_backward_trains():
         dist.cleanup()
 
 
+@pytest.mark.slow
 def test_moe_layer_routing_invariants():
     """Every kept token's output is its expert's FFN of it, weighted by its
     gate prob; with ample capacity nothing is dropped."""
@@ -113,6 +115,7 @@ def test_moe_layer_routing_invariants():
     np.testing.assert_allclose(np.asarray(y), want, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_moe_capacity_drops_overflow():
     """With capacity 1 and all tokens routed to one expert, only one token
     gets output; the rest are dropped (zero)."""
@@ -124,6 +127,7 @@ def test_moe_capacity_drops_overflow():
     assert nonzero.sum() == 1
 
 
+@pytest.mark.slow
 def test_moe_lm_ep_sharded_training():
     """MoETransformerLM trains under a dp x tp x ep mesh with experts
     sharded over ep; loss decreases and expert params stay ep-sharded."""
@@ -223,6 +227,7 @@ class Test1F1BTraining:
         t = jnp.asarray(rng.standard_normal((batch, seq, dim)), jnp.float32)
         return block, layers, stacked, x, t
 
+    @pytest.mark.slow
     def test_1f1b_matches_sequential(self):
         mesh = context.init_mesh(pp=4, dp=2)
         try:
@@ -263,6 +268,7 @@ class Test1F1BTraining:
         finally:
             dist.cleanup()
 
+    @pytest.mark.slow
     def test_1f1b_ragged_batch(self):
         """batch 7 with 4 microbatches: the divisibility constraint is
         relaxed via zero-weight padding; numerics match the unpadded
@@ -413,6 +419,7 @@ def test_moe_z_loss_and_drop_metrics():
     np.testing.assert_allclose(np.asarray(m["expert_load"]).sum(), 1.0)
 
 
+@pytest.mark.slow
 def test_moe_lm_top2_trains():
     """MoETransformerLM with top_k=2 + z-loss trains under the ep mesh."""
     mesh = context.init_mesh(dp=2, tp=2, ep=2)

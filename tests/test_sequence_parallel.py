@@ -69,6 +69,7 @@ def _lm_loss(model):
     return loss_fn
 
 
+@pytest.mark.slow
 def test_dp_tp_sp_mesh_train_step():
     """Full composition: batch over dp=2, heads/mlp over tp=2, sequence
     over sp=2 — one jitted train step, loss matches the single-device
@@ -203,6 +204,7 @@ def test_ring_flash_matches_dense(sp_mesh8, causal):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_flash_grads_match_dense(sp_mesh8, causal):
     """jax.grad through the unrolled ring (reverse ppermutes + the flash

@@ -50,6 +50,7 @@ def _run_reference(world: int, *extra_args: str):
 
 
 class TestReferenceWorkloadUnmodified:
+    @pytest.mark.slow
     def test_world2_runs_and_aggregates(self):
         r = _run_reference(2, "--epochs", "1")
         assert r.returncode == 0, r.stderr[-2000:]
@@ -76,6 +77,7 @@ class TestReferenceWorkloadUnmodified:
         assert r.stdout.count("Finish iteration") == 4
         assert "(7/16)" not in r.stdout
 
+    @pytest.mark.slow
     def test_world2_loss_is_sum_over_ranks(self):
         """The reference prints reduce(loss) with op=SUM (the documented
         'average loss' comment is wrong — min_DDP.py:122); the primary's
@@ -240,6 +242,7 @@ def _spawn(target, world, args):
 
 
 class TestCrossImplementationParity:
+    @pytest.mark.slow
     def test_shim_ddp_matches_torch_gloo_ddp(self, tmp_path, monkeypatch):
         """world=2: the shim's grad-hook DDP over the native C++ group
         produces the same rank-0 loss trajectory as torch's own gloo
