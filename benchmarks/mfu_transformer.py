@@ -19,6 +19,7 @@ Usage: python benchmarks/mfu_transformer.py             (flagship, ~135M)
        python benchmarks/mfu_transformer.py --model medium   (~355M arm)
        python benchmarks/mfu_transformer.py --model long     (seq 4096 arm)
        flags: --batch N --remat --fused-ce --no-fused-ce --no-remat
+              --master-f32
 """
 
 from __future__ import annotations
@@ -93,6 +94,7 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
         seq: int = FLAGSHIP["seq"], batch: int = FLAGSHIP["batch"],
         steps: int = 30, dtype=jnp.bfloat16, remat: bool = False,
         use_flash: bool = True, fused_ce: bool = False,
+        master_f32: bool = False,
         interpret: Optional[bool] = None) -> dict:
     from distributed_pytorch_tpu import models, optim
     from distributed_pytorch_tpu.ops import make_flash_attn_fn
@@ -111,6 +113,12 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
     params = model.init(jax.random.PRNGKey(0))
     n_params = count_params(params)
     opt = optim.adamw(3e-4)
+    if master_f32:
+        # authoritative f32 copy updated by the inner optimizer; working
+        # bf16 params are its cast (the matmuls stay bf16). Perf cost =
+        # the extra f32 param stream per step; numerics gain = no stalled
+        # late-training updates (optim/schedules.py:with_master_f32)
+        opt = optim.with_master_f32(opt)
     opt_state = opt.init(params)
 
     if fused_ce:
@@ -183,7 +191,8 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
                                  else "dense(flash-crossover)")
                    if use_flash else "dense",
                    "remat": remat, "fused_ce": fused_ce,
-                   "optimizer": "adamw"},
+                   "optimizer": "adamw+master_f32" if master_f32
+                   else "adamw"},
         "n_params": n_params,
         "steps_timed": steps,
         "timing_method": "amortized_chain_fetch_fence",
@@ -220,6 +229,7 @@ def sweep(arms=None, steps: int = 20) -> dict:
     skipped — finding the HBM cliff is part of the sweep's job."""
     if arms is None:
         arms = [dict(batch=8), dict(batch=8, fused_ce=True),
+                dict(batch=8, fused_ce=True, master_f32=True),
                 dict(batch=16, fused_ce=True),
                 dict(batch=16, fused_ce=True, remat=True),
                 dict(batch=32, fused_ce=True, remat=True),
@@ -246,25 +256,29 @@ def sweep(arms=None, steps: int = 20) -> dict:
 def main(argv):
     remat = "--remat" in argv
     fused_ce = "--fused-ce" in argv
+    master_f32 = "--master-f32" in argv
     batch = _flag_val(argv, "--batch", None)
     if "--sweep" in argv:
-        if remat or fused_ce or batch:
-            print("# --sweep runs its own fixed arm grid; "
-                  "--batch/--remat/--fused-ce are ignored", file=sys.stderr)
+        if remat or fused_ce or batch or master_f32:
+            print("# --sweep runs its own fixed arm grid; --batch/--remat/"
+                  "--fused-ce/--master-f32 are ignored", file=sys.stderr)
         rec = sweep()
     elif "--small" in argv:
         rec = run(dim=128, n_layers=2, n_heads=4, vocab=512, seq=256,
-                  batch=batch or 4, steps=5, remat=remat, fused_ce=fused_ce)
+                  batch=batch or 4, steps=5, remat=remat, fused_ce=fused_ce,
+                  master_f32=master_f32)
     elif (model := _flag_val(argv, "--model", "flagship", str)) != "flagship":
         if model == "medium":
             cfg = dict(MEDIUM)
-            arm = dict(remat=remat, fused_ce=fused_ce)
+            arm = dict(remat=remat, fused_ce=fused_ce,
+                       master_f32=master_f32)
         elif model == "long":
             cfg = dict(LONGCTX)
             # remat + fused-CE on unless explicitly overridden: at seq
             # 4096 the logits and per-layer activations dominate HBM
             arm = dict(remat="--no-remat" not in argv,
-                       fused_ce="--no-fused-ce" not in argv)
+                       fused_ce="--no-fused-ce" not in argv,
+                       master_f32=master_f32)
         else:
             print(json.dumps({"error": f"unknown --model {model!r} "
                               "(choices: medium, long)"}))
@@ -273,7 +287,7 @@ def main(argv):
             cfg["batch"] = batch
         rec = run(steps=20, **arm, **cfg)
     else:
-        rec = run(remat=remat, fused_ce=fused_ce,
+        rec = run(remat=remat, fused_ce=fused_ce, master_f32=master_f32,
                   **({"batch": batch} if batch else {}))
     # one compact line: collectors parse the last stdout line as JSON
     print(json.dumps(rec))
