@@ -39,7 +39,8 @@ HBM_BW = {
 
 def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
         prompt_len=128, max_new=256, batch=8, n_kv_heads=None,
-        int8_weights=False, dtype=jnp.bfloat16) -> dict:
+        int8_weights=False, pin_weight_stream=False,
+        dtype=jnp.bfloat16) -> dict:
     from benchmarks.mfu_transformer import count_params
     from distributed_pytorch_tpu import models
     from distributed_pytorch_tpu.models import make_generate_fn
@@ -61,7 +62,8 @@ def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
                                 0, vocab, dtype=jnp.int32)
 
-    gen = jax.jit(make_generate_fn(model, max_new))
+    gen = jax.jit(make_generate_fn(
+        model, max_new, pin_weight_stream=pin_weight_stream))
     rng = jax.random.PRNGKey(2)
 
     # Amortized timing with host-fetch fencing (block_until_ready can
@@ -125,6 +127,7 @@ def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
                    "vocab": vocab, "prompt_len": prompt_len,
                    "max_new": max_new, "batch": batch,
                    "int8_weights": bool(int8_weights),
+                   "pin_weight_stream": bool(pin_weight_stream),
                    "dtype": str(jnp.dtype(dtype).name)},
         "n_params": n_params,
         "param_bytes": int(param_bytes),
@@ -153,12 +156,21 @@ def run_gqa_compare(small: bool = False) -> dict:
     mha = run(**kw)
     gqa = run(n_kv_heads=n_kv, **kw)
     gqa_int8 = run(n_kv_heads=n_kv, int8_weights=True, **kw)
+    # pinned arm: weight stream tied into the scan so int8 dequant can't
+    # be hoisted (generate.py:pin_weight_stream). int8 vs int8_pinned is
+    # the empirical answer to "did XLA hoist the dequant": if pinned is
+    # faster, the plain arm was streaming bf16.
+    gqa_int8_pin = run(n_kv_heads=n_kv, int8_weights=True,
+                       pin_weight_stream=True, **kw)
     base = mha["decode_tokens_per_sec"]
     return {"mha": mha, "gqa": gqa, "gqa_int8": gqa_int8,
+            "gqa_int8_pinned": gqa_int8_pin,
             "gqa_decode_speedup": round(
                 gqa["decode_tokens_per_sec"] / base, 2),
             "gqa_int8_decode_speedup": round(
-                gqa_int8["decode_tokens_per_sec"] / base, 2)}
+                gqa_int8["decode_tokens_per_sec"] / base, 2),
+            "gqa_int8_pinned_decode_speedup": round(
+                gqa_int8_pin["decode_tokens_per_sec"] / base, 2)}
 
 
 def main(argv):

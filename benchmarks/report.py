@@ -96,7 +96,7 @@ def render(rows) -> str:
 
     dec = res("bench_decode")
     header_done = False
-    for arm in ("mha", "gqa", "gqa_int8"):
+    for arm in ("mha", "gqa", "gqa_int8", "gqa_int8_pinned"):
         d = dec.get(arm, {})
         if d.get("decode_tokens_per_sec"):
             if not header_done:
@@ -108,8 +108,12 @@ def render(rows) -> str:
                 f"{_fmt(d.get('decode_per_token_latency_ms', 0))} | "
                 f"{_fmt(d.get('est_hbm_utilization', 0))} |")
     if dec.get("gqa_decode_speedup"):
-        lines.append(f"\nGQA decode speedup {dec['gqa_decode_speedup']}x; "
-                     f"int8 {dec.get('gqa_int8_decode_speedup')}x.")
+        line = (f"\nGQA decode speedup {dec['gqa_decode_speedup']}x; "
+                f"int8 {dec.get('gqa_int8_decode_speedup')}x")
+        if dec.get("gqa_int8_pinned_decode_speedup") is not None:
+            line += (f"; int8 pinned (anti-hoist) "
+                     f"{dec['gqa_int8_pinned_decode_speedup']}x")
+        lines.append(line + ".")
 
     fa = res("flash_attention")
     if fa.get("rows"):

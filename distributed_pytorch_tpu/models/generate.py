@@ -187,9 +187,22 @@ def _check_attn_compatible(model: TransformerLM,
 def make_generate_fn(model: TransformerLM, max_new: int, *,
                      temperature: float = 0.0, top_k: Optional[int] = None,
                      max_len: Optional[int] = None,
-                     allow_custom_attn: bool = False):
+                     allow_custom_attn: bool = False,
+                     pin_weight_stream: bool = False):
     """Build ``fn(params, prompt, rng) -> (B, max_new) tokens`` suitable
-    for ``jax.jit`` (all shape-determining arguments are closed over)."""
+    for ``jax.jit`` (all shape-determining arguments are closed over).
+
+    ``pin_weight_stream``: ties the params consumed by each decode step
+    to the loop-varying cache counter through an optimization barrier,
+    so weight-DERIVED tensors cannot be hoisted out of the scan by
+    loop-invariant code motion. Matters for int8 weights
+    (``ops/quant.py``): if XLA hoists the dequantized bf16 copy, every
+    step streams bf16 and the bandwidth win of storing int8 evaporates;
+    pinned, each step re-derives from the int8 bytes (dequant fuses into
+    the consuming matmul). Costs nothing when weights are un-quantized
+    except disabling that same hoisting — benchmark both
+    (benchmarks/decode_tpu.py measures the pinned arm against the plain
+    int8 arm to show which way XLA went)."""
     _check_attn_compatible(model, allow_custom_attn)
 
     def fn(params, prompt, rng):
@@ -210,7 +223,10 @@ def make_generate_fn(model: TransformerLM, max_new: int, *,
 
         def body(carry, step_rng):
             cache, token = carry
-            logits, cache = decode_step(model, params, cache, token)
+            p = params
+            if pin_weight_stream:
+                p, _ = jax.lax.optimization_barrier((params, cache.length))
+            logits, cache = decode_step(model, p, cache, token)
             nxt = _sample(logits, step_rng, temperature, top_k)
             return (cache, nxt), nxt
 

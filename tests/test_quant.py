@@ -79,6 +79,22 @@ class TestQuantizedInference:
             toks = np.concatenate([toks, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(out, np.stack(want, axis=1))
 
+    def test_pinned_weight_stream_same_tokens(self):
+        """pin_weight_stream is a scheduling hint (anti-LICM barrier in
+        the decode scan, generate.py) — it must not change a single
+        generated token, quantized or not."""
+        model = self._model(n_kv_heads=2)
+        params = model.init(jax.random.PRNGKey(0))
+        qp = quantize_tree(params, min_size=256)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 61)
+        rng = jax.random.PRNGKey(2)
+        for tree in (params, qp):
+            plain = np.asarray(make_generate_fn(model, 6)(
+                tree, prompt, rng))
+            pinned = np.asarray(make_generate_fn(
+                model, 6, pin_weight_stream=True)(tree, prompt, rng))
+            np.testing.assert_array_equal(plain, pinned)
+
     def test_moe_lm_quantized_forward(self):
         from distributed_pytorch_tpu.models.moe_lm import MoETransformerLM
         model = MoETransformerLM(vocab=61, dim=32, n_layers=2, n_heads=4,
