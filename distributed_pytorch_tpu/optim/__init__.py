@@ -186,5 +186,6 @@ def adafactor(lr: float = None, *, decay_pow: float = 0.8,
 # after it is defined.
 from . import schedules  # noqa: E402
 from .schedules import (accumulate, clip_by_global_norm, constant,  # noqa: E402
-                        cosine_decay, linear_warmup, warmup_cosine,
-                        with_clipping, with_master_f32, with_schedule)
+                        cosine_decay, ema_params, linear_warmup,
+                        warmup_cosine, with_clipping, with_ema,
+                        with_master_f32, with_schedule)

@@ -52,6 +52,13 @@ def parse_args(argv=None):
                         "torch DDP's per-device BN.")
     p.add_argument("--limit-steps", default=None, type=int,
                    help="Cap steps per epoch (smoke runs).")
+    p.add_argument("--ema", default=0.0, type=float, metavar="DECAY",
+                   help="Track an EMA of the weights (optim.with_ema) "
+                        "and report eval accuracy with both raw and "
+                        "averaged weights. Caveat: BN running stats come "
+                        "from the raw trajectory, so the EMA number "
+                        "understates until stats are re-estimated "
+                        "(torch swa_utils.update_bn has the same issue).")
     p.add_argument("--eval", action="store_true",
                    help="Evaluate after each epoch on the held-out split "
                         "(CIFAR test_batch, or 10%% of synthetic data).")
@@ -126,6 +133,10 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
             lambda x: x.astype(jnp.bfloat16)
             if x.dtype == jnp.float32 else x, params)
     optimizer = optim.sgd(args.lr, momentum=args.momentum)
+    if args.ema:
+        # the averaged weights live in the optimizer state: updated
+        # inside the compiled step, checkpointed/sharded with it
+        optimizer = optim.with_ema(optimizer, decay=args.ema)
 
     params = dist.replicate(params)
     opt_state = dist.replicate(optimizer.init(params))
@@ -202,14 +213,21 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
                 f"epoch {epoch}: acc {correct_sum / max(n_seen, 1):.4f} "
                 f"loss {losses[-1]:.4f}")
         if eval_step is not None:
-            evs = [eval_step(params, state, dist.shard_batch(b))
-                   for b in eval_loader]
-            corr = np.concatenate([np.asarray(e).reshape(-1) for e in evs])
-            logger.log(epoch, eval_acc=corr.mean())
-            if not quiet:
-                dist.print_primary(
-                    f"epoch {epoch}: EVAL acc {corr.mean():.4f} "
-                    f"({int(corr.sum())}/{corr.size})")
+            weight_sets = [("", params)]
+            if args.ema:
+                weight_sets.append(
+                    ("ema_", optim.ema_params(opt_state, like=params)))
+            for tag, w in weight_sets:
+                evs = [eval_step(w, state, dist.shard_batch(b))
+                       for b in eval_loader]
+                corr = np.concatenate([np.asarray(e).reshape(-1)
+                                       for e in evs])
+                logger.log(epoch, **{f"{tag}eval_acc": corr.mean()})
+                if not quiet:
+                    dist.print_primary(
+                        f"epoch {epoch}: EVAL{' (ema)' if tag else ''} "
+                        f"acc {corr.mean():.4f} "
+                        f"({int(corr.sum())}/{corr.size})")
 
     jax.block_until_ready(params)
     if t_run0 is not None and timed_steps > 0 and not quiet:

@@ -110,6 +110,118 @@ def _lm():
                                 max_seq=64)
 
 
+class TestEma:
+    def test_tracks_hand_rolled_average(self):
+        opt = optim.with_ema(optim.sgd(0.5), decay=0.9)
+        params = {"w": jnp.asarray([2.0, -1.0], jnp.float32)}
+        st = opt.init(params)
+        ema_ref = np.asarray(params["w"], np.float64)
+        p = params
+        for i in range(5):
+            g = {"w": jnp.asarray([0.1 * (i + 1), -0.2], jnp.float32)}
+            p, st = opt.update(g, st, p)
+            ema_ref = 0.9 * ema_ref + 0.1 * np.asarray(p["w"])
+        got = optim.ema_params(st)
+        np.testing.assert_allclose(np.asarray(got["w"]), ema_ref,
+                                   rtol=1e-6)
+        # inner sgd really applied: params moved
+        assert not np.allclose(np.asarray(p["w"]), [2.0, -1.0])
+
+    def test_constant_trajectory_is_identity(self):
+        """Params-initialized EMA is unbiased by construction: if the
+        params never move, the extracted average IS the params at every
+        step — no init transient, no correction factor (regression for
+        the Adam-style debias that scaled a convex combination by
+        1/(1-d^t) and returned garbage early weights)."""
+        opt = optim.with_ema(optim.sgd(0.0), decay=0.999)  # lr 0: frozen
+        params = {"w": jnp.asarray([3.0, -2.0], jnp.float32)}
+        st = opt.init(params)
+        p = params
+        for _ in range(3):
+            p, st = opt.update({"w": jnp.zeros(2, jnp.float32)}, st, p)
+            np.testing.assert_allclose(
+                np.asarray(optim.ema_params(st)["w"]),
+                np.asarray(params["w"]), rtol=1e-6)
+
+    def test_decay_validated(self):
+        with pytest.raises(ValueError, match="decay"):
+            optim.with_ema(optim.sgd(0.1), decay=1.0)
+        with pytest.raises(ValueError, match="decay"):
+            optim.with_ema(optim.sgd(0.1), decay=-0.1)
+
+    def test_nested_extraction_and_like_cast(self):
+        base = optim.with_ema(optim.adamw(1e-2), decay=0.5)
+        opt = optim.with_clipping(base, 1.0)
+        params = {"w": jnp.ones((4,), jnp.bfloat16)}
+        st = opt.init(params)
+        p, st = opt.update({"w": jnp.ones((4,), jnp.bfloat16)}, st, params)
+        out = optim.ema_params(st, like=p)
+        assert out["w"].dtype == jnp.bfloat16
+        with pytest.raises(ValueError, match="no EmaState"):
+            optim.ema_params(optim.adamw(1e-2).init(params))
+
+    def test_ema_state_shards_under_fsdp_specs(self):
+        from jax.sharding import PartitionSpec as P
+        from distributed_pytorch_tpu.parallel import fsdp_param_specs
+        from distributed_pytorch_tpu.parallel.fsdp import opt_state_specs
+
+        params = {"w": jnp.zeros((64, 64), jnp.float32)}
+        p_specs = fsdp_param_specs(params, 8, min_size=1)
+        st = optim.with_ema(optim.adamw(1e-3)).init(params)
+        o = opt_state_specs(st, p_specs, params=params)
+        assert o.ema == p_specs            # the average shards like params
+        # inner AdamW moments shard too; its step counter replicates
+        inner_leaves = jax.tree_util.tree_leaves(
+            o.inner, is_leaf=lambda x: isinstance(x, P))
+        assert p_specs["w"] in inner_leaves and P() in inner_leaves
+
+    def test_donating_first_step_no_buffer_aliasing(self):
+        """Regression: with_ema/with_master_f32 init must COPY leaves
+        that are already f32 — an aliased leaf makes a donating step's
+        first call donate the same buffer twice and crash."""
+        from distributed_pytorch_tpu.ops.losses import cross_entropy
+        from distributed_pytorch_tpu.parallel import make_train_step
+
+        model = models.DummyModel(in_dim=1, hidden_dim=8, n_classes=4)
+        x = np.zeros((4, 1), np.float32)
+        y = np.zeros((4,), np.int32)
+
+        def loss_fn(p, batch):
+            bx, by = batch
+            return cross_entropy(model.apply(p, bx), by), {}
+
+        for wrap in (lambda o: optim.with_ema(o, 0.9),
+                     optim.with_master_f32):
+            opt = wrap(optim.adamw(1e-2))
+            params = jax.device_put(model.init(jax.random.PRNGKey(0)))
+            st = opt.init(params)
+            step = make_train_step(loss_fn, opt, donate=True)
+            out = step(params, st, (x, y))       # must not crash
+            jax.block_until_ready(out.loss)
+
+    def test_inside_jitted_train_step(self):
+        from distributed_pytorch_tpu.ops.losses import cross_entropy
+        from distributed_pytorch_tpu.parallel import make_train_step
+
+        model = models.DummyModel(in_dim=1, hidden_dim=8, n_classes=4)
+        opt = optim.with_ema(optim.adamw(1e-2), decay=0.9)
+        params = model.init(jax.random.PRNGKey(0))
+        st = opt.init(params)
+
+        def loss_fn(p, batch):
+            x, y = batch
+            return cross_entropy(model.apply(p, x), y), {}
+
+        step = make_train_step(loss_fn, opt, donate=False)
+        x = np.random.default_rng(0).random((8, 1), np.float32)
+        y = np.zeros((8,), np.int32)
+        for _ in range(3):
+            params, st, loss, _ = step(params, st, (x, y))
+        avg = optim.ema_params(st, like=params)
+        out = model.apply(avg, jnp.asarray(x))   # usable weights
+        assert out.shape == (8, 4)
+
+
 class TestGenerate:
     def test_decode_matches_full_forward(self):
         """Greedy cached decoding must equal argmax over the full
