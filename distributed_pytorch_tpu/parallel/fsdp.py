@@ -25,7 +25,7 @@ added on dims the tp layout leaves free.
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Optional
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,7 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..optim import Optimizer
 from .spmd import SpmdStepOutput
-from .tensor import shard_params
+from .tensor import replicated_specs, shard_params
 
 
 def fsdp_param_specs(params, n_shards: int, *, axis: str = "dp",
@@ -107,15 +107,28 @@ def shard_model_and_opt(params, opt_state, mesh: Mesh, param_specs):
 
 def make_fsdp_train_step(loss_fn: Callable, optimizer: Optimizer,
                          mesh: Mesh, param_specs,
+                         state_specs: Optional[Any] = None,
                          donate: bool = True) -> Callable:
     """Compile ``step(params, opt_state, batch) -> SpmdStepOutput`` with
-    the ZeRO-3 layout pinned by sharding constraints.
+    the ZeRO layout pinned by sharding constraints.
 
     ``loss_fn(params, batch) -> (loss, metrics)`` is ordinary global-view
     model code, identical to what :func:`spmd.make_spmd_train_step` takes.
     The constraints force gradients and updated state back to the sharded
     layout, so XLA emits reduce-scatter for grads and keeps the AdamW
-    update local to each shard."""
+    update local to each shard.
+
+    Default (``state_specs=None``) is ZeRO-3: params, grads and
+    optimizer state all shard along ``param_specs``. Pass a DIFFERENT
+    spec tree as ``state_specs`` to split the layouts — the ZeRO-1 shape
+    is ``param_specs=replicated_specs(params)`` +
+    ``state_specs=fsdp_param_specs(params, n)``: forward/backward run on
+    replicated params (no per-use all-gather), grads reduce-scatter into
+    the sharded moment update, and the updated shards all-gather back
+    into replicated params — 1/N optimizer memory at ZeRO-3's update
+    cost but DP's forward cost. :func:`make_zero1_train_step` wraps
+    exactly that."""
+    state_specs = param_specs if state_specs is None else state_specs
 
     def constrain(tree, specs):
         return jax.tree_util.tree_map(
@@ -124,13 +137,38 @@ def make_fsdp_train_step(loss_fn: Callable, optimizer: Optimizer,
             tree, specs, is_leaf=lambda x: x is None)
 
     def step(params, opt_state, batch):
-        o_specs = opt_state_specs(opt_state, param_specs, params=params)
+        o_specs = opt_state_specs(opt_state, state_specs, params=params)
         (loss, metrics), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, batch)
-        grads = constrain(grads, param_specs)        # reduce-scatter point
+        grads = constrain(grads, state_specs)        # reduce-scatter point
         params, opt_state = optimizer.update(grads, opt_state, params)
         params = constrain(params, param_specs)
         opt_state = constrain(opt_state, o_specs)
         return SpmdStepOutput(params, opt_state, loss, metrics)
 
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def make_zero1_train_step(loss_fn: Callable, optimizer: Optimizer,
+                          mesh: Mesh, params, *, axis: str = "dp",
+                          min_size: int = 1024,
+                          donate: bool = True) -> Tuple[Callable, Any]:
+    """ZeRO-1: replicated params, optimizer state sharded over ``axis``.
+
+    The forward/backward see whole (replicated) params — no all-gather
+    per layer — while moments/master copies shard to 1/N memory; grads
+    reduce-scatter into the update and the fresh shards all-gather back
+    to replicated params once per step. The right point on the ladder
+    when params fit per-device but AdamW's 2x-params state does not
+    (reference frame: torch ZeroRedundancyOptimizer).
+
+    Returns ``(step, state_specs)`` — place the optimizer state with
+    ``shard_params(opt_state, opt_state_specs(opt_state, state_specs,
+    params), mesh)`` or just let the first constrained step lay it out.
+    """
+    p_specs = replicated_specs(params)
+    s_specs = fsdp_param_specs(params, mesh.shape[axis], axis=axis,
+                               min_size=min_size)
+    step = make_fsdp_train_step(loss_fn, optimizer, mesh, p_specs,
+                                state_specs=s_specs, donate=donate)
+    return step, s_specs

@@ -99,6 +99,51 @@ class TestFsdpNumerics:
             np.testing.assert_allclose(float(out_f.loss), float(out_r.loss),
                                        rtol=1e-5)
 
+    @pytest.mark.slow
+    def test_zero1_matches_replicated_dp_and_shards_state(self):
+        """ZeRO-1: replicated params + sharded optimizer state is also
+        pure layout — loss trajectory equals replicated DP; after a step
+        the params stay whole per device while the AdamW moments hold
+        1/8 shards."""
+        from distributed_pytorch_tpu.parallel import (make_zero1_train_step,
+                                                      replicated_specs)
+        from distributed_pytorch_tpu.parallel.fsdp import opt_state_specs
+
+        mesh = _mesh8()
+        model = _lm()
+        loss_fn = _loss_fn(model)
+        opt = optim.adamw(1e-3)
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, 64, (16, 16)).astype(np.int32)
+        batch = shard_batch_spec((toks, toks), mesh, P("dp", None))
+
+        p0 = model.init(jax.random.PRNGKey(0))
+        p_rep = shard_params(p0, replicated_specs(p0), mesh)
+        o_rep = opt.init(p_rep)
+        step_rep = make_spmd_train_step(loss_fn, opt, donate=False)
+
+        params = shard_params(model.init(jax.random.PRNGKey(0)),
+                              replicated_specs(p0), mesh)
+        step_z1, s_specs = make_zero1_train_step(loss_fn, opt, mesh,
+                                                 params, min_size=1,
+                                                 donate=False)
+        o_raw = opt.init(params)
+        opt_state = shard_params(
+            o_raw, opt_state_specs(o_raw, s_specs, params=params), mesh)
+
+        for _ in range(3):
+            out_r = step_rep(p_rep, o_rep, batch)
+            out_z = step_z1(params, opt_state, batch)
+            p_rep, o_rep = out_r.params, out_r.opt_state
+            params, opt_state = out_z.params, out_z.opt_state
+            np.testing.assert_allclose(float(out_z.loss),
+                                       float(out_r.loss), rtol=1e-5)
+
+        w = params["blocks"][0]["fc1"]["w"]
+        assert w.addressable_shards[0].data.size == w.size  # replicated
+        mu = opt_state.mu["blocks"][0]["fc1"]["w"]
+        assert mu.addressable_shards[0].data.size == mu.size // 8
+
     def test_state_actually_sharded(self):
         mesh = _mesh8()
         model = _lm()
