@@ -108,6 +108,7 @@ def shard_model_and_opt(params, opt_state, mesh: Mesh, param_specs):
 def make_fsdp_train_step(loss_fn: Callable, optimizer: Optimizer,
                          mesh: Mesh, param_specs,
                          state_specs: Optional[Any] = None,
+                         grad_specs: Optional[Any] = None,
                          donate: bool = True) -> Callable:
     """Compile ``step(params, opt_state, batch) -> SpmdStepOutput`` with
     the ZeRO layout pinned by sharding constraints.
@@ -118,17 +119,31 @@ def make_fsdp_train_step(loss_fn: Callable, optimizer: Optimizer,
     layout, so XLA emits reduce-scatter for grads and keeps the AdamW
     update local to each shard.
 
-    Default (``state_specs=None``) is ZeRO-3: params, grads and
-    optimizer state all shard along ``param_specs``. Pass a DIFFERENT
-    spec tree as ``state_specs`` to split the layouts — the ZeRO-1 shape
-    is ``param_specs=replicated_specs(params)`` +
-    ``state_specs=fsdp_param_specs(params, n)``: forward/backward run on
-    replicated params (no per-use all-gather), grads reduce-scatter into
-    the sharded moment update, and the updated shards all-gather back
-    into replicated params — 1/N optimizer memory at ZeRO-3's update
-    cost but DP's forward cost. :func:`make_zero1_train_step` wraps
-    exactly that."""
+    The three spec trees are the whole ZeRO ladder (each ``None``
+    defaults to the previous one):
+
+    ========  ============  ===========  ===========
+    stage     param_specs   grad_specs   state_specs
+    ========  ============  ===========  ===========
+    ZeRO-3    sharded       sharded      sharded
+    ZeRO-2    replicated    sharded      sharded
+    ZeRO-1    replicated    replicated   sharded
+    ========  ============  ===========  ===========
+
+    Default (``state_specs=grad_specs=None``) is ZeRO-3: params, grads
+    and optimizer state all shard along ``param_specs``, so XLA emits a
+    per-use all-gather in the forward/backward, a reduce-scatter for
+    grads, and a local 1/N update. With replicated ``param_specs`` and
+    sharded ``state_specs`` the forward/backward run on whole params
+    (no per-use gather) and the updated shards all-gather back into
+    replicated params once per step; ``grad_specs`` then picks the rung
+    — sharded grads (ZeRO-2) make the grad constraint a reduce-scatter
+    and each device keeps only its 1/N grad shard, replicated grads
+    (ZeRO-1, the torch ZeroRedundancyOptimizer shape) all-reduce and
+    keep whole gradients on every device. :func:`make_zero1_train_step`
+    and :func:`make_zero2_train_step` wrap the two non-default rungs."""
     state_specs = param_specs if state_specs is None else state_specs
+    grad_specs = state_specs if grad_specs is None else grad_specs
 
     def constrain(tree, specs):
         return jax.tree_util.tree_map(
@@ -140,7 +155,7 @@ def make_fsdp_train_step(loss_fn: Callable, optimizer: Optimizer,
         o_specs = opt_state_specs(opt_state, state_specs, params=params)
         (loss, metrics), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, batch)
-        grads = constrain(grads, state_specs)        # reduce-scatter point
+        grads = constrain(grads, grad_specs)   # reduce-scatter/all-reduce
         params, opt_state = optimizer.update(grads, opt_state, params)
         params = constrain(params, param_specs)
         opt_state = constrain(opt_state, o_specs)
@@ -162,6 +177,12 @@ def make_zero1_train_step(loss_fn: Callable, optimizer: Optimizer,
     when params fit per-device but AdamW's 2x-params state does not
     (reference frame: torch ZeroRedundancyOptimizer).
 
+    Gradients stay REPLICATED (the all-reduce shape torch's
+    ZeroRedundancyOptimizer inherits from DDP) — each device holds the
+    whole gradient and updates its state shard from it. If gradient
+    memory is also tight, :func:`make_zero2_train_step` reduce-scatters
+    the grads instead, at identical numerics.
+
     Returns ``(step, state_specs)`` — place the optimizer state with
     ``shard_params(opt_state, opt_state_specs(opt_state, state_specs,
     params), mesh)`` or just let the first constrained step lay it out.
@@ -170,5 +191,36 @@ def make_zero1_train_step(loss_fn: Callable, optimizer: Optimizer,
     s_specs = fsdp_param_specs(params, mesh.shape[axis], axis=axis,
                                min_size=min_size)
     step = make_fsdp_train_step(loss_fn, optimizer, mesh, p_specs,
-                                state_specs=s_specs, donate=donate)
+                                state_specs=s_specs, grad_specs=p_specs,
+                                donate=donate)
+    return step, s_specs
+
+
+def make_zero2_train_step(loss_fn: Callable, optimizer: Optimizer,
+                          mesh: Mesh, params, *, axis: str = "dp",
+                          min_size: int = 1024,
+                          donate: bool = True) -> Tuple[Callable, Any]:
+    """ZeRO-2: replicated params, reduce-scattered grads, sharded
+    optimizer state over ``axis``.
+
+    The middle rung of the ladder: forward/backward still see whole
+    (replicated) params — no per-layer all-gather — but the gradient
+    constraint is the sharded layout, so XLA emits reduce-scatter
+    instead of all-reduce and each device keeps only its 1/N gradient
+    shard alongside its 1/N moments. The updated shards all-gather back
+    into replicated params once per step. Pure layout vs ZeRO-1/DP:
+    identical loss trajectory (pinned by
+    tests/test_fsdp_multihost.py), ~1/N grad + optimizer memory.
+
+    Returns ``(step, state_specs)`` like :func:`make_zero1_train_step`.
+    (Reference frame: the reference's only DP form is replicated AdamW
+    after an all-reduce, min_DDP.py:74 + distributed.py:62-66; the
+    ladder is beyond-reference completeness, SURVEY.md §2.4.)
+    """
+    p_specs = replicated_specs(params)
+    s_specs = fsdp_param_specs(params, mesh.shape[axis], axis=axis,
+                               min_size=min_size)
+    step = make_fsdp_train_step(loss_fn, optimizer, mesh, p_specs,
+                                state_specs=s_specs, grad_specs=s_specs,
+                                donate=donate)
     return step, s_specs

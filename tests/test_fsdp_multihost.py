@@ -100,14 +100,20 @@ class TestFsdpNumerics:
                                        rtol=1e-5)
 
     @pytest.mark.slow
-    def test_zero1_matches_replicated_dp_and_shards_state(self):
-        """ZeRO-1: replicated params + sharded optimizer state is also
-        pure layout — loss trajectory equals replicated DP; after a step
-        the params stay whole per device while the AdamW moments hold
-        1/8 shards."""
+    @pytest.mark.parametrize("stage", ["zero1", "zero2"])
+    def test_zero_stages_match_replicated_dp_and_shard_state(self, stage):
+        """ZeRO-1 (replicated grads) and ZeRO-2 (reduce-scattered grads):
+        replicated params + sharded optimizer state are pure layout —
+        loss trajectory equals replicated DP; after a step the params
+        stay whole per device while the AdamW moments hold 1/8 shards.
+        The two rungs differ only in gradient layout (internal to the
+        compiled step), so both pin against the same oracle."""
         from distributed_pytorch_tpu.parallel import (make_zero1_train_step,
+                                                      make_zero2_train_step,
                                                       replicated_specs)
         from distributed_pytorch_tpu.parallel.fsdp import opt_state_specs
+        make_step = {"zero1": make_zero1_train_step,
+                     "zero2": make_zero2_train_step}[stage]
 
         mesh = _mesh8()
         model = _lm()
@@ -124,16 +130,15 @@ class TestFsdpNumerics:
 
         params = shard_params(model.init(jax.random.PRNGKey(0)),
                               replicated_specs(p0), mesh)
-        step_z1, s_specs = make_zero1_train_step(loss_fn, opt, mesh,
-                                                 params, min_size=1,
-                                                 donate=False)
+        step_z, s_specs = make_step(loss_fn, opt, mesh, params,
+                                    min_size=1, donate=False)
         o_raw = opt.init(params)
         opt_state = shard_params(
             o_raw, opt_state_specs(o_raw, s_specs, params=params), mesh)
 
         for _ in range(3):
             out_r = step_rep(p_rep, o_rep, batch)
-            out_z = step_z1(params, opt_state, batch)
+            out_z = step_z(params, opt_state, batch)
             p_rep, o_rep = out_r.params, out_r.opt_state
             params, opt_state = out_z.params, out_z.opt_state
             np.testing.assert_allclose(float(out_z.loss),
