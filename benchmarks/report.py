@@ -68,25 +68,29 @@ def render(rows) -> str:
         src_stage = ("bench_headline" if "bench_headline" in live
                      else "bench_record")
         mfu = res(src_stage).get("mfu_detail", {})
-    if mfu.get("mfu") is not None:
-        c = mfu.get("config", {})
-        src = f"stage {src_stage}, {live.get(src_stage, {}).get('ts', '?')}"
-        lines += [
-            "| Metric | Value | Source row |",
-            "|---|---|---|",
-            f"| **Flagship MFU** | **{_fmt(mfu['mfu'], 4)}** "
-            f"({_fmt(mfu.get('achieved_tflops_per_sec', 0), 1)} of "
-            f"{_fmt(mfu.get('peak_bf16_tflops', 0), 0)} peak TF/s) | "
-            f"{src} |",
-            f"| Flagship tokens/s | {_fmt(mfu.get('tokens_per_sec', 0))} "
-            f"(step {_fmt(mfu.get('step_ms_median', 0))} ms, "
-            f"batch {c.get('batch')}, seq {c.get('seq')}) | same |",
-        ]
-        med = res("bench_mfu_medium")
+    med = res("bench_mfu_medium")
+    lng = res("mfu_long")
+    # the metric table starts whenever ANY MFU row exists — a round where
+    # the flagship stage wedged but medium/long landed still renders
+    if any(r.get("mfu") is not None for r in (mfu, med, lng)):
+        lines += ["| Metric | Value | Source row |", "|---|---|---|"]
+        if mfu.get("mfu") is not None:
+            c = mfu.get("config", {})
+            src = (f"stage {src_stage}, "
+                   f"{live.get(src_stage, {}).get('ts', '?')}")
+            lines += [
+                f"| **Flagship MFU** | **{_fmt(mfu['mfu'], 4)}** "
+                f"({_fmt(mfu.get('achieved_tflops_per_sec', 0), 1)} of "
+                f"{_fmt(mfu.get('peak_bf16_tflops', 0), 0)} peak TF/s) | "
+                f"{src} |",
+                f"| Flagship tokens/s | "
+                f"{_fmt(mfu.get('tokens_per_sec', 0))} "
+                f"(step {_fmt(mfu.get('step_ms_median', 0))} ms, "
+                f"batch {c.get('batch')}, seq {c.get('seq')}) | same |",
+            ]
         if med.get("mfu") is not None:
             lines.append(f"| medium (~355M) MFU | {_fmt(med['mfu'], 4)} | "
                          f"stage bench_mfu_medium |")
-        lng = res("mfu_long")
         if lng.get("mfu") is not None:
             lines.append(
                 f"| long-context (seq 4096) MFU | {_fmt(lng['mfu'], 4)}"

@@ -137,11 +137,16 @@ def run(dim=FLAGSHIP["dim"], n_layers=FLAGSHIP["n_layers"],
     def fwd_bwd(params, opt_state, toks):
         (loss, _), grads = jax.value_and_grad(ce_loss(model),
                                               has_aux=True)(params, toks)
-        # fold grads into the carried loss so the whole backward is live
-        gsum = sum(jnp.sum(jnp.abs(g).astype(jnp.float32) * 0.0)
+        # fold grads into the carried loss so the whole backward is live.
+        # The scale is derived from runtime DATA (not a literal 0.0), so
+        # no simplifier/fast-math pass can prove the term away and
+        # dead-code-eliminate the backward; numerically it is ~1e-30 *
+        # mean|g| — far below f32 resolution next to the loss.
+        eps = (toks[0, 0].astype(jnp.float32) + 1.0) * 1e-30
+        gsum = sum(jnp.mean(jnp.abs(g).astype(jnp.float32))
                    for g in jax.tree_util.tree_leaves(grads))
         from distributed_pytorch_tpu.parallel.spmd import SpmdStepOutput
-        return SpmdStepOutput(params, opt_state, loss + gsum, {})
+        return SpmdStepOutput(params, opt_state, loss + eps * gsum, {})
 
     rows["no_opt"] = _time_step(fwd_bwd, params, st, tokens, steps)
     rows["fwd"] = _time_fwd(ce_loss(model), params, tokens, steps)

@@ -29,6 +29,7 @@ Numerics are validated against ``dense_attention`` (values and grads) in
 from __future__ import annotations
 
 import functools
+import logging
 import math
 from typing import Optional
 
@@ -555,6 +556,10 @@ def flash_attention(q, k, v, *, causal: bool = False,
 # memory, so make_flash_attn_fn dispatches to it.
 FLASH_MIN_SEQ = 1024
 
+# one-time flag for the dense-dispatch info log (list, so the closure in
+# make_flash_attn_fn can mutate it without a global statement)
+_dense_dispatch_logged = []
+
 
 def make_flash_attn_fn(block_q: Optional[int] = None,
                        block_k: Optional[int] = None,
@@ -576,6 +581,13 @@ def make_flash_attn_fn(block_q: Optional[int] = None,
 
     def attn_fn(q, k, v, *, causal=False, scale=None):
         if min_seq_flash and k.shape[-2] < min_seq_flash:
+            if not _dense_dispatch_logged:
+                _dense_dispatch_logged.append(True)
+                logging.getLogger(__name__).info(
+                    "flash attn_fn: %d keys < min_seq_flash=%d, "
+                    "dispatching to dense einsum (measured v5e "
+                    "crossover; numerics identical — logged once)",
+                    k.shape[-2], min_seq_flash)
             from ..nn.attention import dense_attention
             return dense_attention(q, k, v, causal=causal, scale=scale,
                                    window=window)

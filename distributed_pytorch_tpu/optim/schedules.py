@@ -197,6 +197,14 @@ def with_ema(opt: Optimizer, decay: float = 0.999) -> Optimizer:
     under the raw trajectory don't match the averaged weights
     (torch addresses this with ``update_bn``); expect the reported EMA
     accuracy to understate until stats are re-estimated.
+
+    Wrap order with gradient accumulation: compose as
+    ``accumulate(with_ema(opt), every=k)`` — accumulate then only calls
+    this wrapper on real apply steps. The other order,
+    ``with_ema(accumulate(opt))``, blends on every micro-step including
+    the k-1 skip steps where params come back unchanged, which shrinks
+    the effective averaging horizon by ~k and biases the average toward
+    stale params.
     """
     if not 0.0 <= decay < 1.0:
         raise ValueError(f"decay must be in [0, 1), got {decay} "
