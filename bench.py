@@ -116,12 +116,15 @@ def run_json_subprocess(argv, timeout_s: int, *, label: str,
         # the child wrote any) — keep it: on a flaky backend the progress
         # lines before the wedge are exactly the diagnostics needed
         rec = {"error": f"{label} timed out after {timeout_s}s"}
-        for name in ("stdout", "stderr"):
+        # stdout gets a wider tail than stderr: sweep stages emit one
+        # "# ..." progress line per completed arm to stdout precisely so
+        # a timeout keeps the partial per-arm record
+        for name, cap in (("stdout", 2500), ("stderr", 800)):
             v = getattr(e, name, None)
             if v:
                 if isinstance(v, bytes):
                     v = v.decode(errors="replace")
-                rec[f"{name}_tail"] = v.strip()[-800:]
+                rec[f"{name}_tail"] = v.strip()[-cap:]
         return rec
 
     payload = None

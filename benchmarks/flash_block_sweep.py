@@ -59,8 +59,11 @@ def main(argv):
                   file=sys.stderr, flush=True)
         results.sort(key=lambda r: r["ms"])
         table[s] = results
-        print(f"# s={s} best: {results[0] if results else 'ALL FAILED'}",
-              file=sys.stderr, flush=True)
+        # stdout on purpose: the collector's timeout handler keeps the
+        # stdout tail, so completed seq rows survive a mid-sweep SIGKILL
+        print(f"# s={s} best: "
+              f"{json.dumps(results[0]) if results else 'ALL FAILED'}",
+              flush=True)
     print(json.dumps({"mode": "fwdbwd" if grad_mode else "fwd",
                       "best": {s: r[0] for s, r in table.items() if r},
                       "all": table}))

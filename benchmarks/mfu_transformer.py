@@ -247,7 +247,10 @@ def sweep(arms=None, steps: int = 20) -> dict:
         except Exception as e:  # noqa: BLE001 — OOM arms are expected
             results.append({"arm": arm,
                             "error": f"{type(e).__name__}: {str(e)[:300]}"})
-        print(f"# arm {label}: {results[-1]}", file=sys.stderr, flush=True)
+        # stdout on purpose: the collector's timeout handler keeps the
+        # stdout tail, so completed arms survive a mid-sweep SIGKILL
+        # ("#" lines don't disturb the parse-last-line-as-JSON contract)
+        print(f"# arm {label}: {json.dumps(results[-1])}", flush=True)
     out = dict(best or {"error": "every sweep arm failed"})
     out["sweep"] = results
     return out

@@ -133,6 +133,34 @@ def render(rows) -> str:
                 f"{_fmt(r['dense_fwdbwd_ms'], 2)} | "
                 f"{_fmt(r['fwdbwd_speedup'], 2)}x |")
 
+    sw = res("mfu_sweep")
+    if sw.get("sweep"):
+        lines += ["", "| MFU-sweep arm | MFU | tokens/s | step ms |",
+                  "|---|---|---|---|"]
+        arms = sorted((a for a in sw["sweep"] if a.get("mfu") is not None),
+                      key=lambda a: -(a["mfu"] or 0))
+        for a in arms:
+            lines.append(
+                f"| `{json.dumps(a['arm'], sort_keys=True)}` | "
+                f"{_fmt(a['mfu'], 4)} | {_fmt(a['tokens_per_sec'])} | "
+                f"{_fmt(a['step_ms_median'], 2)} |")
+        failed = [a for a in sw["sweep"] if a.get("error")]
+        if failed:
+            lines.append("")
+            for a in failed:
+                lines.append(f"- arm `{json.dumps(a['arm'], sort_keys=True)}`"
+                             f" failed: {a['error'][:90]}")
+
+    bw = res("flash_bwd_sweep")
+    if bw.get("best"):
+        lines += ["", f"Flash {bw.get('mode', 'fwdbwd')} best block sizes "
+                  "(block-size sweep):",
+                  "", "| seq | block_q | block_k | ms |", "|---|---|---|---|"]
+        for s in sorted(bw["best"], key=int):
+            r = bw["best"][s]
+            lines.append(f"| {s} | {r['bq']} | {r['bk']} | "
+                         f"{_fmt(r['ms'], 3)} |")
+
     for stage in ("step_breakdown", "step_breakdown_b32"):
         sb = res(stage)
         if sb.get("attribution_ms"):

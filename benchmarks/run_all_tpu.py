@@ -7,8 +7,10 @@ in PRIORITY order (a re-wedge mid-collection keeps what landed):
 1. the flagship MFU alone (bench.py --stage mfu) — the round's headline
 2. flash-attention compiled validation + fwd/fwd+bwd speedup table
    (benchmarks/flash_attention_tpu.py, adaptive block defaults)
-3. the long-context (seq 4096) MFU arm, the step-time ablation
-   breakdowns (batch 8 and 32), and the remat arm
+3. the MFU-candidate sweep (the config grid the next flagship comes
+   from), the long-context (seq 4096) MFU arm, the step-time ablation
+   breakdowns (batch 8 and 32), the backward block-size sweep, and the
+   remat arm
 4. the headline bench record (bench.py — embeds flagship MFU, the
    medium-model MFU arm, min_ddp, and the decode MHA/GQA/int8 arms)
 
@@ -193,6 +195,15 @@ def _run(argv):
                {"DPX_BENCH_SELFLOG": "0"})]
     if not quick:
         extra = [
+            # the MFU-candidate grid (batch8+fused-CE+master-f32, batch
+            # 16/32 remat arms, HBM cliff at 64) — the data that picks
+            # the next flagship config (round-4 verdict: push >= 0.45)
+            # 7200s: seven flagship-scale arms (7x compile) — sized to
+            # the file's timeout standard (outer > child worst case);
+            # both sweeps also progress-print per arm to stdout so even
+            # a SIGKILL keeps the completed arms in the stdout tail
+            ("mfu_sweep", [py, path("benchmarks/mfu_transformer.py"),
+                           "--sweep"], 7200, None),
             # long-context arm: flagship model at seq 4096 — the regime
             # the flash kernel's 8.5x win lives in (remat+fused-CE on)
             ("mfu_long", [py, path("benchmarks/mfu_transformer.py"),
@@ -204,6 +215,12 @@ def _run(argv):
             ("step_breakdown_b32",
              [py, path("benchmarks/step_breakdown.py"),
               "--batch", "32"], 2400, None),
+            # backward block-size tuning: the bwd 512 cap is an analytic
+            # VMEM estimate (ops/flash_attention.py) never confirmed on
+            # chip post-adaptive-tiling
+            ("flash_bwd_sweep",
+             [py, path("benchmarks/flash_block_sweep.py"), "--fwdbwd"],
+             7200, None),
             # MFU sweep arm: remat trades activation HBM for FLOPs
             ("mfu_remat", [py, path("benchmarks/mfu_transformer.py"),
                            "--remat"], 1800, None),
