@@ -5,9 +5,11 @@ These are the compiled-program counterparts of the eager helpers in
 device holds its own block and the primitive names the mesh axis to
 communicate over. They lower directly to XLA HLO collectives (all-reduce,
 all-gather, collective-permute, all-to-all, reduce-scatter) riding ICI — the
-NCCL replacement called for by SURVEY.md §2.3 row 1 — and are the building
-blocks for the data/tensor/sequence/pipeline/expert parallel engines in
-:mod:`distributed_pytorch_tpu.parallel`.
+NCCL replacement called for by SURVEY.md §2.3 row 1 — and ARE the transport
+layer of the parallel engines: :mod:`..parallel.data_parallel` averages
+grads through :func:`pmean`, :mod:`..parallel.sequence` rotates k/v blocks
+through :func:`ring_shift`, :mod:`..parallel.pipeline` moves activations
+between stages through :func:`line_shift`.
 """
 
 from __future__ import annotations
@@ -54,9 +56,24 @@ def ppermute(x, axis_name: str, perm):
 
 
 def ring_shift(x, axis_name: str, shift: int = 1):
-    """Rotate each device's block ``shift`` hops around the mesh-axis ring."""
+    """Rotate each device's block ``shift`` hops around the mesh-axis ring
+    — the k/v transport under ring attention (:mod:`..parallel.sequence`)."""
     n = lax.psum(1, axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def line_shift(x, axis_name: str, shift: int = 1):
+    """Shift blocks ``shift`` hops along a mesh axis WITHOUT wraparound;
+    devices with no sender receive zeros (``collective-permute``
+    semantics). The stage-to-stage transport under pipeline parallelism
+    (:mod:`..parallel.pipeline`): activations move +1, gradients -1, and
+    the zero fill feeds the warmup/drain bubbles."""
+    n = lax.psum(1, axis_name)
+    if shift >= 0:
+        perm = [(i, i + shift) for i in range(n - shift)]
+    else:
+        perm = [(i, i + shift) for i in range(-shift, n)]
     return lax.ppermute(x, axis_name, perm=perm)
 
 

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from ..comm import primitives as prim
 from ..optim import Optimizer
 from ..runtime import context
 from ..runtime.context import DATA_AXIS
@@ -73,7 +74,7 @@ def make_train_step(loss_fn: Callable, optimizer: Optimizer,
         (loss, metrics), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, batch)
         if world > 1:
-            grads = jax.lax.pmean(grads, DATA_AXIS)
+            grads = prim.pmean(grads, DATA_AXIS)
         params, opt_state = optimizer.update(grads, opt_state, params)
         return params, opt_state, loss[None], metrics
 
@@ -152,7 +153,7 @@ def make_stateful_train_step(loss_fn: Callable, optimizer: Optimizer,
         (loss, (new_state, metrics)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, state, batch)
         if world > 1:
-            grads = jax.lax.pmean(grads, DATA_AXIS)
+            grads = prim.pmean(grads, DATA_AXIS)
         params, opt_state = optimizer.update(grads, opt_state, params)
         return params, new_state, opt_state, loss[None], metrics
 
@@ -250,7 +251,7 @@ def make_scan_train_steps(loss_fn: Callable, optimizer: Optimizer,
             (loss, _), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, batch)
             if world > 1:
-                grads = jax.lax.pmean(grads, DATA_AXIS)
+                grads = prim.pmean(grads, DATA_AXIS)
             params, opt_state = optimizer.update(grads, opt_state, params)
             return (params, opt_state), loss
         (params, opt_state), losses = jax.lax.scan(

@@ -44,6 +44,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..comm import primitives as prim
+
 
 def pipeline_apply(stage_params, microbatches, stage_fn, *,
                    axis_name: str = "pp"):
@@ -61,8 +63,6 @@ def pipeline_apply(stage_params, microbatches, stage_fn, *,
     n_micro = microbatches.shape[0]
     mb_shape = microbatches.shape[1:]
 
-    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
-
     def tick(carry, t):
         recv, outputs = carry
         mb_idx = jnp.clip(t, 0, n_micro - 1)
@@ -76,7 +76,7 @@ def pipeline_apply(stage_params, microbatches, stage_fn, *,
                                         keepdims=False)
         outputs = lax.dynamic_update_index_in_dim(
             outputs, jnp.where(valid, y, prev), out_idx, axis=0)
-        send = lax.ppermute(y, axis_name, fwd_perm)
+        send = prim.line_shift(y, axis_name, 1)
         return (send, outputs), None
 
     recv0 = jnp.zeros(mb_shape, microbatches.dtype)
@@ -235,8 +235,6 @@ def make_pipeline_train_fn(mesh: Mesh, stage_fn: Callable,
     fwd_np, bwd_np, depth = _build_1f1b_schedule(n_stages, n_microbatches)
     fwd_tab, bwd_tab = jnp.asarray(fwd_np), jnp.asarray(bwd_np)
     n_ticks = fwd_np.shape[0]
-    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
-    bwd_perm = [(i + 1, i) for i in range(n_stages - 1)]
 
     def fn(stacked_params, x, targets):
         b = x.shape[0]
@@ -271,7 +269,7 @@ def make_pipeline_train_fn(mesh: Mesh, stage_fn: Callable,
                 old = lax.dynamic_index_in_dim(ring, slot, 0, False)
                 ring = lax.dynamic_update_index_in_dim(
                     ring, jnp.where(dof, x_in, old), slot, 0)
-                f_recv = lax.ppermute(y, axis_name, fwd_perm)
+                f_recv = prim.line_shift(y, axis_name, 1)
 
                 # ---- backward sub-slot (recompute fwd from the stored
                 # stage input, then pull the cotangent through)
@@ -294,7 +292,7 @@ def make_pipeline_train_fn(mesh: Mesh, stage_fn: Callable,
                     lambda a, g: a + g * keep.astype(a.dtype), gacc, dp)
                 loss_acc = loss_acc + lval * keep * is_last.astype(
                     jnp.float32)
-                b_recv = lax.ppermute(dx, axis_name, bwd_perm)
+                b_recv = prim.line_shift(dx, axis_name, -1)
 
                 return (f_recv, b_recv, ring, gacc, loss_acc), None
 

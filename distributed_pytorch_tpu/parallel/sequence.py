@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..comm import primitives as prim
+
 _NEG = -1e30  # finite mask value: keeps online softmax NaN-free
 
 
@@ -74,7 +76,6 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
     l0 = jnp.zeros((b, h, s_loc), jnp.float32)
 
     # send k/v to the NEXT rank each step => at step t we hold block (my - t)
-    perm = [(i, (i + 1) % n) for i in range(n)]
     tri = jnp.tril(jnp.ones((s_loc, s_loc), bool))
     full = jnp.ones((s_loc, s_loc), bool)
 
@@ -87,8 +88,8 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
         else:
             mask = full
         o, m, l = _block_update(q, kt, vt, o, m, l, scale, mask)
-        kt = lax.ppermute(kt, axis_name, perm)
-        vt = lax.ppermute(vt, axis_name, perm)
+        kt = prim.ring_shift(kt, axis_name)
+        vt = prim.ring_shift(vt, axis_name)
         return o, m, l, kt, vt
 
     o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
@@ -135,7 +136,6 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
     my = lax.axis_index(axis_name)
     b, h, s_loc, dh = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(dh)
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
     o_acc = jnp.zeros((b, h, s_loc, dh), jnp.float32)
     lse_acc = jnp.full((b, h, s_loc), _NEG, jnp.float32)
@@ -158,8 +158,8 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
         o_acc = o_acc * w_acc + o_j * w_j
         lse_acc = lse_new
         if t < n_static - 1:
-            kt = lax.ppermute(kt, axis_name, perm)
-            vt = lax.ppermute(vt, axis_name, perm)
+            kt = prim.ring_shift(kt, axis_name)
+            vt = prim.ring_shift(vt, axis_name)
     return o_acc.astype(q.dtype)
 
 

@@ -116,3 +116,32 @@ def test_in_step_primitives_under_shard_map(group8):
     np.testing.assert_allclose(np.asarray(shifted).ravel(),
                                np.roll(np.arange(8.0), 1))
     np.testing.assert_array_equal(np.asarray(idx).ravel(), np.arange(8))
+
+
+def test_line_shift_under_shard_map(group8):
+    """line_shift: no wraparound, zero fill at the unfed end — the
+    pipeline stage transport (activations +1, gradients -1)."""
+    from jax.sharding import PartitionSpec as P
+    from distributed_pytorch_tpu.comm import primitives as prim
+
+    mesh = dist.get_mesh()
+
+    def body(x):
+        return (prim.line_shift(x, "dp", 1),
+                prim.line_shift(x, "dp", -1),
+                prim.line_shift(x, "dp", 0),
+                prim.line_shift(x, "dp", 8))
+
+    f = jax.shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                      out_specs=(P("dp"),) * 4, check_vma=False)
+    x = jnp.arange(8.0).reshape(8, 1)
+    fwd, bwd, ident, over = jax.jit(f)(x)
+    # +1: rank r receives rank r-1's block; rank 0 gets zeros
+    np.testing.assert_allclose(np.asarray(fwd).ravel(),
+                               [0, 0, 1, 2, 3, 4, 5, 6])
+    # -1: rank r receives rank r+1's block; rank 7 gets zeros
+    np.testing.assert_allclose(np.asarray(bwd).ravel(),
+                               [1, 2, 3, 4, 5, 6, 7, 0])
+    np.testing.assert_allclose(np.asarray(ident).ravel(), np.arange(8.0))
+    # shift >= axis size: nobody sends, everyone zero-filled
+    np.testing.assert_allclose(np.asarray(over).ravel(), np.zeros(8))
