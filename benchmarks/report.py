@@ -137,13 +137,19 @@ def render(rows) -> str:
     if sw.get("sweep"):
         lines += ["", "| MFU-sweep arm | MFU | tokens/s | step ms |",
                   "|---|---|---|---|"]
-        arms = sorted((a for a in sw["sweep"] if a.get("mfu") is not None),
-                      key=lambda a: -(a["mfu"] or 0))
+        # keep arms whose run succeeded even when mfu is None (unknown
+        # device kind): tokens/s and step time are still signal
+        arms = sorted((a for a in sw["sweep"] if not a.get("error")),
+                      key=lambda a: (a.get("mfu") is None,
+                                     -(a.get("mfu") or 0),
+                                     -(a.get("tokens_per_sec") or 0)))
         for a in arms:
+            mfu_cell = (_fmt(a["mfu"], 4) if a.get("mfu") is not None
+                        else "n/a")
             lines.append(
                 f"| `{json.dumps(a['arm'], sort_keys=True)}` | "
-                f"{_fmt(a['mfu'], 4)} | {_fmt(a['tokens_per_sec'])} | "
-                f"{_fmt(a['step_ms_median'], 2)} |")
+                f"{mfu_cell} | {_fmt(a.get('tokens_per_sec', 0))} | "
+                f"{_fmt(a.get('step_ms_median', 0), 2)} |")
         failed = [a for a in sw["sweep"] if a.get("error")]
         if failed:
             lines.append("")
