@@ -97,12 +97,16 @@ class TransformerLM(Module):
         return jnp.matmul(x, self.head_weight(params))
 
     def apply(self, params: Params, tokens, *, rng=None, train: bool = False,
-              pos_offset=0, return_hidden: bool = False, **_):
+              pos_offset=0, positions=None, return_hidden: bool = False,
+              **_):
         """tokens: (B, S) int32 → logits (B, S, vocab).
 
         ``pos_offset`` shifts position ids — under sequence parallelism each
         device holds a local block whose global positions start at
-        ``axis_index(sp) * S_local``.
+        ``axis_index(sp) * S_local``. ``positions`` (S,) int overrides the
+        ids entirely — the contract for PERMUTED token layouts
+        (``parallel.sequence.stripe_tokens``: pass the striped ids so
+        RoPE/learned embeddings see each token's true position).
 
         ``return_hidden=True`` returns the post-final-norm hidden states
         (B, S, dim) *instead of* logits, skipping the vocab projection — the
@@ -111,7 +115,8 @@ class TransformerLM(Module):
         chunkwise so the full (B, S, vocab) logits never materialize."""
         b, s = tokens.shape
         x = self.tok.apply(params["tok"], tokens)
-        positions = pos_offset + jnp.arange(s)
+        if positions is None:
+            positions = pos_offset + jnp.arange(s)
         if self.pos is not None:
             x = x + self.pos.apply(params["pos"], positions)
         for i, blk in enumerate(self.blocks):

@@ -114,12 +114,21 @@ def _frontier_ok(iq, ik, *, block_q, block_k, q_len, k_len, window=None):
 
 
 def _tile_mask(iq, ik, *, block_q, block_k, q_len, k_len, causal,
-               mask_pad_rows, window=None):
+               mask_pad_rows, window=None, causal_offset=0):
     """Boolean (block_q, block_k) mask of logits to suppress: padded key
     columns, the causal future, positions below the sliding window's
     lower edge, and (in backward only, where padded q rows would
     otherwise leak into the dK/dV accumulators) padded query rows.
-    In forward, padded-row outputs are sliced away on the host instead."""
+    In forward, padded-row outputs are sliced away on the host instead.
+
+    ``causal_offset`` shifts the causal frontier down: offset 1 masks the
+    diagonal too (strict lower-triangular). The striped sequence-parallel
+    ring (parallel/sequence.py:striped_ring_flash_attention) alternates
+    between offset 0 and 1 per hop — in striped token layout a rotated
+    k/v block is visible either through the diagonal or strictly below
+    it. The tile FRONTIER (_frontier_ok) deliberately ignores the offset:
+    it over-includes by at most the diagonal elements of diagonal tiles,
+    which this mask then suppresses — fwd and bwd stay in lockstep."""
     rows = iq * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     cols = ik * block_k + jax.lax.broadcasted_iota(
@@ -128,7 +137,8 @@ def _tile_mask(iq, ik, *, block_q, block_k, q_len, k_len, causal,
     if mask_pad_rows:
         masked = jnp.logical_or(masked, rows >= q_len)
     if causal:
-        masked = jnp.logical_or(masked, cols > rows + (k_len - q_len))
+        masked = jnp.logical_or(
+            masked, cols > rows + (k_len - q_len) - causal_offset)
     if window is not None:
         masked = jnp.logical_or(
             masked, cols <= rows + (k_len - q_len) - window)
@@ -142,7 +152,7 @@ def _tile_mask(iq, ik, *, block_q, block_k, q_len, k_len, causal,
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, scale, causal, window, block_q, block_k, n_k, q_len,
-                k_len):
+                k_len, causal_offset=0):
     iq, ik = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -160,7 +170,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         s = jnp.where(
             _tile_mask(iq, ik, block_q=block_q, block_k=block_k,
                        q_len=q_len, k_len=k_len, causal=causal,
-                       mask_pad_rows=False, window=window),
+                       mask_pad_rows=False, window=window,
+                       causal_offset=causal_offset),
             _MASK, s)
 
         m_old = m_scr[:, :1]                               # (bq, 1)
@@ -227,7 +238,7 @@ def _kv_index(bh, h, h_kv, g):
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-               window=None):
+               window=None, causal_offset=0):
     b, h, s_q, d = q.shape
     h_kv, s_k = k.shape[1], k.shape[2]
     g = _kv_head_group(h, h_kv)
@@ -241,7 +252,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
 
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, window=window,
-        block_q=bq, block_k=bk, n_k=n_k, q_len=s_q, k_len=s_k)
+        block_q=bq, block_k=bk, n_k=n_k, q_len=s_q, k_len=s_k,
+        causal_offset=causal_offset)
     o3, lse3 = pl.pallas_call(
         kern,
         grid=(b * h, n_q, n_k),
@@ -281,14 +293,15 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
 
 
 def _recompute_p(q_ref, k_ref, lse_ref, iq, ik, *, scale, causal, window,
-                 block_q, block_k, q_len, k_len):
+                 block_q, block_k, q_len, k_len, causal_offset=0):
     """p = exp(qk*scale - lse) for one tile, masked to exact zeros."""
     s = jax.lax.dot_general(
         q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
     masked = _tile_mask(iq, ik, block_q=block_q, block_k=block_k,
                         q_len=q_len, k_len=k_len, causal=causal,
-                        mask_pad_rows=True, window=window)
+                        mask_pad_rows=True, window=window,
+                        causal_offset=causal_offset)
     p = jnp.exp(jnp.where(masked, _MASK, s) - lse_ref[0][:, :1])
     return jnp.where(masked, 0.0, p)
 
@@ -296,7 +309,7 @@ def _recompute_p(q_ref, k_ref, lse_ref, iq, ik, *, scale, causal, window,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
                     *, scale, causal, window, block_q, block_k, n_q, q_len,
-                    k_len):
+                    k_len, causal_offset=0):
     ik, iq = pl.program_id(1), pl.program_id(2)
 
     @pl.when(iq == 0)
@@ -309,7 +322,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # native path; f32 stats/accumulators) — see _fwd_kernel._body.
         p = _recompute_p(q_ref, k_ref, lse_ref, iq, ik, scale=scale,
                          causal=causal, window=window, block_q=block_q,
-                         block_k=block_k, q_len=q_len, k_len=k_len)
+                         block_k=block_k, q_len=q_len, k_len=k_len,
+                         causal_offset=causal_offset)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                # p^T @ dO
@@ -338,7 +352,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr,
                    *, scale, causal, window, block_q, block_k, n_k, q_len,
-                   k_len):
+                   k_len, causal_offset=0):
     iq, ik = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -348,7 +362,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _body():
         p = _recompute_p(q_ref, k_ref, lse_ref, iq, ik, scale=scale,
                          causal=causal, window=window, block_q=block_q,
-                         block_k=block_k, q_len=q_len, k_len=k_len)
+                         block_k=block_k, q_len=q_len, k_len=k_len,
+                         causal_offset=causal_offset)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0],
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
@@ -371,7 +386,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
-               interpret, g_lse=None, window=None):
+               interpret, g_lse=None, window=None, causal_offset=0):
     b, h, s_q, d = q.shape
     h_kv, s_k = k.shape[1], k.shape[2]
     grp = _kv_head_group(h, h_kv)
@@ -380,7 +395,14 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
     interp = _interpret_default(interpret)
 
     # delta_i = sum_d dO_i * O_i — tiny elementwise+reduce; XLA fuses it.
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # Zero cotangent elements contribute exactly zero even where O is
+    # non-finite: rows with NO visible key (causal s_q > s_k, or the
+    # strict causal_offset=1 mask) emit NaN output by design, and their
+    # callers weight them to zero — 0 * NaN = NaN would otherwise poison
+    # delta and, through ds = p * (dp - delta), the dq/dk/dv of every
+    # OTHER row sharing the tile.
+    gf, of = g.astype(jnp.float32), o.astype(jnp.float32)
+    delta = jnp.sum(jnp.where(gf == 0.0, 0.0, gf * of), axis=-1)
     if g_lse is not None:
         # An lse cotangent folds into the same kernels: per query row,
         # ds_j = p_j (dp_j - delta + g_lse)   [dlse/ds_j = p_j], i.e. the
@@ -412,7 +434,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
     dkv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           window=window, block_q=bq, block_k=bk, n_q=n_q,
-                          q_len=s_q, k_len=s_k),
+                          q_len=s_q, k_len=s_k,
+                          causal_offset=causal_offset),
         grid=(b * h, n_k, n_q),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=[dkv_spec, dkv_spec],
@@ -433,7 +456,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
     dq3 = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           window=window, block_q=bq, block_k=bk, n_k=n_k,
-                          q_len=s_q, k_len=s_k),
+                          q_len=s_q, k_len=s_k,
+                          causal_offset=causal_offset),
         grid=(b * h, n_q, n_k),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
         out_specs=q_spec2,
@@ -461,26 +485,27 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret,
-               window):
+               window, causal_offset):
     return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                      window=window)
+                      window=window, causal_offset=causal_offset)
 
 
 def _flash_lse_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                       window):
+                       window, causal_offset):
     o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                        window=window)
+                        window=window, causal_offset=causal_offset)
     return (o, lse), (q, k, v, o, lse)
 
 
 def _flash_lse_vjp_bwd(causal, scale, block_q, block_k, interpret, window,
-                       res, gs):
+                       causal_offset, res, gs):
     q, k, v, o, lse = res
     g_o, g_lse = gs
     return _flash_bwd(q, k, v, o, lse, g_o, causal, scale, block_q,
-                      block_k, interpret, g_lse=g_lse, window=window)
+                      block_k, interpret, g_lse=g_lse, window=window,
+                      causal_offset=causal_offset)
 
 
 _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
@@ -491,7 +516,8 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = False,
                              block_q: Optional[int] = None,
                              block_k: Optional[int] = None,
                              interpret: Optional[bool] = None,
-                             window: Optional[int] = None):
+                             window: Optional[int] = None,
+                             causal_offset: int = 0):
     """Like :func:`flash_attention` but also returns the per-row
     log-sum-exp ``lse`` (B, H, Sq) — DIFFERENTIABLY (the lse cotangent is
     folded into the backward kernels' delta term). This is the building
@@ -508,13 +534,25 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = False,
                          "attention is a causal-decoder pattern)")
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
+    if causal_offset and not causal:
+        raise ValueError("causal_offset shifts the causal frontier and "
+                         "requires causal=True")
+    if causal_offset and window is not None:
+        raise ValueError("causal_offset cannot combine with window: the "
+                         "window lower edge is anchored to the inclusive "
+                         "diagonal, so the combination would silently "
+                         "shrink the band to window-1 keys")
+    if causal_offset not in (0, 1):
+        raise ValueError(f"causal_offset must be 0 (include diagonal) or "
+                         f"1 (strict), got {causal_offset}")
     *_, dh = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(dh)
     return _flash_lse(q, k, v, causal, float(scale),
                       int(block_q) if block_q is not None else None,
                       int(block_k) if block_k is not None else None,
                       interpret,
-                      int(window) if window is not None else None)
+                      int(window) if window is not None else None,
+                      int(causal_offset))
 
 
 def flash_attention(q, k, v, *, causal: bool = False,

@@ -13,8 +13,11 @@ from .moe import MoELayer, moe_param_specs
 from .pipeline import (make_gspmd_pipeline_fn, make_pipeline_train_fn,
                        pipeline_apply, stack_layer_params)
 from .sequence import (make_ring_attn_fn, make_ring_flash_attn_fn,
-                       ring_attention, ring_flash_attention)
-from .spmd import (make_gspmd_ring_attn_fn, make_spmd_train_step,
+                       ring_attention, ring_flash_attention,
+                       stripe_tokens, striped_ring_flash_attention,
+                       unstripe_tokens)
+from .spmd import (make_gspmd_ring_attn_fn,
+                   make_gspmd_striped_ring_attn_fn, make_spmd_train_step,
                    shard_batch_spec)
 from .tensor import (replicated_specs, shard_params,
                      transformer_lm_param_specs)

@@ -163,6 +163,114 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
     return o_acc.astype(q.dtype)
 
 
+def stripe_tokens(x, n: int, axis: int = 1):
+    """Permute a sequence axis into STRIPED layout for ``n`` shards:
+    contiguous shard r of the result holds the tokens with original
+    positions ``{r, r+n, r+2n, ...}`` in order.
+
+    Contiguous sharding makes causal ring attention pathologically
+    imbalanced — shard r's queries see r+1 of the n k/v blocks, so the
+    last shard does n times the first shard's useful work while every
+    shard pays for n full hops (masked hops compute, then merge with
+    weight zero). In striped layout every rotated k/v block is roughly
+    half-visible to every query block (Striped Attention, Brandon et
+    al. 2023), so each hop runs a HALF (triangular) kernel on every
+    shard: ~2x less attention compute at large n, balanced by
+    construction. Stripe ONCE at the data level (tokens, targets, and
+    position ids — pass the striped positions to the model so RoPE /
+    learned embeddings see true positions); token-wise model math is
+    permutation-equivariant and the per-token LM loss mean is
+    permutation-invariant, so nothing else changes.
+    """
+    s = x.shape[axis]
+    if s % n:
+        raise ValueError(f"sequence length {s} not divisible by {n} shards")
+    c = s // n
+    x = x.reshape(*x.shape[:axis], c, n, *x.shape[axis + 1:])
+    x = jnp.swapaxes(x, axis, axis + 1)
+    return x.reshape(*x.shape[:axis], s, *x.shape[axis + 2:])
+
+
+def unstripe_tokens(x, n: int, axis: int = 1):
+    """Inverse of :func:`stripe_tokens` (restore original token order)."""
+    s = x.shape[axis]
+    if s % n:
+        raise ValueError(f"sequence length {s} not divisible by {n} shards")
+    c = s // n
+    x = x.reshape(*x.shape[:axis], n, c, *x.shape[axis + 1:])
+    x = jnp.swapaxes(x, axis, axis + 1)
+    return x.reshape(*x.shape[:axis], s, *x.shape[axis + 2:])
+
+
+def striped_ring_flash_attention(q, k, v, *, axis_name: str = "sp",
+                                 scale: Optional[float] = None,
+                                 block_q: Optional[int] = None,
+                                 block_k: Optional[int] = None,
+                                 interpret: Optional[bool] = None):
+    """Causal ring flash attention over STRIPED-layout shards — the
+    load-balanced long-context path.
+
+    Same island contract as :func:`ring_flash_attention` (call inside
+    ``shard_map`` with (B, H, S_local, Dh) blocks), but q/k/v must be in
+    the :func:`stripe_tokens` layout: shard r's local index i is global
+    position ``i*n + r``. Then the k/v block held at hop t (origin shard
+    ``src = (my - t) % n``) is visible to local query i at local key j
+    iff ``j*n + src <= i*n + my`` — i.e. ``j <= i`` when ``t <= my`` and
+    ``j <= i - 1`` otherwise: EVERY hop is a triangular flash kernel
+    (inclusive or strict diagonal, ops/flash_attention.py:causal_offset)
+    instead of a full block, halving attention FLOPs per device with
+    static shapes. The t > my hops pick the strict variant via
+    ``lax.cond`` — one compiled kernel per variant, reused across hops.
+
+    Exactness vs dense attention on the unstriped sequence is pinned by
+    tests/test_sequence_parallel.py. Causal only (striping exists to
+    balance the causal frontier; use :func:`ring_flash_attention` for
+    non-causal).
+    """
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, s_loc, dh = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(dh)
+
+    def call(offset, kt, vt):
+        return flash_attention_with_lse(
+            q, kt, vt, causal=True, causal_offset=offset, scale=scale,
+            block_q=block_q, block_k=block_k, interpret=interpret)
+
+    o_acc = jnp.zeros((b, h, s_loc, dh), jnp.float32)
+    lse_acc = jnp.full((b, h, s_loc), _NEG, jnp.float32)
+    kt, vt = k, v
+    n_static = int(n)
+    for t in range(n_static):
+        if t == 0:
+            o_j, lse_j = call(0, kt, vt)  # own block: ordinary causal
+        else:
+            o_j, lse_j = lax.cond(
+                t <= my,
+                functools.partial(call, 0),   # held block starts earlier
+                functools.partial(call, 1),   # starts later: strict
+                kt, vt)
+        o_j = o_j.astype(jnp.float32)
+        # a strict hop's first row (local i=0, global position my) can
+        # have NO visible key in the held block; the kernel emits NaN
+        # output and floor lse for such rows (dense-softmax parity) —
+        # zero them so the weight-zero merge stays NaN-free
+        no_vis = lse_j <= _NEG / 2
+        o_j = jnp.where(no_vis[..., None], 0.0, o_j)
+        lse_j = jnp.where(no_vis, _NEG, lse_j)
+        lse_new = jnp.logaddexp(lse_acc, lse_j)
+        w_acc = jnp.exp(lse_acc - lse_new)[..., None]
+        w_j = jnp.exp(lse_j - lse_new)[..., None]
+        o_acc = o_acc * w_acc + o_j * w_j
+        lse_acc = lse_new
+        if t < n_static - 1:
+            kt = prim.ring_shift(kt, axis_name)
+            vt = prim.ring_shift(vt, axis_name)
+    return o_acc.astype(q.dtype)
+
+
 def make_ring_flash_attn_fn(axis_name: str = "sp",
                             block_q: Optional[int] = None,
                             block_k: Optional[int] = None,

@@ -31,9 +31,9 @@ from jax.sharding import PartitionSpec as P
 import distributed_pytorch_tpu as dist
 from distributed_pytorch_tpu import models, optim
 from distributed_pytorch_tpu.ops.losses import cross_entropy_per_example
-from distributed_pytorch_tpu.parallel import (make_gspmd_ring_attn_fn,
-                                              make_spmd_train_step,
-                                              shard_batch_spec)
+from distributed_pytorch_tpu.parallel import (
+    make_gspmd_ring_attn_fn, make_gspmd_striped_ring_attn_fn,
+    make_spmd_train_step, shard_batch_spec, stripe_tokens)
 from distributed_pytorch_tpu.parallel.tensor import (
     shard_params, transformer_lm_param_specs)
 from distributed_pytorch_tpu.runtime import context
@@ -58,6 +58,13 @@ def parse_args(argv=None):
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--block-q", default=128, type=int)
     p.add_argument("--block-k", default=128, type=int)
+    p.add_argument("--striped", action="store_true",
+                   help="Striped (load-balanced) causal ring: tokens/"
+                        "targets/positions are striped once at the data "
+                        "level and every ring hop runs a triangular "
+                        "kernel — ~2x less attention compute per device "
+                        "at large sp (parallel/sequence.py:"
+                        "stripe_tokens).")
     p.add_argument("--log", default=None, type=str)
     return p.parse_args(argv)
 
@@ -81,9 +88,14 @@ def main(argv=None, quiet=False, history=None):
                            f"/device)")
 
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
-    attn_fn = make_gspmd_ring_attn_fn(mesh, core="flash",
-                                      block_q=args.block_q,
-                                      block_k=args.block_k)
+    if args.striped:
+        attn_fn = make_gspmd_striped_ring_attn_fn(mesh,
+                                                  block_q=args.block_q,
+                                                  block_k=args.block_k)
+    else:
+        attn_fn = make_gspmd_ring_attn_fn(mesh, core="flash",
+                                          block_q=args.block_q,
+                                          block_k=args.block_k)
     model = models.TransformerLM(vocab=256, dim=args.dim,
                                  n_layers=args.n_layers,
                                  n_heads=args.n_heads,
@@ -94,9 +106,18 @@ def main(argv=None, quiet=False, history=None):
     optimizer = optim.adamw(args.lr)
     opt_state = optimizer.init(params)
 
+    # striped mode: permute tokens/targets/position-ids ONCE at the data
+    # level; token-wise math is permutation-equivariant and the per-token
+    # CE mean is permutation-invariant, so the loss trajectory is
+    # identical to the contiguous run (pinned by
+    # tests/test_sequence_parallel.py)
+    positions = (stripe_tokens(jnp.arange(args.seq_len), sp, axis=0)
+                 if args.striped else None)
+
     def loss_fn(p, batch):
         x, y = batch
-        return cross_entropy_per_example(model.apply(p, x), y).mean(), {}
+        logits = model.apply(p, x, positions=positions)
+        return cross_entropy_per_example(logits, y).mean(), {}
 
     step = make_spmd_train_step(loss_fn, optimizer, donate=False)
 
@@ -104,8 +125,11 @@ def main(argv=None, quiet=False, history=None):
     rng = np.random.default_rng(0)
     toks = rng.integers(0, 256,
                         (args.batch_size, args.seq_len + 1)).astype(np.int32)
-    batch = shard_batch_spec((toks[:, :-1], toks[:, 1:]), mesh,
-                             P("dp", "sp"))
+    x_np, y_np = toks[:, :-1], toks[:, 1:]
+    if args.striped:
+        x_np = np.asarray(stripe_tokens(jnp.asarray(x_np), sp, axis=1))
+        y_np = np.asarray(stripe_tokens(jnp.asarray(y_np), sp, axis=1))
+    batch = shard_batch_spec((x_np, y_np), mesh, P("dp", "sp"))
 
     logger = MetricsLogger(args.log)
     tokens_per_step = args.batch_size * args.seq_len
