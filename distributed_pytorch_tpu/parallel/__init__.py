@@ -15,7 +15,7 @@ from .pipeline import (make_gspmd_pipeline_fn, make_pipeline_train_fn,
 from .sequence import (make_ring_attn_fn, make_ring_flash_attn_fn,
                        ring_attention, ring_flash_attention,
                        stripe_tokens, striped_ring_flash_attention,
-                       unstripe_tokens)
+                       ulysses_attention, unstripe_tokens)
 from .spmd import (make_gspmd_ring_attn_fn,
                    make_gspmd_striped_ring_attn_fn, make_spmd_train_step,
                    shard_batch_spec)

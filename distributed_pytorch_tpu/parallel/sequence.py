@@ -106,6 +106,18 @@ def make_ring_attn_fn(axis_name: str = "sp"):
     return attn_fn
 
 
+def _merge_lse(o_acc, lse_acc, o_j, lse_j):
+    """Exact cross-block softmax merge of two (output, lse) partials over
+    disjoint key sets. SINGLE definition for every ring variant — this
+    is the NaN-sensitive numerics block (finite _NEG floor, exp
+    underflow to exact 0 for masked partials) that must never diverge
+    between the contiguous and striped rings."""
+    lse_new = jnp.logaddexp(lse_acc, lse_j)
+    w_acc = jnp.exp(lse_acc - lse_new)[..., None]
+    w_j = jnp.exp(lse_j - lse_new)[..., None]
+    return o_acc * w_acc + o_j * w_j, lse_new
+
+
 def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
                          causal: bool = False,
                          scale: Optional[float] = None,
@@ -152,15 +164,63 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
             # strictly before my, i.e. t <= my on this unrolled step
             visible = (t <= my)
             lse_j = jnp.where(visible, lse_j, _NEG)
-        lse_new = jnp.logaddexp(lse_acc, lse_j)
-        w_acc = jnp.exp(lse_acc - lse_new)[..., None]
-        w_j = jnp.exp(lse_j - lse_new)[..., None]
-        o_acc = o_acc * w_acc + o_j * w_j
-        lse_acc = lse_new
+        o_acc, lse_acc = _merge_lse(o_acc, lse_acc, o_j, lse_j)
         if t < n_static - 1:
             kt = prim.ring_shift(kt, axis_name)
             vt = prim.ring_shift(vt, axis_name)
     return o_acc.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "sp",
+                      causal: bool = False,
+                      scale: Optional[float] = None,
+                      core: str = "flash",
+                      block_q: Optional[int] = None,
+                      block_k: Optional[int] = None,
+                      interpret: Optional[bool] = None):
+    """All-to-all (Ulysses / DeepSpeed-style) sequence parallelism — the
+    second SP mode next to the ring.
+
+    Call inside ``shard_map`` with sequence-sharded (B, H, S/n, Dh)
+    blocks. Two ``all-to-all`` collectives reshard heads<->sequence
+    around an ordinary FULL-sequence attention:
+
+        (B, H, S/n, D) --all2all--> (B, H/n, S, D)   heads sharded
+                        [full causal attention, dense or flash kernel]
+        (B, H/n, S, D) --all2all--> (B, H, S/n, D)   seq sharded again
+
+    Trade-offs vs :func:`ring_flash_attention`: 2 collectives total
+    instead of n neighbor hops (lower latency on small rings / DCN), the
+    causal mask is handled natively by the kernel (no masked hops, no
+    striping needed for balance) — but each device holds FULL-sequence
+    k/v for its H/n heads, so attention memory is O(S), and the head
+    count (q AND kv heads — GQA) must divide the axis size. Pick ring
+    for the longest contexts, Ulysses when heads are plentiful and S/n
+    still fits.
+    """
+    if core not in ("dense", "flash"):
+        raise ValueError(f"unknown ulysses attention core {core!r}")
+    from ..nn.attention import dense_attention
+    from ..ops.flash_attention import flash_attention
+
+    n = int(lax.psum(1, axis_name))
+    h, h_kv = q.shape[1], k.shape[1]
+    if h % n or h_kv % n:
+        raise ValueError(
+            f"ulysses_attention needs q heads ({h}) and kv heads "
+            f"({h_kv}) divisible by the {axis_name} axis size {n} — "
+            "use ring attention otherwise")
+    # heads -> devices, sequence gathered (shards concat in ring order)
+    qh, kh, vh = (prim.all_to_all(t, axis_name, split_axis=1,
+                                  concat_axis=2) for t in (q, k, v))
+    if core == "flash":
+        oh = flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                             block_q=block_q, block_k=block_k,
+                             interpret=interpret)
+    else:
+        oh = dense_attention(qh, kh, vh, causal=causal, scale=scale)
+    # sequence -> devices, heads gathered back
+    return prim.all_to_all(oh, axis_name, split_axis=2, concat_axis=1)
 
 
 def stripe_tokens(x, n: int, axis: int = 1):
@@ -260,11 +320,7 @@ def striped_ring_flash_attention(q, k, v, *, axis_name: str = "sp",
         no_vis = lse_j <= _NEG / 2
         o_j = jnp.where(no_vis[..., None], 0.0, o_j)
         lse_j = jnp.where(no_vis, _NEG, lse_j)
-        lse_new = jnp.logaddexp(lse_acc, lse_j)
-        w_acc = jnp.exp(lse_acc - lse_new)[..., None]
-        w_j = jnp.exp(lse_j - lse_new)[..., None]
-        o_acc = o_acc * w_acc + o_j * w_j
-        lse_acc = lse_new
+        o_acc, lse_acc = _merge_lse(o_acc, lse_acc, o_j, lse_j)
         if t < n_static - 1:
             kt = prim.ring_shift(kt, axis_name)
             vt = prim.ring_shift(vt, axis_name)

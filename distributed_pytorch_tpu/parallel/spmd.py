@@ -29,7 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..optim import Optimizer
 from ..runtime import context
 from .sequence import (ring_attention, ring_flash_attention,
-                       striped_ring_flash_attention)
+                       striped_ring_flash_attention, ulysses_attention)
 
 
 class SpmdStepOutput(NamedTuple):
@@ -54,8 +54,12 @@ def make_gspmd_ring_attn_fn(mesh: Mesh, *, dp: str = "dp", tp: str = "tp",
     (and the model's tokens/targets/position ids) must be in
     :func:`..parallel.sequence.stripe_tokens` layout, and every hop runs
     a triangular kernel — ~2x less attention compute per device at large
-    sp. Striped is causal-only."""
-    if core not in ("dense", "flash", "striped"):
+    sp. Striped is causal-only. ``core='ulysses'`` swaps the ring for
+    the all-to-all mode (:func:`..parallel.sequence.ulysses_attention`):
+    two collectives reshard heads<->sequence around a full-sequence
+    flash kernel — lower collective count, O(S) attention memory, head
+    counts must divide sp."""
+    if core not in ("dense", "flash", "striped", "ulysses"):
         raise ValueError(f"unknown ring attention core {core!r}")
     qkv_spec = P(dp, tp, sp, None)  # (B, H, S, Dh)
 
@@ -67,6 +71,10 @@ def make_gspmd_ring_attn_fn(mesh: Mesh, *, dp: str = "dp", tp: str = "tp",
                 "non-causal attention")
 
         def island(q, k, v):
+            if core == "ulysses":
+                return ulysses_attention(
+                    q, k, v, axis_name=sp, causal=causal, scale=scale,
+                    block_q=block_q, block_k=block_k, interpret=interpret)
             if core == "striped":
                 return striped_ring_flash_attention(
                     q, k, v, axis_name=sp, scale=scale,

@@ -31,9 +31,10 @@ from jax.sharding import PartitionSpec as P
 import distributed_pytorch_tpu as dist
 from distributed_pytorch_tpu import models, optim
 from distributed_pytorch_tpu.ops.losses import cross_entropy_per_example
-from distributed_pytorch_tpu.parallel import (
-    make_gspmd_ring_attn_fn, make_gspmd_striped_ring_attn_fn,
-    make_spmd_train_step, shard_batch_spec, stripe_tokens)
+from distributed_pytorch_tpu.parallel import (make_gspmd_ring_attn_fn,
+                                              make_spmd_train_step,
+                                              shard_batch_spec,
+                                              stripe_tokens)
 from distributed_pytorch_tpu.parallel.tensor import (
     shard_params, transformer_lm_param_specs)
 from distributed_pytorch_tpu.runtime import context
@@ -58,13 +59,19 @@ def parse_args(argv=None):
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--block-q", default=128, type=int)
     p.add_argument("--block-k", default=128, type=int)
-    p.add_argument("--striped", action="store_true",
-                   help="Striped (load-balanced) causal ring: tokens/"
-                        "targets/positions are striped once at the data "
-                        "level and every ring hop runs a triangular "
-                        "kernel — ~2x less attention compute per device "
-                        "at large sp (parallel/sequence.py:"
-                        "stripe_tokens).")
+    p.add_argument("--sp-core", default="flash",
+                   choices=("flash", "striped", "ulysses"),
+                   help="Sequence-parallel attention mode: 'flash' = "
+                        "contiguous ring with the pallas kernel per hop; "
+                        "'striped' = load-balanced causal ring (tokens/"
+                        "targets/positions striped once at the data "
+                        "level, every hop a triangular kernel — ~2x "
+                        "less attention compute at large sp); 'ulysses' "
+                        "= all-to-all heads<->sequence reshard around a "
+                        "full-sequence kernel (2 collectives, O(S) "
+                        "attention memory, heads must divide sp).")
+    p.add_argument("--striped", dest="sp_core", action="store_const",
+                   const="striped", help="alias for --sp-core striped")
     p.add_argument("--log", default=None, type=str)
     return p.parse_args(argv)
 
@@ -88,14 +95,10 @@ def main(argv=None, quiet=False, history=None):
                            f"/device)")
 
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
-    if args.striped:
-        attn_fn = make_gspmd_striped_ring_attn_fn(mesh,
-                                                  block_q=args.block_q,
-                                                  block_k=args.block_k)
-    else:
-        attn_fn = make_gspmd_ring_attn_fn(mesh, core="flash",
-                                          block_q=args.block_q,
-                                          block_k=args.block_k)
+    striped = args.sp_core == "striped"
+    attn_fn = make_gspmd_ring_attn_fn(mesh, core=args.sp_core,
+                                      block_q=args.block_q,
+                                      block_k=args.block_k)
     model = models.TransformerLM(vocab=256, dim=args.dim,
                                  n_layers=args.n_layers,
                                  n_heads=args.n_heads,
@@ -112,7 +115,7 @@ def main(argv=None, quiet=False, history=None):
     # identical to the contiguous run (pinned by
     # tests/test_sequence_parallel.py)
     positions = (stripe_tokens(jnp.arange(args.seq_len), sp, axis=0)
-                 if args.striped else None)
+                 if striped else None)
 
     def loss_fn(p, batch):
         x, y = batch
@@ -126,9 +129,14 @@ def main(argv=None, quiet=False, history=None):
     toks = rng.integers(0, 256,
                         (args.batch_size, args.seq_len + 1)).astype(np.int32)
     x_np, y_np = toks[:, :-1], toks[:, 1:]
-    if args.striped:
-        x_np = np.asarray(stripe_tokens(jnp.asarray(x_np), sp, axis=1))
-        y_np = np.asarray(stripe_tokens(jnp.asarray(y_np), sp, axis=1))
+    if striped:
+        # same permutation as stripe_tokens, in numpy (host data path:
+        # no device round-trip for a pure reshape/transpose)
+        def stripe_np(a):
+            b_, s = a.shape
+            return (a.reshape(b_, s // sp, sp).swapaxes(1, 2)
+                    .reshape(b_, s))
+        x_np, y_np = stripe_np(x_np), stripe_np(y_np)
     batch = shard_batch_spec((x_np, y_np), mesh, P("dp", "sp"))
 
     logger = MetricsLogger(args.log)
