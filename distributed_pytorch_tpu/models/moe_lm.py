@@ -106,14 +106,18 @@ class MoETransformerLM(Module):
         return p
 
     def apply_with_metrics(self, params: Params, tokens, *, pos_offset=0,
-                           **_):
+                           positions=None, **_):
         """(logits, aux_loss, metrics): metrics averages the per-layer
         router diagnostics (``drop_rate``, ``z_loss``, ``aux_loss``,
         ``expert_load``) so capacity_factor/top_k can be tuned from the
-        training loop without bypassing the model API."""
+        training loop without bypassing the model API. ``positions``
+        overrides the position ids — the permuted-layout contract shared
+        with :class:`..models.transformer.TransformerLM` (striped
+        sequence parallelism, ``parallel.sequence.stripe_tokens``)."""
         b, s = tokens.shape
         x = self.tok.apply(params["tok"], tokens)
-        positions = pos_offset + jnp.arange(s)
+        if positions is None:
+            positions = pos_offset + jnp.arange(s)
         if self.pos is not None:
             x = x + self.pos.apply(params["pos"], positions)
         per_layer = []

@@ -241,6 +241,11 @@ def stripe_tokens(x, n: int, axis: int = 1):
     learned embeddings see true positions); token-wise model math is
     permutation-equivariant and the per-token LM loss mean is
     permutation-invariant, so nothing else changes.
+
+    Caveat: an MoE token-choice router that actually DROPS tokens
+    (capacity exceeded) breaks exact parity — drops happen in layout
+    order, so striping changes WHICH tokens drop. With adequate
+    capacity (or the expert-choice router) the loss is identical.
     """
     s = x.shape[axis]
     if s % n:
