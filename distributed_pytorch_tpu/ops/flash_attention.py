@@ -94,17 +94,21 @@ def _pad_seq(x, block, axis):
     return jnp.pad(x, widths)
 
 
-def _frontier_ok(iq, ik, *, block_q, block_k, q_len, k_len, window=None):
+def _frontier_ok(iq, ik, *, block_q, block_k, q_len, k_len, window=None,
+                 diag_offset=0):
     """Whether k-tile ``ik`` intersects the causal-visible region of q-tile
     ``iq``. The ``k_len - q_len`` offset aligns the causal diagonal when
     s_q != s_k (query block i attends through absolute key position
-    i + k_len - q_len). With a sliding ``window`` the band has a LOWER
-    edge too (row r sees cols (r+off-window, r+off]), so tiles entirely
-    below it are skipped — that skip is what makes windowed attention
-    O(S*window) instead of O(S^2/2). Single source of truth for fwd and
-    both bwd kernels — the masks must never desynchronize or gradients
-    silently break."""
-    off = k_len - q_len
+    i + k_len - q_len); ``diag_offset`` shifts that diagonal further —
+    the windowed-ring-hop contract where this kv block sits
+    ``diag_offset`` positions EARLIER in the global sequence than the
+    local indices suggest. With a sliding ``window`` the band has a
+    LOWER edge too (row r sees cols (r+off-window, r+off]), so tiles
+    entirely below it are skipped — that skip is what makes windowed
+    attention O(S*window) instead of O(S^2/2). Single source of truth
+    for fwd and both bwd kernels — the masks must never desynchronize or
+    gradients silently break."""
+    off = k_len - q_len + diag_offset
     ok = ik * block_k <= (iq + 1) * block_q - 1 + off
     if window is not None:
         # tile's last col >= the tile's first row's lowest visible col
@@ -114,7 +118,8 @@ def _frontier_ok(iq, ik, *, block_q, block_k, q_len, k_len, window=None):
 
 
 def _tile_mask(iq, ik, *, block_q, block_k, q_len, k_len, causal,
-               mask_pad_rows, window=None, causal_offset=0):
+               mask_pad_rows, window=None, causal_offset=0,
+               diag_offset=0):
     """Boolean (block_q, block_k) mask of logits to suppress: padded key
     columns, the causal future, positions below the sliding window's
     lower edge, and (in backward only, where padded q rows would
@@ -126,22 +131,28 @@ def _tile_mask(iq, ik, *, block_q, block_k, q_len, k_len, causal,
     ring (parallel/sequence.py:striped_ring_flash_attention) alternates
     between offset 0 and 1 per hop — in striped token layout a rotated
     k/v block is visible either through the diagonal or strictly below
-    it. The tile FRONTIER (_frontier_ok) deliberately ignores the offset:
-    it over-includes by at most the diagonal elements of diagonal tiles,
+    it. ``diag_offset`` shifts the whole diagonal (causal AND window
+    edges) the other way: key column j stands for global position
+    j - diag_offset relative to the queries — the windowed-ring-hop
+    contract (hop t's kv block sits t*S_local positions earlier, so
+    ``diag_offset = t*S_local``). The tile FRONTIER (_frontier_ok)
+    shares diag_offset but deliberately ignores causal_offset: it
+    over-includes by at most the diagonal elements of diagonal tiles,
     which this mask then suppresses — fwd and bwd stay in lockstep."""
     rows = iq * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     cols = ik * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
+    off = k_len - q_len + diag_offset
     masked = cols >= k_len
     if mask_pad_rows:
         masked = jnp.logical_or(masked, rows >= q_len)
     if causal:
         masked = jnp.logical_or(
-            masked, cols > rows + (k_len - q_len) - causal_offset)
+            masked, cols > rows + off - causal_offset)
     if window is not None:
         masked = jnp.logical_or(
-            masked, cols <= rows + (k_len - q_len) - window)
+            masked, cols <= rows + off - window)
     return masked
 
 
@@ -152,7 +163,7 @@ def _tile_mask(iq, ik, *, block_q, block_k, q_len, k_len, causal,
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, scale, causal, window, block_q, block_k, n_k, q_len,
-                k_len, causal_offset=0):
+                k_len, causal_offset=0, diag_offset=0):
     iq, ik = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -171,7 +182,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             _tile_mask(iq, ik, block_q=block_q, block_k=block_k,
                        q_len=q_len, k_len=k_len, causal=causal,
                        mask_pad_rows=False, window=window,
-                       causal_offset=causal_offset),
+                       causal_offset=causal_offset,
+                       diag_offset=diag_offset),
             _MASK, s)
 
         m_old = m_scr[:, :1]                               # (bq, 1)
@@ -192,7 +204,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     if causal:
         @pl.when(_frontier_ok(iq, ik, block_q=block_q, block_k=block_k,
-                              q_len=q_len, k_len=k_len, window=window))
+                              q_len=q_len, k_len=k_len, window=window,
+                              diag_offset=diag_offset))
         def _():
             _body()
     else:
@@ -238,7 +251,7 @@ def _kv_index(bh, h, h_kv, g):
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-               window=None, causal_offset=0):
+               window=None, causal_offset=0, diag_offset=0):
     b, h, s_q, d = q.shape
     h_kv, s_k = k.shape[1], k.shape[2]
     g = _kv_head_group(h, h_kv)
@@ -253,7 +266,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, window=window,
         block_q=bq, block_k=bk, n_k=n_k, q_len=s_q, k_len=s_k,
-        causal_offset=causal_offset)
+        causal_offset=causal_offset, diag_offset=diag_offset)
     o3, lse3 = pl.pallas_call(
         kern,
         grid=(b * h, n_q, n_k),
@@ -293,7 +306,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
 
 
 def _recompute_p(q_ref, k_ref, lse_ref, iq, ik, *, scale, causal, window,
-                 block_q, block_k, q_len, k_len, causal_offset=0):
+                 block_q, block_k, q_len, k_len, causal_offset=0,
+                 diag_offset=0):
     """p = exp(qk*scale - lse) for one tile, masked to exact zeros."""
     s = jax.lax.dot_general(
         q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
@@ -301,7 +315,8 @@ def _recompute_p(q_ref, k_ref, lse_ref, iq, ik, *, scale, causal, window,
     masked = _tile_mask(iq, ik, block_q=block_q, block_k=block_k,
                         q_len=q_len, k_len=k_len, causal=causal,
                         mask_pad_rows=True, window=window,
-                        causal_offset=causal_offset)
+                        causal_offset=causal_offset,
+                        diag_offset=diag_offset)
     p = jnp.exp(jnp.where(masked, _MASK, s) - lse_ref[0][:, :1])
     return jnp.where(masked, 0.0, p)
 
@@ -309,7 +324,7 @@ def _recompute_p(q_ref, k_ref, lse_ref, iq, ik, *, scale, causal, window,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
                     *, scale, causal, window, block_q, block_k, n_q, q_len,
-                    k_len, causal_offset=0):
+                    k_len, causal_offset=0, diag_offset=0):
     ik, iq = pl.program_id(1), pl.program_id(2)
 
     @pl.when(iq == 0)
@@ -323,7 +338,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = _recompute_p(q_ref, k_ref, lse_ref, iq, ik, scale=scale,
                          causal=causal, window=window, block_q=block_q,
                          block_k=block_k, q_len=q_len, k_len=k_len,
-                         causal_offset=causal_offset)
+                         causal_offset=causal_offset,
+                         diag_offset=diag_offset)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                # p^T @ dO
@@ -337,7 +353,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if causal:
         @pl.when(_frontier_ok(iq, ik, block_q=block_q, block_k=block_k,
-                              q_len=q_len, k_len=k_len, window=window))
+                              q_len=q_len, k_len=k_len, window=window,
+                              diag_offset=diag_offset))
         def _():
             _body()
     else:
@@ -352,7 +369,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr,
                    *, scale, causal, window, block_q, block_k, n_k, q_len,
-                   k_len, causal_offset=0):
+                   k_len, causal_offset=0, diag_offset=0):
     iq, ik = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -363,7 +380,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = _recompute_p(q_ref, k_ref, lse_ref, iq, ik, scale=scale,
                          causal=causal, window=window, block_q=block_q,
                          block_k=block_k, q_len=q_len, k_len=k_len,
-                         causal_offset=causal_offset)
+                         causal_offset=causal_offset,
+                         diag_offset=diag_offset)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0],
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
@@ -374,7 +392,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if causal:
         @pl.when(_frontier_ok(iq, ik, block_q=block_q, block_k=block_k,
-                              q_len=q_len, k_len=k_len, window=window))
+                              q_len=q_len, k_len=k_len, window=window,
+                              diag_offset=diag_offset))
         def _():
             _body()
     else:
@@ -386,7 +405,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
-               interpret, g_lse=None, window=None, causal_offset=0):
+               interpret, g_lse=None, window=None, causal_offset=0,
+               diag_offset=0):
     b, h, s_q, d = q.shape
     h_kv, s_k = k.shape[1], k.shape[2]
     grp = _kv_head_group(h, h_kv)
@@ -435,7 +455,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           window=window, block_q=bq, block_k=bk, n_q=n_q,
                           q_len=s_q, k_len=s_k,
-                          causal_offset=causal_offset),
+                          causal_offset=causal_offset,
+                          diag_offset=diag_offset),
         grid=(b * h, n_k, n_q),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=[dkv_spec, dkv_spec],
@@ -457,7 +478,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           window=window, block_q=bq, block_k=bk, n_k=n_k,
                           q_len=s_q, k_len=s_k,
-                          causal_offset=causal_offset),
+                          causal_offset=causal_offset,
+                          diag_offset=diag_offset),
         grid=(b * h, n_q, n_k),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
         out_specs=q_spec2,
@@ -485,27 +507,31 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret,
-               window, causal_offset):
+               window, causal_offset, diag_offset):
     return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                      window=window, causal_offset=causal_offset)
+                      window=window, causal_offset=causal_offset,
+                      diag_offset=diag_offset)
 
 
 def _flash_lse_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                       window, causal_offset):
+                       window, causal_offset, diag_offset):
     o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                        window=window, causal_offset=causal_offset)
+                        window=window, causal_offset=causal_offset,
+                        diag_offset=diag_offset)
     return (o, lse), (q, k, v, o, lse)
 
 
 def _flash_lse_vjp_bwd(causal, scale, block_q, block_k, interpret, window,
-                       causal_offset, res, gs):
+                       causal_offset, diag_offset, res, gs):
     q, k, v, o, lse = res
     g_o, g_lse = gs
     return _flash_bwd(q, k, v, o, lse, g_o, causal, scale, block_q,
                       block_k, interpret, g_lse=g_lse, window=window,
-                      causal_offset=causal_offset)
+                      causal_offset=causal_offset,
+                      diag_offset=diag_offset)
 
 
 _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
@@ -517,7 +543,8 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = False,
                              block_k: Optional[int] = None,
                              interpret: Optional[bool] = None,
                              window: Optional[int] = None,
-                             causal_offset: int = 0):
+                             causal_offset: int = 0,
+                             diag_offset: int = 0):
     """Like :func:`flash_attention` but also returns the per-row
     log-sum-exp ``lse`` (B, H, Sq) — DIFFERENTIABLY (the lse cotangent is
     folded into the backward kernels' delta term). This is the building
@@ -545,6 +572,9 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = False,
     if causal_offset not in (0, 1):
         raise ValueError(f"causal_offset must be 0 (include diagonal) or "
                          f"1 (strict), got {causal_offset}")
+    if diag_offset and not causal:
+        raise ValueError("diag_offset shifts the causal/window diagonal "
+                         "and requires causal=True")
     *_, dh = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(dh)
     return _flash_lse(q, k, v, causal, float(scale),
@@ -552,7 +582,7 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = False,
                       int(block_k) if block_k is not None else None,
                       interpret,
                       int(window) if window is not None else None,
-                      int(causal_offset))
+                      int(causal_offset), int(diag_offset))
 
 
 def flash_attention(q, k, v, *, causal: bool = False,

@@ -123,7 +123,8 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
                          scale: Optional[float] = None,
                          block_q: Optional[int] = None,
                          block_k: Optional[int] = None,
-                         interpret: Optional[bool] = None):
+                         interpret: Optional[bool] = None,
+                         window: Optional[int] = None):
     """Ring attention with the pallas FLASH kernel as the per-block core.
 
     Same contract as :func:`ring_attention` (call inside ``shard_map``
@@ -141,9 +142,21 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
     non-causal kernel and are merged with weight zero when the held block
     is in the causal future (lse forced to the mask value — exp
     underflows to exactly 0), keeping shapes/kernels static per step.
+
+    ``window`` (requires ``causal``) is SLIDING-WINDOW ring attention —
+    the Mistral-style local pattern at ring scale. Hops whose k/v block
+    cannot intersect any query's window are skipped STATICALLY: only
+    ``ceil(window/S_local)+1`` of the n hops run at all, and within each
+    kept hop the kernel's banded frontier (``diag_offset = t*S_local``
+    aligns the band to the rotated block) computes only the band tiles —
+    O(S*window) total attention across the whole ring instead of
+    O(S^2/2), with the ring's O(S/n) per-device memory.
     """
     from ..ops.flash_attention import flash_attention_with_lse
 
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (sliding-window "
+                         "attention is a causal-decoder pattern)")
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     b, h, s_loc, dh = q.shape
@@ -154,18 +167,41 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
     kt, vt = k, v
     # mesh axis sizes are static, so the ring unrolls at trace time
     n_static = int(n)
-    for t in range(n_static):
-        o_j, lse_j = flash_attention_with_lse(
-            q, kt, vt, causal=(causal and t == 0), scale=scale,
-            block_q=block_q, block_k=block_k, interpret=interpret)
+    if window is not None:
+        # hop t's block spans relative offsets [t*c-(c-1), t*c+(c-1)];
+        # it intersects the window band (0 <= g_q - g_k < window) only
+        # while t*c - (c-1) < window — everything past that is a static
+        # skip (no kernel, no ppermute)
+        t_hi = min(n_static, (window + s_loc - 2) // s_loc + 1)
+    else:
+        t_hi = n_static
+    for t in range(t_hi):
+        if window is not None:
+            # banded kernel per hop: diag_offset aligns the causal AND
+            # window edges to the rotated block's true global offset
+            o_j, lse_j = flash_attention_with_lse(
+                q, kt, vt, causal=True, window=window,
+                diag_offset=t * s_loc, scale=scale,
+                block_q=block_q, block_k=block_k, interpret=interpret)
+        else:
+            o_j, lse_j = flash_attention_with_lse(
+                q, kt, vt, causal=(causal and t == 0), scale=scale,
+                block_q=block_q, block_k=block_k, interpret=interpret)
         o_j = o_j.astype(jnp.float32)
         if causal and t > 0:
             # held block has global index (my - t) % n; visible iff it is
             # strictly before my, i.e. t <= my on this unrolled step
             visible = (t <= my)
             lse_j = jnp.where(visible, lse_j, _NEG)
+        if window is not None:
+            # a banded hop can leave rows with NO visible key (NaN
+            # output, floor lse, dense-softmax parity) — zero them so
+            # the weight-zero merge stays NaN-free
+            no_vis = lse_j <= _NEG / 2
+            o_j = jnp.where(no_vis[..., None], 0.0, o_j)
+            lse_j = jnp.where(no_vis, _NEG, lse_j)
         o_acc, lse_acc = _merge_lse(o_acc, lse_acc, o_j, lse_j)
-        if t < n_static - 1:
+        if t < t_hi - 1:
             kt = prim.ring_shift(kt, axis_name)
             vt = prim.ring_shift(vt, axis_name)
     return o_acc.astype(q.dtype)
@@ -177,7 +213,8 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp",
                       core: str = "flash",
                       block_q: Optional[int] = None,
                       block_k: Optional[int] = None,
-                      interpret: Optional[bool] = None):
+                      interpret: Optional[bool] = None,
+                      window: Optional[int] = None):
     """All-to-all (Ulysses / DeepSpeed-style) sequence parallelism — the
     second SP mode next to the ring.
 
@@ -200,6 +237,11 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp",
     """
     if core not in ("dense", "flash"):
         raise ValueError(f"unknown ulysses attention core {core!r}")
+    if window is not None and not causal:
+        # both cores re-check this, but raising before the all_to_all
+        # traces keeps the error surface uniform with the ring variants
+        raise ValueError("window requires causal=True (sliding-window "
+                         "attention is a causal-decoder pattern)")
     from ..nn.attention import dense_attention
     from ..ops.flash_attention import flash_attention
 
@@ -216,9 +258,10 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp",
     if core == "flash":
         oh = flash_attention(qh, kh, vh, causal=causal, scale=scale,
                              block_q=block_q, block_k=block_k,
-                             interpret=interpret)
+                             interpret=interpret, window=window)
     else:
-        oh = dense_attention(qh, kh, vh, causal=causal, scale=scale)
+        oh = dense_attention(qh, kh, vh, causal=causal, scale=scale,
+                             window=window)
     # sequence -> devices, heads gathered back
     return prim.all_to_all(oh, axis_name, split_axis=2, concat_axis=1)
 
@@ -335,13 +378,15 @@ def striped_ring_flash_attention(q, k, v, *, axis_name: str = "sp",
 def make_ring_flash_attn_fn(axis_name: str = "sp",
                             block_q: Optional[int] = None,
                             block_k: Optional[int] = None,
-                            interpret: Optional[bool] = None):
+                            interpret: Optional[bool] = None,
+                            window: Optional[int] = None):
     """``attn_fn`` drop-in running :func:`ring_flash_attention` — the
     long-context fast path: sequence-parallel ring over ICI with the
-    pallas kernel inside each hop."""
+    pallas kernel inside each hop. ``window`` bakes sliding-window
+    (local) attention into the ring — far hops skip statically."""
     def attn_fn(q, k, v, *, causal: bool = False, scale=None):
         return ring_flash_attention(q, k, v, axis_name=axis_name,
                                     causal=causal, scale=scale,
                                     block_q=block_q, block_k=block_k,
-                                    interpret=interpret)
+                                    interpret=interpret, window=window)
     return attn_fn

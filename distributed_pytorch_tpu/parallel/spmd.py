@@ -42,7 +42,7 @@ class SpmdStepOutput(NamedTuple):
 def make_gspmd_ring_attn_fn(mesh: Mesh, *, dp: str = "dp", tp: str = "tp",
                             sp: str = "sp", core: str = "dense",
                             block_q=None, block_k=None,
-                            interpret=None):
+                            interpret=None, window=None):
     """An ``attn_fn`` for use INSIDE a GSPMD-jitted model: a shard_map
     island that runs ring attention over the ``sp`` axis while batch/heads
     stay sharded over ``dp``/``tp``. ``core='flash'`` swaps the per-hop
@@ -58,9 +58,15 @@ def make_gspmd_ring_attn_fn(mesh: Mesh, *, dp: str = "dp", tp: str = "tp",
     the all-to-all mode (:func:`..parallel.sequence.ulysses_attention`):
     two collectives reshard heads<->sequence around a full-sequence
     flash kernel — lower collective count, O(S) attention memory, head
-    counts must divide sp."""
+    counts must divide sp. ``window`` (causal sliding-window attention)
+    is supported by the flash ring (far hops skip statically — O(S*W)
+    across the ring) and by ulysses (the full-sequence kernel's banded
+    frontier); not by the dense ring or the striped layout."""
     if core not in ("dense", "flash", "striped", "ulysses"):
         raise ValueError(f"unknown ring attention core {core!r}")
+    if window is not None and core not in ("flash", "ulysses"):
+        raise ValueError(f"window is supported by core='flash' and "
+                         f"core='ulysses', not {core!r}")
     qkv_spec = P(dp, tp, sp, None)  # (B, H, S, Dh)
 
     def attn_fn(q, k, v, *, causal: bool = False, scale=None):
@@ -74,7 +80,8 @@ def make_gspmd_ring_attn_fn(mesh: Mesh, *, dp: str = "dp", tp: str = "tp",
             if core == "ulysses":
                 return ulysses_attention(
                     q, k, v, axis_name=sp, causal=causal, scale=scale,
-                    block_q=block_q, block_k=block_k, interpret=interpret)
+                    block_q=block_q, block_k=block_k, interpret=interpret,
+                    window=window)
             if core == "striped":
                 return striped_ring_flash_attention(
                     q, k, v, axis_name=sp, scale=scale,
@@ -82,7 +89,8 @@ def make_gspmd_ring_attn_fn(mesh: Mesh, *, dp: str = "dp", tp: str = "tp",
             if core == "flash":
                 return ring_flash_attention(
                     q, k, v, axis_name=sp, causal=causal, scale=scale,
-                    block_q=block_q, block_k=block_k, interpret=interpret)
+                    block_q=block_q, block_k=block_k, interpret=interpret,
+                    window=window)
             return ring_attention(q, k, v, axis_name=sp, causal=causal,
                                   scale=scale)
         return jax.shard_map(island, mesh=mesh,
