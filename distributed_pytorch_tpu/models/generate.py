@@ -62,15 +62,21 @@ def init_cache(model: TransformerLM, batch: int, max_len: int,
 
 
 def prefill(model: TransformerLM, params: Params, tokens,
-            max_len: int) -> Tuple[jnp.ndarray, KVCache]:
+            max_len: int,
+            window: Optional[int] = None) -> Tuple[jnp.ndarray, KVCache]:
     """Run the prompt through the model once, filling the cache.
 
     tokens: (B, S) int32. Returns (last-position logits (B, vocab),
-    cache with ``length = S``)."""
+    cache with ``length = S``). With ``window`` the cache is a ROLLING
+    buffer of ``window`` slots — position p lives at slot ``p % W`` —
+    holding the last W prompt positions; attention inside the prefill
+    already runs the model's own (windowed) attn_fn, so only the cache
+    layout changes."""
     b, s = tokens.shape
     if s > max_len:
         raise ValueError(f"prompt length {s} exceeds max_len {max_len}")
-    cache = init_cache(model, b, max_len)
+    w = window
+    cache = init_cache(model, b, w if w is not None else max_len)
     x = model.tok.apply(params["tok"], tokens)
     positions = jnp.arange(s)
     if getattr(model, "pos", None) is not None:
@@ -85,27 +91,63 @@ def prefill(model: TransformerLM, params: Params, tokens,
         o = blk.attn.attn_fn(hq, hk, hv, causal=True)
         x = x + blk.attn.project_out(p["attn"], o)
         x = x + blk.mlp(p, x)
-        ks.append(jax.lax.dynamic_update_slice(
-            cache.k[i], hk.astype(cache.k[i].dtype), (0, 0, 0, 0)))
-        vs.append(jax.lax.dynamic_update_slice(
-            cache.v[i], hv.astype(cache.v[i].dtype), (0, 0, 0, 0)))
+        hk = hk.astype(cache.k[i].dtype)
+        hv = hv.astype(cache.v[i].dtype)
+        if w is not None:
+            # keep the LAST min(s, w) positions, laid out so position p
+            # sits at slot p % w (roll of the contiguous tail)
+            keep = min(s, w)
+            hk, hv = hk[:, :, -keep:], hv[:, :, -keep:]
+            shift = (s - keep) % w
+            ks.append(jnp.roll(_pad_to(hk, w), shift, axis=2))
+            vs.append(jnp.roll(_pad_to(hv, w), shift, axis=2))
+        else:
+            ks.append(jax.lax.dynamic_update_slice(
+                cache.k[i], hk, (0, 0, 0, 0)))
+            vs.append(jax.lax.dynamic_update_slice(
+                cache.v[i], hv, (0, 0, 0, 0)))
     x = model.ln_f.apply(params["ln_f"], x[:, -1:])
     logits = model.project_vocab(params, x)[:, 0]
     return logits, KVCache(k=ks, v=vs,
                            length=jnp.asarray(s, jnp.int32))
 
 
+def _pad_to(x, w: int):
+    """Zero-pad the cache axis (2) up to ``w`` slots (prompt < window)."""
+    pad = w - x.shape[2]
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+
+
 def decode_step(model: TransformerLM, params: Params, cache: KVCache,
-                token) -> Tuple[jnp.ndarray, KVCache]:
+                token,
+                window: Optional[int] = None) -> Tuple[jnp.ndarray,
+                                                       KVCache]:
     """One cached decode step. token: (B,) int32 at position
-    ``cache.length``. Returns (logits (B, vocab), advanced cache)."""
+    ``cache.length``. Returns (logits (B, vocab), advanced cache).
+
+    With ``window`` the cache is the rolling W-slot buffer from
+    :func:`prefill`: the new position writes slot ``idx % W``
+    (overwriting the token that just fell out of the window) and the
+    mask reconstructs each slot's global position from the slot index —
+    slot j holds ``idx - ((idx - j) mod W)``, valid iff >= 0. Exact
+    sliding-window semantics in O(window) memory, independent of how
+    long generation runs."""
     idx = cache.length
     x = model.tok.apply(params["tok"], token[:, None])         # (B,1,D)
     if getattr(model, "pos", None) is not None:
         x = x + model.pos.apply(params["pos"], idx[None])
     scale = 1.0 / math.sqrt(model.dim // model.n_heads)
     max_len = cache.k[0].shape[2]
-    pos_mask = (jnp.arange(max_len) <= idx)                    # (max,)
+    if window is not None:
+        slots = jnp.arange(max_len)
+        slot_pos = idx - ((idx - slots) % window)
+        pos_mask = slot_pos >= 0                               # (W,)
+        write_at = idx % window
+    else:
+        pos_mask = (jnp.arange(max_len) <= idx)                # (max,)
+        write_at = idx
 
     new_k, new_v = [], []
     for i, blk in enumerate(model.blocks):
@@ -114,9 +156,9 @@ def decode_step(model: TransformerLM, params: Params, cache: KVCache,
                                           blk.ln1.apply(p["ln1"], x))
         hq, hk = blk.attn.maybe_rope(hq, hk, idx[None])
         k = jax.lax.dynamic_update_slice(
-            cache.k[i], hk.astype(cache.k[i].dtype), (0, 0, idx, 0))
+            cache.k[i], hk.astype(cache.k[i].dtype), (0, 0, write_at, 0))
         v = jax.lax.dynamic_update_slice(
-            cache.v[i], hv.astype(cache.v[i].dtype), (0, 0, idx, 0))
+            cache.v[i], hv.astype(cache.v[i].dtype), (0, 0, write_at, 0))
         new_k.append(k)
         new_v.append(v)
         # grouped einsum: hq (B,H,1,Dh) vs cache (B,Hkv,max,Dh) — under
@@ -164,18 +206,37 @@ def generate(model: TransformerLM, params: Params, prompt, max_new: int,
         params, prompt, rng if rng is not None else jax.random.PRNGKey(0))
 
 
+def _model_window(model: TransformerLM) -> Optional[int]:
+    """The model's uniform sliding-window width, or None.
+
+    A model built with ``make_flash_attn_fn(window=W)`` advertises W on
+    every block's attn_fn; a uniform W switches decode to the rolling
+    O(W)-memory cache that reproduces the window exactly. Mixed widths
+    are not a cache layout this path can serve."""
+    widths = {getattr(blk.attn.attn_fn, "window", None)
+              for blk in model.blocks}
+    if widths == {None} or not model.blocks:
+        return None
+    if len(widths) == 1:
+        return next(iter(widths))
+    raise ValueError(f"blocks disagree on attention window ({sorted(map(str, widths))}); "
+                     "cached decode needs a uniform width")
+
+
 def _check_attn_compatible(model: TransformerLM,
                            allow_custom_attn: bool) -> None:
     """Decode attends over the cache with an inline softmax(qk)v — exact
-    for the dense core and dense-equivalent kernels (flash attention
-    marks itself ``dense_equivalent``), wrong for behavior-changing
-    custom cores (sliding-window, biased). Refuse those unless the
-    caller explicitly opts in."""
+    for the dense core, for dense-equivalent kernels (flash attention
+    marks itself ``dense_equivalent``), and for uniform sliding-window
+    kernels (served by the rolling cache). Refuse behavior-changing
+    custom cores (biased, ring islands) unless the caller explicitly
+    opts in."""
     if allow_custom_attn:
         return
     for blk in model.blocks:
         f = blk.attn.attn_fn
-        if f is dense_attention or getattr(f, "dense_equivalent", False):
+        if (f is dense_attention or getattr(f, "dense_equivalent", False)
+                or getattr(f, "window", None) is not None):
             continue
         raise ValueError(
             "model was built with a custom attn_fn whose semantics the "
@@ -202,8 +263,14 @@ def make_generate_fn(model: TransformerLM, max_new: int, *,
     the consuming matmul). Costs nothing when weights are un-quantized
     except disabling that same hoisting — benchmark both
     (benchmarks/decode_tpu.py measures the pinned arm against the plain
-    int8 arm to show which way XLA went)."""
+    int8 arm to show which way XLA went).
+
+    A model built with a uniform sliding window decodes through the
+    ROLLING cache automatically: W slots, position p at slot p % W —
+    exact window semantics in O(window) memory however long generation
+    runs."""
     _check_attn_compatible(model, allow_custom_attn)
+    window = _model_window(model)
 
     def fn(params, prompt, rng):
         s = prompt.shape[1]
@@ -213,12 +280,30 @@ def make_generate_fn(model: TransformerLM, max_new: int, *,
                 f"cache length {limit} (prompt {s} + max_new {max_new} "
                 f"or explicit max_len) exceeds the model's max_seq "
                 f"({model.max_seq})")
-        if s + max_new > limit:
+        if window is None and s + max_new > limit:
             raise ValueError(
                 f"max_len {limit} cannot hold prompt ({s}) + max_new "
                 f"({max_new}) tokens — the cache would wrap and corrupt")
+        if (window is not None and getattr(model, "pos", None) is not None
+                and s + max_new > model.max_seq):
+            # the rolling cache is unbounded but LEARNED position
+            # embeddings are not: past max_seq the table gather would
+            # clip and silently reuse the last row. rope/none have no
+            # such ceiling.
+            raise ValueError(
+                f"prompt ({s}) + max_new ({max_new}) exceeds max_seq "
+                f"({model.max_seq}): learned position embeddings cannot "
+                "extrapolate past their table even under a sliding "
+                "window (use pos='rope' for unbounded generation)")
+        # never allocate more slots than positions can exist: a window
+        # wider than the whole run degenerates to the plain layout size
+        # with identical semantics (nothing is ever evicted). s+max_new
+        # (not max_len) is the bound — an explicit small max_len must
+        # not silently shrink the semantic window.
+        w_eff = None if window is None else min(window, s + max_new)
         rng_first, *step_rngs = jax.random.split(rng, max_new)
-        logits, cache = prefill(model, params, prompt, limit)
+        logits, cache = prefill(model, params, prompt, limit,
+                                window=w_eff)
         first = _sample(logits, rng_first, temperature, top_k)
 
         def body(carry, step_rng):
@@ -226,7 +311,8 @@ def make_generate_fn(model: TransformerLM, max_new: int, *,
             p = params
             if pin_weight_stream:
                 p, _ = jax.lax.optimization_barrier((params, cache.length))
-            logits, cache = decode_step(model, p, cache, token)
+            logits, cache = decode_step(model, p, cache, token,
+                                        window=w_eff)
             nxt = _sample(logits, step_rng, temperature, top_k)
             return (cache, nxt), nxt
 

@@ -665,6 +665,9 @@ def make_flash_attn_fn(block_q: Optional[int] = None,
 
     # full-window flash computes exactly softmax(qk)v, so cached decode
     # (models/generate.py) may substitute its inline core; a sliding
-    # window changes the function and must not be silently swapped
+    # window changes the function and must not be silently swapped —
+    # decode reads .window instead and switches to the rolling
+    # (O(window)-memory) cache that reproduces it exactly
     attn_fn.dense_equivalent = window is None
+    attn_fn.window = window
     return attn_fn

@@ -72,6 +72,11 @@ def parse_args(argv=None):
                         "attention memory, heads must divide sp).")
     p.add_argument("--striped", dest="sp_core", action="store_const",
                    const="striped", help="alias for --sp-core striped")
+    p.add_argument("--window", default=None, type=int,
+                   help="Sliding-window (local) attention width: with "
+                        "--sp-core flash, ring hops beyond the window "
+                        "never trace — O(S*window) attention across the "
+                        "ring. Supported by flash and ulysses cores.")
     p.add_argument("--log", default=None, type=str)
     return p.parse_args(argv)
 
@@ -98,7 +103,8 @@ def main(argv=None, quiet=False, history=None):
     striped = args.sp_core == "striped"
     attn_fn = make_gspmd_ring_attn_fn(mesh, core=args.sp_core,
                                       block_q=args.block_q,
-                                      block_k=args.block_k)
+                                      block_k=args.block_k,
+                                      window=args.window)
     model = models.TransformerLM(vocab=256, dim=args.dim,
                                  n_layers=args.n_layers,
                                  n_heads=args.n_heads,

@@ -319,6 +319,54 @@ class TestGenerate:
             np.asarray(out[:, 0]),
             np.asarray(jnp.argmax(full[:, -1], axis=-1)))
 
+    @pytest.mark.parametrize("prompt_len", [4, 20])
+    def test_windowed_model_rolling_cache_decode(self, prompt_len):
+        """A sliding-window model decodes through the rolling O(window)
+        cache: greedy tokens equal the no-cache reference (full forward
+        through the SAME windowed model each step), for prompts shorter
+        and longer than the window, with generation running far past
+        it."""
+        from distributed_pytorch_tpu.models.generate import prefill
+        from distributed_pytorch_tpu.ops import make_flash_attn_fn
+        W = 8
+        model = models.TransformerLM(
+            vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            pos="rope", max_seq=64,
+            attn_fn=make_flash_attn_fn(window=W, block_q=4, block_k=4,
+                                       min_seq_flash=None))
+        params = model.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        prompt = jnp.asarray(
+            rng.integers(0, 64, (2, prompt_len)).astype(np.int32))
+        max_new = 24
+        gen = jax.jit(make_generate_fn(model, max_new))(
+            params, prompt, jax.random.PRNGKey(1))
+
+        toks = prompt
+        out = []
+        for _ in range(max_new):
+            logits = model.apply(params, toks)[:, -1]
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out.append(nxt)
+            toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(gen),
+                                      np.asarray(jnp.stack(out, 1)))
+
+        # the cache really is O(window): W slots, not prompt+max_new
+        _, cache = prefill(model, params, prompt, 64, window=W)
+        assert cache.k[0].shape[2] == W
+
+    def test_mixed_window_widths_rejected(self):
+        from distributed_pytorch_tpu.ops import make_flash_attn_fn
+        model = models.TransformerLM(
+            vocab=61, dim=32, n_layers=2, n_heads=4, max_seq=64,
+            attn_fn=make_flash_attn_fn(window=8, min_seq_flash=None))
+        # forge a second block with a different width
+        model.blocks[1].attn.attn_fn = make_flash_attn_fn(
+            window=16, min_seq_flash=None)
+        with pytest.raises(ValueError, match="disagree"):
+            make_generate_fn(model, 2)
+
 
 # ---------------------------------------------------------------------------
 # prefetch
