@@ -39,21 +39,29 @@ HBM_BW = {
 
 def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
         prompt_len=128, max_new=256, batch=8, n_kv_heads=None,
-        int8_weights=False, pin_weight_stream=False,
+        int8_weights=False, pin_weight_stream=False, window=None,
         dtype=jnp.bfloat16) -> dict:
     from benchmarks.mfu_transformer import count_params
     from distributed_pytorch_tpu import models
     from distributed_pytorch_tpu.models import make_generate_fn
     from distributed_pytorch_tpu.models.generate import prefill
+    from distributed_pytorch_tpu.ops.flash_attention import \
+        make_flash_attn_fn
     from distributed_pytorch_tpu.ops.quant import (quantize_tree,
                                                    quantized_bytes)
     from distributed_pytorch_tpu.utils.profiler import (fetch_fence,
                                                         time_steps_amortized)
 
     max_seq = prompt_len + max_new
+    # a sliding window switches generate to the rolling O(window) cache
+    # (models/generate.py): each decode step streams min(window, total)
+    # cache slots instead of max_seq — the bandwidth lever this arm
+    # measures
+    attn_fn = make_flash_attn_fn(window=window) if window else None
     model = models.TransformerLM(vocab=vocab, dim=dim, n_layers=n_layers,
                                  n_heads=n_heads, n_kv_heads=n_kv_heads,
-                                 max_seq=max_seq, dtype=dtype)
+                                 max_seq=max_seq, dtype=dtype,
+                                 attn_fn=attn_fn)
     params = model.init(jax.random.PRNGKey(0))
     n_params = count_params(params)
     if int8_weights:
@@ -87,7 +95,10 @@ def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
     # gen() = one prefill (which also yields the FIRST new token's logits)
     # + (max_new - 1) scanned decode steps. Chained by perturbing the
     # prompt with a zero derived from the previous output.
-    pf = jax.jit(lambda p, toks: prefill(model, p, toks, max_seq))
+    cache_len = min(window, max_seq) if window else max_seq
+    pf = jax.jit(lambda p, toks: prefill(model, p, toks, max_seq,
+                                         window=(cache_len if window
+                                                 else None)))
     out0 = pf(params, prompt)
     fetch_fence(jax.tree_util.tree_leaves(out0)[0].ravel()[0])
 
@@ -114,7 +125,7 @@ def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
     # over max_len under a position mask — static shapes); GQA shrinks
     # the cache rows to n_kv_heads * head_dim
     kv_dim = (n_kv_heads or n_heads) * (dim // n_heads)
-    kv_bytes = n_layers * 2 * batch * kv_dim * max_seq * bpe
+    kv_bytes = n_layers * 2 * batch * kv_dim * cache_len * bpe
     bytes_per_step = param_bytes + kv_bytes
     achieved_bw = bytes_per_step * decode_steps / t_decode
 
@@ -128,6 +139,7 @@ def run(dim=768, n_layers=12, n_heads=12, vocab=32000,
                    "max_new": max_new, "batch": batch,
                    "int8_weights": bool(int8_weights),
                    "pin_weight_stream": bool(pin_weight_stream),
+                   "window": window, "cache_len": cache_len,
                    "dtype": str(jnp.dtype(dtype).name)},
         "n_params": n_params,
         "param_bytes": int(param_bytes),
@@ -162,15 +174,23 @@ def run_gqa_compare(small: bool = False) -> dict:
     # faster, the plain arm was streaming bf16.
     gqa_int8_pin = run(n_kv_heads=n_kv, int8_weights=True,
                        pin_weight_stream=True, **kw)
+    # rolling-cache arm: sliding window = 1/3 of the total length, so
+    # the cache the decode step streams shrinks 3x (models/generate.py
+    # rolling buffer) — stacks with GQA's group-factor shrink
+    win = 16 if small else 128
+    gqa_window = run(n_kv_heads=n_kv, window=win, **kw)
     base = mha["decode_tokens_per_sec"]
     return {"mha": mha, "gqa": gqa, "gqa_int8": gqa_int8,
             "gqa_int8_pinned": gqa_int8_pin,
+            "gqa_window": gqa_window,
             "gqa_decode_speedup": round(
                 gqa["decode_tokens_per_sec"] / base, 2),
             "gqa_int8_decode_speedup": round(
                 gqa_int8["decode_tokens_per_sec"] / base, 2),
             "gqa_int8_pinned_decode_speedup": round(
-                gqa_int8_pin["decode_tokens_per_sec"] / base, 2)}
+                gqa_int8_pin["decode_tokens_per_sec"] / base, 2),
+            "gqa_window_decode_speedup": round(
+                gqa_window["decode_tokens_per_sec"] / base, 2)}
 
 
 def main(argv):

@@ -100,7 +100,8 @@ def render(rows) -> str:
 
     dec = res("bench_decode")
     header_done = False
-    for arm in ("mha", "gqa", "gqa_int8", "gqa_int8_pinned"):
+    for arm in ("mha", "gqa", "gqa_int8", "gqa_int8_pinned",
+                "gqa_window"):
         d = dec.get(arm, {})
         if d.get("decode_tokens_per_sec"):
             if not header_done:
@@ -117,6 +118,9 @@ def render(rows) -> str:
         if dec.get("gqa_int8_pinned_decode_speedup") is not None:
             line += (f"; int8 pinned (anti-hoist) "
                      f"{dec['gqa_int8_pinned_decode_speedup']}x")
+        if dec.get("gqa_window_decode_speedup") is not None:
+            line += (f"; sliding-window rolling cache "
+                     f"{dec['gqa_window_decode_speedup']}x")
         lines.append(line + ".")
 
     fa = res("flash_attention")
