@@ -39,17 +39,20 @@ _NEG = -1e30  # finite mask value: keeps online softmax NaN-free
 def _block_update(q, k, v, o, m, l, scale, mask):
     """One blockwise-attention accumulation step (online softmax).
 
-    q: (B,H,Sq,D); k,v: (B,H,Sk,D); o,m,l running accumulators.
-    mask: (Sq, Sk) boolean of *allowed* positions.
+    q: (..., Sq, D); k,v: (..., Sk, D) with broadcastable leading dims
+    (GQA passes q as (B, Hkv, g, Sq, D) against k (B, Hkv, 1, Sk, D) —
+    the shared kv head broadcasts over the group, never materialized);
+    o,m,l running accumulators. mask: (Sq, Sk) of *allowed* positions.
     """
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    logits = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) \
+        * scale
     logits = jnp.where(mask, logits, _NEG)
     m_new = jnp.maximum(m, logits.max(axis=-1))
     p = jnp.exp(logits - m_new[..., None])
     corr = jnp.exp(m - m_new)
     l_new = l * corr + p.sum(axis=-1)
     o_new = o * corr[..., None] + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+        "...qk,...kd->...qd", p, v.astype(jnp.float32))
     return o_new, m_new, l_new
 
 
@@ -57,23 +60,30 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
                    scale: Optional[float] = None):
     """Attention with q/k/v sequence-sharded over ``axis_name``.
 
-    Call inside ``shard_map``: q,k,v are local blocks (B, H, S_local, Dh).
+    Call inside ``shard_map``: q is a local (B, H, S_local, Dh) block;
+    k, v are (B, Hkv, S_local, Dh) with Hkv dividing H (Hkv < H is
+    grouped-query attention — the shared kv head broadcasts over its
+    query group inside the blockwise update, never repeated in memory).
     Returns the local (B, H, S_local, Dh) output block. Exact (not
     approximate): identical to dense attention on the gathered sequence.
     """
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     b, h, s_loc, dh = q.shape
-    if k.shape[1] != h:
-        raise NotImplementedError(
-            "dense ring_attention requires equal q/kv head counts; for "
-            "GQA use ring_flash_attention (its flash core reads grouped "
-            "kv heads natively)")
+    h_kv = k.shape[1]
+    if h % h_kv:
+        raise ValueError(f"n_heads {h} not divisible by kv heads {h_kv}")
+    g = h // h_kv
+    if g > 1:
+        # GQA: group the query heads so the shared kv head broadcasts
+        # over the group inside _block_update (never repeated in memory)
+        q = q.reshape(b, h_kv, g, s_loc, dh)
     scale = scale if scale is not None else 1.0 / math.sqrt(dh)
 
-    o0 = jnp.zeros((b, h, s_loc, dh), jnp.float32)
-    m0 = jnp.full((b, h, s_loc), _NEG, jnp.float32)
-    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    acc_shape = (b, h_kv, g, s_loc) if g > 1 else (b, h, s_loc)
+    o0 = jnp.zeros(acc_shape + (dh,), jnp.float32)
+    m0 = jnp.full(acc_shape, _NEG, jnp.float32)
+    l0 = jnp.zeros(acc_shape, jnp.float32)
 
     # send k/v to the NEXT rank each step => at step t we hold block (my - t)
     tri = jnp.tril(jnp.ones((s_loc, s_loc), bool))
@@ -87,13 +97,16 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
             mask = jnp.where(src == my, tri, jnp.where(src < my, full, ~full))
         else:
             mask = full
-        o, m, l = _block_update(q, kt, vt, o, m, l, scale, mask)
+        kb = kt[:, :, None] if g > 1 else kt
+        vb = vt[:, :, None] if g > 1 else vt
+        o, m, l = _block_update(q, kb, vb, o, m, l, scale, mask)
         kt = prim.ring_shift(kt, axis_name)
         vt = prim.ring_shift(vt, axis_name)
         return o, m, l, kt, vt
 
     o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
-    return (o / l[..., None]).astype(q.dtype)
+    out = (o / l[..., None]).astype(q.dtype)
+    return out.reshape(b, h, s_loc, dh) if g > 1 else out
 
 
 def make_ring_attn_fn(axis_name: str = "sp"):

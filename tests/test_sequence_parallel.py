@@ -28,13 +28,18 @@ def sp_mesh8():
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_ring_attention_matches_dense(sp_mesh8, causal):
-    """Ring attention over 8 sequence shards == dense attention, exactly."""
+@pytest.mark.parametrize("h_kv", [4, 2, 1])
+def test_ring_attention_matches_dense(sp_mesh8, causal, h_kv):
+    """Ring attention over 8 sequence shards == dense attention, exactly
+    — including GQA kv heads (h_kv < h) via the grouped block update.
+    h_kv=2 is the true grouped case that pins the contiguous
+    query-group convention (MQA h_kv=1 cannot — every mapping is
+    equivalent there)."""
     rng = np.random.default_rng(0)
-    b, h, s, d = 2, 3, 32, 8
+    b, h, s, d = 2, 4, 32, 8
     q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
-    k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
-    v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h_kv, s, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h_kv, s, d)), jnp.float32)
 
     want = dense_attention(q, k, v, causal=causal)
 
