@@ -22,6 +22,7 @@ class MoEBlock(Module):
                  mlp_ratio: int = 4, *, causal: bool = True,
                  capacity_factor: float = 2.0, top_k: int = 1,
                  router_z_coef: float = 0.1, router: str = "tokens",
+                 n_shared_experts: int = 0,
                  n_kv_heads: Optional[int] = None, rope: bool = False,
                  attn_fn: Optional[Callable] = None, dtype=jnp.float32):
         self.ln1 = LayerNorm(dim, dtype=dtype)
@@ -32,7 +33,9 @@ class MoEBlock(Module):
         self.router_z_coef = router_z_coef
         self.moe = MoELayer(dim, n_experts, mlp_ratio,
                             capacity_factor=capacity_factor, top_k=top_k,
-                            router=router, dtype=dtype)
+                            router=router,
+                            n_shared_experts=n_shared_experts,
+                            dtype=dtype)
 
     def init(self, key) -> Params:
         ks = jax.random.split(key, 3)
@@ -65,7 +68,7 @@ class MoETransformerLM(Module):
                  n_heads: int = 4, n_experts: int = 4, max_seq: int = 512,
                  mlp_ratio: int = 4, capacity_factor: float = 2.0,
                  top_k: int = 1, router_z_coef: float = 0.1,
-                 router: str = "tokens",
+                 router: str = "tokens", n_shared_experts: int = 0,
                  n_kv_heads: Optional[int] = None, pos: str = "learned",
                  attn_fn: Optional[Callable] = None, dtype=jnp.float32):
         if pos not in ("learned", "rope", "none"):
@@ -74,6 +77,7 @@ class MoETransformerLM(Module):
         self.dim = dim
         self.n_layers = n_layers
         self.n_experts = n_experts
+        self.n_shared_experts = n_shared_experts
         self.pos_kind = pos
         # dimension-aware table init (std 1/sqrt(dim)), matching
         # TransformerLM's tables — an intentional init change from the
@@ -85,6 +89,7 @@ class MoETransformerLM(Module):
             MoEBlock(dim, n_heads, n_experts, mlp_ratio,
                      capacity_factor=capacity_factor, top_k=top_k,
                      router_z_coef=router_z_coef, router=router,
+                     n_shared_experts=n_shared_experts,
                      n_kv_heads=n_kv_heads,
                      rope=(pos == "rope"), attn_fn=attn_fn,
                      dtype=dtype)
@@ -146,7 +151,9 @@ class MoETransformerLM(Module):
                 "attn": {"qkv": {"w": P(None, t), "b": P(t)},
                          "out": {"w": P(t, None), "b": P()}},
                 "ln2": {"scale": P(), "bias": P()},
-                "moe": moe_param_specs(ep_axis=ep_axis),
+                "moe": moe_param_specs(
+                    ep_axis=ep_axis, tp_axis=t,
+                    n_shared_experts=self.n_shared_experts),
             }
 
         specs = {

@@ -51,11 +51,14 @@ class MoELayer(Module):
     def __init__(self, dim: int, n_experts: int, mlp_ratio: int = 4,
                  capacity_factor: float = 1.25, top_k: int = 1,
                  normalize_gates: bool = True, router: str = "tokens",
-                 dtype=jnp.float32):
+                 n_shared_experts: int = 0, dtype=jnp.float32):
         if not 1 <= top_k <= n_experts:
             raise ValueError(f"top_k={top_k} not in [1, {n_experts}]")
         if router not in ("tokens", "experts"):
             raise ValueError(f"router must be tokens|experts, got {router!r}")
+        if n_shared_experts < 0:
+            raise ValueError(
+                f"n_shared_experts must be >= 0, got {n_shared_experts}")
         self.dim = dim
         self.n_experts = n_experts
         self.hidden = mlp_ratio * dim
@@ -63,14 +66,20 @@ class MoELayer(Module):
         self.top_k = top_k
         self.normalize_gates = normalize_gates
         self.router = router
+        # DeepSeekMoE-style shared experts: a dense always-on FFN (width
+        # n_shared * hidden) every token passes through, added to the
+        # routed output — common knowledge lives here, so the routed
+        # experts specialize. Replicated over ep (every group runs it),
+        # tp-shardable like any dense MLP (moe_param_specs).
+        self.n_shared = n_shared_experts
         self.dtype = dtype
 
     def init(self, key) -> Params:
-        kg, k1, k2 = jax.random.split(key, 3)
+        kg, k1, k2, ks1, ks2 = jax.random.split(key, 5)
         bound1 = 1.0 / math.sqrt(self.dim)
         bound2 = 1.0 / math.sqrt(self.hidden)
         e, d, h = self.n_experts, self.dim, self.hidden
-        return {
+        p = {
             "gate": {"w": jax.random.uniform(kg, (d, e), self.dtype,
                                              -bound1, bound1)},
             "fc1": {"w": jax.random.uniform(k1, (e, d, h), self.dtype,
@@ -80,6 +89,26 @@ class MoELayer(Module):
                                             -bound2, bound2),
                     "b": jnp.zeros((e, d), self.dtype)},
         }
+        if self.n_shared:
+            hs = self.n_shared * h
+            bound2s = 1.0 / math.sqrt(hs)
+            p["shared"] = {
+                "fc1": {"w": jax.random.uniform(ks1, (d, hs), self.dtype,
+                                                -bound1, bound1),
+                        "b": jnp.zeros((hs,), self.dtype)},
+                "fc2": {"w": jax.random.uniform(ks2, (hs, d), self.dtype,
+                                                -bound2s, bound2s),
+                        "b": jnp.zeros((d,), self.dtype)},
+            }
+        return p
+
+    def _shared_ffn(self, params, xt):
+        from ..ops.quant import resolve_weight
+        w1 = resolve_weight(params["shared"]["fc1"], "w", self.dtype)
+        w2 = resolve_weight(params["shared"]["fc2"], "w", self.dtype)
+        h = gelu(xt.astype(jnp.float32) @ w1.astype(jnp.float32)
+                 + params["shared"]["fc1"]["b"])
+        return h @ w2.astype(jnp.float32) + params["shared"]["fc2"]["b"]
 
     def apply_with_metrics(self, params: Params, x,
                            **_) -> Tuple[Any, Dict[str, Any]]:
@@ -116,6 +145,8 @@ class MoELayer(Module):
         combine = jnp.einsum("nkec,nk->nec", disp_k, gates)      # (N, E, C)
 
         y = self._expert_ffn(params, dispatch, combine, xt)
+        if self.n_shared:
+            y = y + self._shared_ffn(params, xt)
 
         # Switch aux loss over FIRST-choice assignments (eq. 4)
         frac = onehot[:, 0, :].mean(axis=0)
@@ -171,6 +202,8 @@ class MoELayer(Module):
         dispatch = disp.transpose(2, 0, 1)                      # (N, E, C)
         combine = (disp * top_s[..., None]).transpose(2, 0, 1)  # (N, E, C)
         y = self._expert_ffn(params, dispatch, combine, xt)
+        if self.n_shared:
+            y = y + self._shared_ffn(params, xt)
 
         picks_per_token = dispatch.sum(axis=(1, 2))             # (N,)
         metrics = {
@@ -191,12 +224,19 @@ class MoELayer(Module):
         return y, m["aux_loss"]
 
 
-def moe_param_specs(ep_axis: str = "ep", tp_axis: Optional[str] = None):
+def moe_param_specs(ep_axis: str = "ep", tp_axis: Optional[str] = None,
+                    n_shared_experts: int = 0):
     """PartitionSpecs for MoELayer params: experts sharded over ``ep``
-    (optionally expert-internal hidden over ``tp``)."""
+    (optionally expert-internal hidden over ``tp``). Shared experts —
+    a dense FFN — replicate over ``ep`` and shard their hidden over
+    ``tp`` like any Megatron MLP."""
     t = tp_axis
-    return {
+    specs = {
         "gate": {"w": P()},
         "fc1": {"w": P(ep_axis, None, t), "b": P(ep_axis, t)},
         "fc2": {"w": P(ep_axis, t, None), "b": P(ep_axis, None)},
     }
+    if n_shared_experts:
+        specs["shared"] = {"fc1": {"w": P(None, t), "b": P(t)},
+                           "fc2": {"w": P(t, None), "b": P()}}
+    return specs

@@ -58,6 +58,10 @@ def parse_args(argv=None):
                    help="experts = expert-choice routing: exact load "
                         "balance, no aux loss (training-only scheme)")
     p.add_argument("--capacity-factor", default=2.0, type=float)
+    p.add_argument("--shared-experts", default=0, type=int,
+                   help="DeepSeekMoE-style always-on shared experts "
+                        "(dense FFN of this many expert-widths added to "
+                        "the routed output; replicated over ep).")
     p.add_argument("--aux-coef", default=0.01, type=float,
                    help="weight of the combined router aux in the loss")
     p.add_argument("--dim", default=128, type=int)
@@ -94,7 +98,8 @@ def main(argv=None, quiet=False, history=None):
         vocab=256, dim=args.dim, n_layers=args.n_layers,
         n_heads=args.n_heads, n_experts=n_experts, max_seq=args.seq_len,
         capacity_factor=args.capacity_factor, top_k=args.top_k,
-        router=args.router, pos=args.pos, dtype=dtype)
+        router=args.router, n_shared_experts=args.shared_experts,
+        pos=args.pos, dtype=dtype)
     params = shard_params(model.init(jax.random.PRNGKey(0)),
                           model.param_specs(), mesh)
     optimizer = optim.adamw(args.lr)

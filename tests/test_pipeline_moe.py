@@ -159,8 +159,9 @@ def test_moe_lm_ep_sharded_training():
             losses.append(float(out.loss))
         assert losses[-1] < losses[0]
         fc1 = out.params["blocks"][0]["moe"]["fc1"]["w"]
-        # trailing Nones normalize away in PartitionSpec
-        assert fc1.sharding.spec == P("ep")
+        # experts over ep AND expert-internal hidden over tp (the model
+        # forwards tp_axis into moe_param_specs)
+        assert fc1.sharding.spec == P("ep", None, "tp")
     finally:
         dist.cleanup()
 
@@ -566,3 +567,68 @@ class TestExpertChoice:
         y, m = layer.apply_with_metrics(params, x)
         assert y.shape == x.shape
         assert float(m["drop_rate"]) == 0.0
+
+
+class TestSharedExperts:
+    def test_shared_expert_adds_dense_ffn(self):
+        """With one routed expert (gate prob 1, generous capacity) the
+        layer output is exactly routed_mlp(x) + shared_mlp(x): the
+        shared expert is an always-on dense FFN on top of routing."""
+        from distributed_pytorch_tpu.parallel.moe import MoELayer
+        from distributed_pytorch_tpu.nn.core import gelu
+
+        layer = MoELayer(dim=8, n_experts=1, mlp_ratio=2,
+                         capacity_factor=4.0, n_shared_experts=2)
+        params = layer.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((3, 5, 8)), jnp.float32)
+        y, aux = layer.apply(params, x)
+
+        xt = x.reshape(-1, 8)
+        routed = (gelu(xt @ params["fc1"]["w"][0] + params["fc1"]["b"][0])
+                  @ params["fc2"]["w"][0] + params["fc2"]["b"][0])
+        shared = (gelu(xt @ params["shared"]["fc1"]["w"]
+                       + params["shared"]["fc1"]["b"])
+                  @ params["shared"]["fc2"]["w"]
+                  + params["shared"]["fc2"]["b"])
+        np.testing.assert_allclose(np.asarray(y.reshape(-1, 8)),
+                                   np.asarray(routed + shared),
+                                   rtol=2e-5, atol=2e-5)
+
+        # width scales with n_shared_experts; absent when 0
+        assert params["shared"]["fc1"]["w"].shape == (8, 2 * 2 * 8)
+        p0 = MoELayer(dim=8, n_experts=1,
+                      n_shared_experts=0).init(jax.random.PRNGKey(0))
+        assert "shared" not in p0
+
+    @pytest.mark.parametrize("router", ["tokens", "experts"])
+    def test_shared_experts_ep_sharded_matches_oracle(self, router):
+        """Shared experts compose with ep sharding (replicated dense FFN
+        next to ep-sharded routed experts) at oracle-equal loss, for
+        both routers."""
+        mesh = context.init_mesh(dp=2, tp=2, ep=2)
+        try:
+            model = models.MoETransformerLM(
+                vocab=32, dim=16, n_layers=2, n_heads=2, n_experts=2,
+                max_seq=8, capacity_factor=4.0, router=router,
+                n_shared_experts=1)
+            p_full = model.init(jax.random.PRNGKey(0))
+            params = shard_params(p_full, model.param_specs(), mesh)
+
+            def loss_fn(p, batch):
+                x, y = batch
+                logits, aux = model.apply(p, x)
+                return (cross_entropy_per_example(logits, y).mean()
+                        + 0.01 * aux, {})
+
+            opt = optim.adamw(1e-3)
+            step = make_spmd_train_step(loss_fn, opt, donate=False)
+            rng = np.random.default_rng(0)
+            toks = rng.integers(0, 32, (8, 8)).astype(np.int32)
+            batch = shard_batch_spec((toks, toks), mesh, P("dp", None))
+            out = step(params, opt.init(params), batch)
+            oracle = float(loss_fn(p_full, (toks, toks))[0])
+            np.testing.assert_allclose(float(out.loss), oracle,
+                                       rtol=1e-4, atol=1e-5)
+        finally:
+            dist.cleanup()
