@@ -84,6 +84,58 @@ def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int, tiled: bool
                           concat_axis=concat_axis, tiled=tiled)
 
 
+def quantized_pmean(x, axis_name: str, *, block: int = 1024):
+    """Bandwidth-compressed (int8) mean over a mesh axis — LOSSY.
+
+    The EQuARX recipe (arxiv 2506.17615) mapped onto XLA collectives:
+    each device symmetrically int8-quantizes its 1/n chunk-row of the
+    flattened tensor (one f32 scale per ``block`` elements, so a big
+    bucket of concatenated gradients keeps LOCAL dynamic range — tiny
+    layernorm grads are not scaled by an embedding's max), exchanges
+    quantized chunks with ``all-to-all``, dequantizes and reduces ITS
+    chunk in f32, requantizes the partial, and ``all-gather``s the
+    result — both wire legs move int8 bytes (+4 bytes per block for the
+    scale), ~4x less traffic than an f32 all-reduce (2x vs bf16). Error
+    is bounded by one quantization step per leg:
+    |err| <= blockmax|x|/254 + blockmax|mean|/254 per element.
+
+    Use for DATA-PARALLEL GRADIENTS on bandwidth-bound interconnects
+    (DCN hops, very large meshes) where SGD noise dwarfs the
+    quantization error — ``make_train_step(grad_reduce="int8")``
+    buckets the whole gradient tree through one call. Keep exact
+    :func:`pmean` for losses/metrics and small meshes.
+    """
+    n = int(lax.psum(1, axis_name))
+    if n == 1:
+        return x
+    shape, dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).ravel()
+    size = flat.shape[0]
+    pad = (-size) % (n * block)
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    nb = flat.shape[0] // (n * block)
+
+    def quant(v):                       # (..., nb, block) -> q, scales
+        amax = jnp.max(jnp.abs(v), axis=-1, keepdims=True)
+        scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+        return jnp.round(v / scale).astype(jnp.int8), scale
+
+    q, scale = quant(flat.reshape(n, nb, block))
+    # row i of the result = device i's row <my_index>: every device
+    # ends up holding all n quantized versions of ITS chunk
+    rq = all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+    rs = all_to_all(scale, axis_name, split_axis=0, concat_axis=0)
+    partial = jnp.sum(rq.astype(jnp.float32) * rs, axis=0) / n  # (nb, blk)
+    q2, scale2 = quant(partial)
+    gq = all_gather(q2[None], axis_name, axis=0, tiled=True)
+    gs = all_gather(scale2[None], axis_name, axis=0, tiled=True)
+    out = (gq.astype(jnp.float32) * gs).ravel()
+    if pad:
+        out = out[:size]
+    return out.reshape(shape).astype(dtype)
+
+
 def axis_index(axis_name: str):
     """This device's position along a mesh axis (the in-step 'rank')."""
     return lax.axis_index(axis_name)

@@ -145,3 +145,29 @@ def test_line_shift_under_shard_map(group8):
     np.testing.assert_allclose(np.asarray(ident).ravel(), np.arange(8.0))
     # shift >= axis size: nobody sends, everyone zero-filled
     np.testing.assert_allclose(np.asarray(over).ravel(), np.zeros(8))
+
+
+def test_quantized_pmean_error_bound_and_agreement(group8):
+    """int8-compressed mean: every device gets the SAME result, within
+    one quantization step per wire leg of the exact mean; zeros exact;
+    odd (non-divisible) sizes padded correctly."""
+    from jax.sharding import PartitionSpec as P
+    from distributed_pytorch_tpu.comm import primitives as prim
+
+    mesh = dist.get_mesh()
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((8, 13, 7)).astype(np.float32) * 3.0
+
+    def island(x):
+        return prim.quantized_pmean(x[0], "dp")[None]
+
+    f = jax.shard_map(island, mesh=mesh, in_specs=(P("dp"),),
+                      out_specs=P("dp"), check_vma=False)
+    out = np.asarray(jax.jit(f)(jnp.asarray(xs)))
+    exact = xs.mean(0)
+    for i in range(1, 8):
+        np.testing.assert_array_equal(out[i], out[0])
+    err = np.abs(out[0] - exact).max()
+    bound = np.abs(xs).max() / 254 + np.abs(exact).max() / 254
+    assert err <= bound * 1.05, (err, bound)
+    assert np.asarray(jax.jit(f)(jnp.zeros((8, 4, 4)))).max() == 0.0
