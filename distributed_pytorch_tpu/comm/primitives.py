@@ -84,7 +84,13 @@ def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int, tiled: bool
                           concat_axis=concat_axis, tiled=tiled)
 
 
-def quantized_pmean(x, axis_name: str, *, block: int = 1024):
+# default quantization-block width for quantized_pmean — exported so
+# bucketing callers (parallel/data_parallel._reduce_grads) can pad each
+# leaf to a block multiple and keep scale blocks from spanning leaves
+QUANT_BLOCK = 1024
+
+
+def quantized_pmean(x, axis_name: str, *, block: int = QUANT_BLOCK):
     """Bandwidth-compressed (int8) mean over a mesh axis — LOSSY.
 
     The EQuARX recipe (arxiv 2506.17615) mapped onto XLA collectives:

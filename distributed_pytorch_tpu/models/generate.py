@@ -18,8 +18,9 @@ Design notes (TPU-first):
   matmul pair — bandwidth-bound as always for single-token decoding (GQA
   cuts exactly that cache bandwidth); the cache layout keeps the
   contraction on the MXU's fast axis.
-- Sampling (greedy / temperature / top-k) happens on-device inside the
-  scan; the host sees only the final (B, steps) token block.
+- Sampling (greedy / temperature / top-k / nucleus top-p) happens
+  on-device inside the scan; the host sees only the final (B, steps)
+  token block.
 
 Works on the same ``TransformerLM`` params used for training (reads the
 block submodules directly; no weight conversion).
@@ -181,7 +182,8 @@ def decode_step(model: TransformerLM, params: Params, cache: KVCache,
     return logits, KVCache(k=new_k, v=new_v, length=idx + 1)
 
 
-def _sample(logits, rng, temperature: float, top_k: Optional[int]):
+def _sample(logits, rng, temperature: float, top_k: Optional[int],
+            top_p: Optional[float] = None):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
@@ -189,11 +191,26 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int]):
         vals, _ = jax.lax.top_k(logits, top_k)
         cutoff = vals[..., -1:]
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    if top_p is not None:
+        # nucleus sampling: keep the smallest prefix of the
+        # probability-sorted vocab whose mass reaches top_p (the token
+        # that CROSSES the threshold stays — cum - p < top_p — so at
+        # least one survives even for tiny top_p)
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = (cum - probs) < top_p
+        # clamp: top_p == 0.0 would keep zero tokens and the -1 index
+        # would WRAP to the smallest logit, silently disabling filtering
+        kept = jnp.maximum(jnp.sum(keep_sorted, axis=-1, keepdims=True), 1)
+        cutoff = jnp.take_along_axis(sorted_logits, kept - 1, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
 def generate(model: TransformerLM, params: Params, prompt, max_new: int,
              *, temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None,
              rng=None, max_len: Optional[int] = None) -> jnp.ndarray:
     """Generate ``max_new`` tokens after ``prompt`` ((B, S) int32).
 
@@ -202,7 +219,7 @@ def generate(model: TransformerLM, params: Params, prompt, max_new: int,
     ``lax.scan`` — jit :func:`make_generate_fn`'s product to cache the
     whole pipeline as two XLA programs."""
     return make_generate_fn(model, max_new, temperature=temperature,
-                            top_k=top_k, max_len=max_len)(
+                            top_k=top_k, top_p=top_p, max_len=max_len)(
         params, prompt, rng if rng is not None else jax.random.PRNGKey(0))
 
 
@@ -247,6 +264,7 @@ def _check_attn_compatible(model: TransformerLM,
 
 def make_generate_fn(model: TransformerLM, max_new: int, *,
                      temperature: float = 0.0, top_k: Optional[int] = None,
+                     top_p: Optional[float] = None,
                      max_len: Optional[int] = None,
                      allow_custom_attn: bool = False,
                      pin_weight_stream: bool = False):
@@ -304,7 +322,7 @@ def make_generate_fn(model: TransformerLM, max_new: int, *,
         rng_first, *step_rngs = jax.random.split(rng, max_new)
         logits, cache = prefill(model, params, prompt, limit,
                                 window=w_eff)
-        first = _sample(logits, rng_first, temperature, top_k)
+        first = _sample(logits, rng_first, temperature, top_k, top_p)
 
         def body(carry, step_rng):
             cache, token = carry
@@ -313,7 +331,7 @@ def make_generate_fn(model: TransformerLM, max_new: int, *,
                 p, _ = jax.lax.optimization_barrier((params, cache.length))
             logits, cache = decode_step(model, p, cache, token,
                                         window=w_eff)
-            nxt = _sample(logits, step_rng, temperature, top_k)
+            nxt = _sample(logits, step_rng, temperature, top_k, top_p)
             return (cache, nxt), nxt
 
         if max_new == 1:

@@ -90,19 +90,27 @@ def make_train_step(loss_fn: Callable, optimizer: Optimizer,
         if grad_reduce == "mean":
             return prim.pmean(grads, DATA_AXIS)
         # ONE compressed collective pair for the whole tree: flatten
-        # every leaf into a single f32 bucket (per-block scales inside
-        # quantized_pmean keep small leaves' dynamic range), reduce,
-        # unflatten — dozens of per-leaf all-to-alls would pay
-        # per-collective latency on exactly the meshes this targets
+        # every leaf into a single f32 bucket, reduce, unflatten —
+        # dozens of per-leaf all-to-alls would pay per-collective
+        # latency on exactly the meshes this targets. Each leaf is
+        # zero-padded to a QUANT_BLOCK multiple so no quantization-scale
+        # block ever spans two leaves — a tiny layernorm grad sharing a
+        # block with an embedding grad's tail would quantize to zero
+        # under the big leaf's scale. (The per-leaf padding is also why
+        # this is hand-rolled rather than jax.flatten_util.ravel_pytree.)
+        bs = prim.QUANT_BLOCK
         leaves, treedef = jax.tree_util.tree_flatten(grads)
-        flat = jnp.concatenate(
-            [jnp.ravel(g).astype(jnp.float32) for g in leaves])
-        red = prim.quantized_pmean(flat, DATA_AXIS)
+        padded = []
+        for g in leaves:
+            f = jnp.ravel(g).astype(jnp.float32)
+            pad = (-f.shape[0]) % bs
+            padded.append(jnp.pad(f, (0, pad)) if pad else f)
+        red = prim.quantized_pmean(jnp.concatenate(padded), DATA_AXIS)
         out, off = [], 0
         for g in leaves:
             out.append(red[off:off + g.size].reshape(g.shape)
                        .astype(g.dtype))
-            off += g.size
+            off += g.size + ((-g.size) % bs)
         return jax.tree_util.tree_unflatten(treedef, out)
 
     def local_step(params, opt_state, batch):

@@ -545,3 +545,38 @@ class TestAdafactor:
         p2, _ = opt.update(g, st, params)
         assert p2["w"].dtype == jnp.bfloat16
         assert st.vr[0].dtype == jnp.float32
+
+
+class TestTopP:
+    def test_nucleus_restricts_to_smallest_prefix(self):
+        """top-p keeps exactly the smallest probability-sorted prefix
+        reaching the mass threshold: samples never leave the nucleus,
+        and the crossing token itself stays (at least one survives)."""
+        from distributed_pytorch_tpu.models.generate import _sample
+
+        # probs ~ [0.6, 0.3, 0.08, 0.02] after softmax
+        logits = jnp.log(jnp.asarray([[0.6, 0.3, 0.08, 0.02]]))
+        # top_p=0.5: nucleus = {0} (0.6 crosses the threshold)
+        for i in range(50):
+            s = _sample(logits, jax.random.PRNGKey(i), 1.0, None, 0.5)
+            assert int(s[0]) == 0
+        # top_p=0.7: nucleus = {0, 1}
+        seen = {int(_sample(logits, jax.random.PRNGKey(i), 1.0,
+                            None, 0.7)[0]) for i in range(200)}
+        assert seen == {0, 1}
+        # top_p=1.0 keeps everything reachable
+        seen = {int(_sample(logits, jax.random.PRNGKey(i), 1.0,
+                            None, 1.0)[0]) for i in range(400)}
+        assert seen == {0, 1, 2, 3}
+        # tiny top_p still yields the argmax, never an empty nucleus
+        s = _sample(logits, jax.random.PRNGKey(0), 1.0, None, 1e-6)
+        assert int(s[0]) == 0
+
+    def test_top_p_generate_runs(self):
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        prompt = jnp.zeros((2, 3), jnp.int32)
+        out = jax.jit(make_generate_fn(model, 4, temperature=0.8,
+                                       top_p=0.9))(
+            params, prompt, jax.random.PRNGKey(1))
+        assert out.shape == (2, 4)
