@@ -3,4 +3,6 @@ from . import losses
 from .flash_attention import (flash_attention, flash_attention_with_lse,
                               make_flash_attn_fn)
 from .losses import (cross_entropy, cross_entropy_per_example,
-                     fused_linear_cross_entropy)
+                     fused_linear_cross_entropy,
+                     make_vocab_parallel_ce_fn,
+                     vocab_parallel_cross_entropy)

@@ -74,3 +74,72 @@ def fused_linear_cross_entropy(hidden: jnp.ndarray, w: jnp.ndarray,
         (h.reshape(n_chunks, c, d), y.reshape(n_chunks, c),
          valid.reshape(n_chunks, c)))
     return total / n
+
+
+def vocab_parallel_cross_entropy(logits_local: jnp.ndarray, labels,
+                                 *, axis_name: str = "tp") -> jnp.ndarray:
+    """Per-example CE from VOCAB-SHARDED logits — call inside
+    ``shard_map`` with each device holding its contiguous
+    ``(..., V/n)`` vocab slice (shard r owns ids ``[r*V/n, (r+1)*V/n)``,
+    the layout ``P(..., tp)`` produces). ``labels`` are GLOBAL ids.
+
+    The Megatron-LM vocab-parallel loss: the full (..., V) logits are
+    never gathered — two scalar-per-row collectives (a pmax for the
+    stabilizer, ONE fused psum of local sum-exp, masked target logit,
+    and label-ownership count) replace the O(V) all-gather XLA would
+    otherwise insert between a tp-sharded head and an unsharded loss.
+    The max is detached (mathematically the logsumexp shift cancels in
+    the gradient), so gradients flow only through differentiable psums
+    — exactness vs the gathered loss is pinned by tests/test_models.py.
+    A label no shard owns (out-of-range ids such as -100 padding)
+    yields NaN, matching the gathered path — silent finite garbage
+    would corrupt training instead of surfacing the masking bug."""
+    from ..comm import primitives as prim
+
+    v_loc = logits_local.shape[-1]
+    my = prim.axis_index(axis_name)
+    offset = my * v_loc
+    lf = logits_local.astype(jnp.float32)
+    # stop_gradient BEFORE the pmax: the stabilizer shift cancels in the
+    # gradient mathematically, and pmax has no differentiation rule —
+    # a zero-tangent operand keeps it out of the linearized graph
+    gmax = prim.pmax(
+        jax.lax.stop_gradient(jnp.max(lf, axis=-1)), axis_name)
+    loc = labels.astype(jnp.int32) - offset
+    in_shard = (loc >= 0) & (loc < v_loc)
+    loc_c = jnp.clip(loc, 0, v_loc - 1)
+    tgt_local = jnp.take_along_axis(lf, loc_c[..., None], axis=-1)[..., 0]
+    # one all-reduce for all three per-row scalars (psum takes a pytree)
+    denom, tgt, owned = prim.psum(
+        (jnp.sum(jnp.exp(lf - gmax[..., None]), axis=-1),
+         jnp.where(in_shard, tgt_local, 0.0),
+         in_shard.astype(jnp.float32)), axis_name)
+    loss = jnp.log(denom) + gmax - tgt
+    return jnp.where(owned > 0, loss, jnp.float32(jnp.nan))
+
+
+def make_vocab_parallel_ce_fn(mesh, *, dp: str = "dp", tp: str = "tp"):
+    """``fn(hidden, head_w, labels) -> per-example CE`` fusing the vocab
+    projection INTO the tp island: hidden (B, S, D) replicated over tp,
+    ``head_w`` (D, V) sharded ``P(None, tp)`` (the TransformerLM head
+    layout), labels (B, S) global ids. Each device computes only its
+    (B, S, V/n) logits slice and the loss reduces with scalar-per-token
+    collectives — the (B, S, V) logits never exist on any device, in
+    forward or backward. The GSPMD alternative (plain
+    ``cross_entropy_per_example`` on a sharded head) all-gathers the
+    full logits; at B8 x S1024 x V32k that is 1 GiB per step."""
+    from jax.sharding import PartitionSpec as P
+
+    def island(hidden, w_local, labels):
+        logits_local = jnp.matmul(hidden, w_local,
+                                  preferred_element_type=jnp.float32)
+        return vocab_parallel_cross_entropy(logits_local, labels,
+                                            axis_name=tp)
+
+    def fn(hidden, head_w, labels):
+        return jax.shard_map(
+            island, mesh=mesh,
+            in_specs=(P(dp, None, None), P(None, tp), P(dp, None)),
+            out_specs=P(dp, None), check_vma=False)(hidden, head_w,
+                                                    labels)
+    return fn
