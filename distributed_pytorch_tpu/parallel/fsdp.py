@@ -78,7 +78,9 @@ def opt_state_specs(opt_state, param_specs, params=None):
     types. Pass ``params`` when available: structure alone cannot tell a
     scalar step counter from a single-bare-leaf params tree, so the
     param-shaped test then also requires matching leaf shapes."""
+    from ..optim import Q8LogMoment, Q8Moment
     p_struct = jax.tree_util.tree_structure(param_specs)
+    is_q8 = lambda x: isinstance(x, (Q8Moment, Q8LogMoment))
 
     def param_shaped(state):
         if jax.tree_util.tree_structure(state) != p_struct:
@@ -89,8 +91,32 @@ def opt_state_specs(opt_state, param_specs, params=None):
                    for a, b in zip(jax.tree_util.tree_leaves(state),
                                    jax.tree_util.tree_leaves(params)))
 
+    def q8_param_shaped(state):
+        # quantized moment trees (optim.adamw_8bit): param structure
+        # with Q8(Log)Moment nodes whose int8 codes are param-shaped —
+        # codes shard like the moment they encode, per-block scales
+        # replicate (O(size/256), not worth a collective)
+        if jax.tree_util.tree_structure(state, is_leaf=is_q8) != p_struct:
+            return False
+        nodes = jax.tree_util.tree_leaves(state, is_leaf=is_q8)
+        if not all(is_q8(n) for n in nodes):
+            return False
+        if params is None:
+            return True
+        return all(jnp.shape(n.q) == jnp.shape(p)
+                   for n, p in zip(nodes,
+                                   jax.tree_util.tree_leaves(params)))
+
     if param_shaped(opt_state):
         return param_specs  # param-shaped subtree: moments, master, acc
+    if q8_param_shaped(opt_state):
+        def q8_spec(node, spec):
+            fields = {"q": spec}
+            for extra in node._fields[1:]:
+                fields[extra] = P()
+            return type(node)(**fields)
+        return jax.tree_util.tree_map(
+            q8_spec, opt_state, param_specs, is_leaf=is_q8)
     if isinstance(opt_state, tuple) and hasattr(opt_state, "_fields"):
         return type(opt_state)(*(
             opt_state_specs(getattr(opt_state, f), param_specs, params)

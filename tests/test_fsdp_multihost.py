@@ -329,3 +329,19 @@ def test_fsdp_fused_ce_matches_unfused(group8):
     for _ in range(3):
         out = step(out.params, out.opt_state, batch)
     assert float(out.loss) < l0
+
+
+def test_opt_state_specs_adamw_8bit_codes_shard():
+    """adamw_8bit's quantized moments shard under the FSDP layout: the
+    param-shaped int8 code arrays inherit the param specs, per-block
+    scales replicate — the '8-bit on top of ZeRO' composition is a real
+    sharding, not a silent P() fallback."""
+    params = {"w": jnp.zeros((64, 64), jnp.float32)}
+    p_specs = fsdp_param_specs(params, 8, min_size=1)
+    state = optim.adamw_8bit(1e-3).init(params)
+    o = opt_state_specs(state, p_specs, params=params)
+    assert o.step == P()
+    assert o.mu["w"].q == p_specs["w"]
+    assert o.nu["w"].q == p_specs["w"]
+    assert o.mu["w"].scale == P()
+    assert o.nu["w"].mid == P()

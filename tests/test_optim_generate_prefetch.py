@@ -580,3 +580,36 @@ class TestTopP:
                                        top_p=0.9))(
             params, prompt, jax.random.PRNGKey(1))
         assert out.shape == (2, 4)
+
+
+class TestAdamW8bit:
+    def test_tracks_f32_adamw_with_int8_state(self):
+        """Blockwise-int8 moments (log-domain second moment): params
+        track exact f32 AdamW within a few percent of the total update
+        while both moment code trees are stored int8 — ~1/4 the state
+        bytes. The log-domain nu matters: linear codes round small
+        second moments to exact zero and the sqrt(0)+eps denominator
+        explodes the step (regression guard: the tracking bound below
+        fails by >4x with linear nu codes)."""
+        params = {"w": jnp.ones((300, 7), jnp.float32),
+                  "b": jnp.zeros((5,), jnp.float32)}
+        opt8 = optim.adamw_8bit(1e-2)
+        optf = optim.adamw(1e-2)
+        s8, sf = opt8.init(params), optf.init(params)
+        p8, pf = params, params
+        rng = np.random.default_rng(0)
+        for _ in range(10):
+            g = {"w": jnp.asarray(rng.standard_normal((300, 7)),
+                                  jnp.float32) * 0.1,
+                 "b": jnp.asarray(rng.standard_normal(5),
+                                  jnp.float32) * 0.1}
+            p8, s8 = jax.jit(opt8.update)(g, s8, p8)
+            pf, sf = jax.jit(optf.update)(g, sf, pf)
+        assert s8.mu["w"].q.dtype == jnp.int8
+        assert s8.nu["w"].q.dtype == jnp.int8
+        # one f32 scale per 256 elements, not per element
+        assert s8.mu["w"].scale.size == -(-params["w"].size // 256)
+        for k in params:
+            diff = np.abs(np.asarray(p8[k]) - np.asarray(pf[k])).max()
+            total = np.abs(np.asarray(pf[k] - params[k])).max()
+            assert diff < 0.1 * max(total, 1e-6), (k, diff, total)
