@@ -64,13 +64,16 @@ def parse_args(argv=None):
                    help="positional scheme: learned absolute table or "
                         "rotary embeddings (RoPE, parameter-free)")
     p.add_argument("--lr", default=None, type=float,
-                   help="default: 3e-4 for adamw; unset for adafactor, "
-                        "which then uses its canonical relative-step mode "
-                        "min(1e-2, 1/sqrt(t)) * RMS(param)")
+                   help="default: 3e-4 for adamw and adamw8bit; unset "
+                        "for adafactor, which then uses its canonical "
+                        "relative-step mode min(1e-2, 1/sqrt(t)) * "
+                        "RMS(param)")
     p.add_argument("--optimizer", default="adamw",
-                   choices=["adamw", "adafactor"],
+                   choices=["adamw", "adafactor", "adamw8bit"],
                    help="adafactor: factored second moments, O(rows+cols) "
-                        "optimizer memory (optim.adafactor)")
+                        "optimizer memory (optim.adafactor); adamw8bit: "
+                        "blockwise-int8 moments, ~1/4 the state bytes "
+                        "(optim.adamw_8bit)")
     p.add_argument("--warmup-steps", default=0, type=int,
                    help="Linear warmup into cosine decay over --steps "
                         "(the standard LM schedule); 0 = constant lr.")
@@ -247,8 +250,8 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
         raise ValueError(
             f"--warmup-steps {args.warmup_steps} must be < --steps "
             f"{args.steps} (the cosine phase would never run)")
-    opt_fn = optim.adafactor if args.optimizer == "adafactor" \
-        else optim.adamw
+    opt_fn = {"adamw": optim.adamw, "adafactor": optim.adafactor,
+              "adamw8bit": optim.adamw_8bit}[args.optimizer]
     lr = args.lr if args.lr is not None else \
         (None if args.optimizer == "adafactor" else 3e-4)
     if args.warmup_steps > 0:
