@@ -452,7 +452,13 @@ jax.block_until_ready(out.loss)
 # per step anyway, so the fenced number is the semantically right one).
 # median-of-5 reps with spread: identical code swung 37.8-87.9 steps/s
 # across rounds 3-4 under host contention — a single rep is noise.
+# One UNTIMED warm rep first: the first timed rep otherwise runs ~10x
+# slow (cache/dispatch warmup) and poisons the spread with a warmup
+# artifact instead of genuine contention signal.
 n = 50
+for _ in range(n):
+    out = step(out.params, out.opt_state, (x, y))
+    jax.block_until_ready(out.loss)
 runs = []
 for _ in range(5):
     t0 = time.perf_counter()
