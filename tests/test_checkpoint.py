@@ -346,3 +346,28 @@ def test_retention_removes_old_and_tmp_forms(tmp_path):
     save_checkpoint(str(tmp_path), 4, params, keep=2)
     assert available_steps(str(tmp_path)) == [3, 4]
     assert "step_1.old.11111" not in os.listdir(tmp_path)
+
+
+def test_adamw_8bit_state_roundtrips_with_exact_resume(tmp_path):
+    """The quantized optimizer state (int8 code arrays + per-block
+    scale/mid NamedTuples) checkpoints and restores bit-exactly, and a
+    resumed step produces identical params to the uninterrupted run."""
+    from distributed_pytorch_tpu import optim
+    from distributed_pytorch_tpu.utils.checkpoint import (
+        restore_checkpoint, save_checkpoint)
+
+    params = {"w": jnp.ones((300, 7), jnp.float32)}
+    opt = optim.adamw_8bit(1e-2)
+    g = {"w": jnp.full((300, 7), 0.1, jnp.float32)}
+    params2, state2 = opt.update(g, opt.init(params), params)
+
+    save_checkpoint(str(tmp_path), step=1, params=params2,
+                    opt_state=state2)
+    r = restore_checkpoint(str(tmp_path), like_params=params2,
+                           like_opt_state=state2)
+    assert r.opt_state.mu["w"].q.dtype == jnp.int8
+    _tree_eq(r.opt_state, state2)   # every leaf: codes, scales, mids, step
+    p_a, _ = opt.update(g, state2, params2)
+    p_b, _ = opt.update(g, r.opt_state, r.params)
+    np.testing.assert_array_equal(np.asarray(p_a["w"]),
+                                  np.asarray(p_b["w"]))
