@@ -352,10 +352,6 @@ def test_adamw_8bit_state_roundtrips_with_exact_resume(tmp_path):
     """The quantized optimizer state (int8 code arrays + per-block
     scale/mid NamedTuples) checkpoints and restores bit-exactly, and a
     resumed step produces identical params to the uninterrupted run."""
-    from distributed_pytorch_tpu import optim
-    from distributed_pytorch_tpu.utils.checkpoint import (
-        restore_checkpoint, save_checkpoint)
-
     params = {"w": jnp.ones((300, 7), jnp.float32)}
     opt = optim.adamw_8bit(1e-2)
     g = {"w": jnp.full((300, 7), 0.1, jnp.float32)}

@@ -135,17 +135,21 @@ def test_transformer_lm_checkpoint_resume_exact(tmp_path):
 
 
 @pytest.mark.slow
-def test_long_context_sp_ring_flash():
-    """Sequence-parallel long-context training: dp x sp mesh with the
-    ring-flash attention island; loss finite and decreasing-ish over a
-    few steps."""
+@pytest.mark.parametrize("extra", [[], ["--sp-core", "striped"],
+                                   ["--sp-core", "ulysses"],
+                                   ["--window", "48"]])
+def test_long_context_sp_modes(extra):
+    """Sequence-parallel long-context training in every attention mode:
+    contiguous ring-flash, striped (data-level token striping), ulysses
+    (all-to-all), and sliding-window ring; loss finite over a few
+    steps."""
     import train_long_context
 
     h = []
     train_long_context.main(
         ["--steps", "6", "--seq-len", "128", "--sp", "4",
          "--batch-size", "2", "--dim", "32", "--n-layers", "1",
-         "--n-heads", "4", "--block-q", "16", "--block-k", "16"],
+         "--n-heads", "4", "--block-q", "16", "--block-k", "16"] + extra,
         quiet=True, history=h)
     assert len(h) == 5
     assert all(np.isfinite(x) for x in h)
