@@ -108,6 +108,12 @@ def run_json_subprocess(argv, timeout_s: int, *, label: str,
                 + os.environ.get("PYTHONPATH", "")}
     if env:
         base_env.update(env)
+    if base_env.get("JAX_PLATFORMS") == "cpu":
+        # this environment's sitecustomize dials the TPU relay at EVERY
+        # python startup when PALLAS_AXON_POOL_IPS is set; a wedged
+        # tunnel then hangs even pure-CPU children before user code
+        # runs. CPU stages have no business talking to the relay.
+        base_env.pop("PALLAS_AXON_POOL_IPS", None)
     try:
         out = subprocess.run(argv, capture_output=True, text=True,
                              timeout=timeout_s, env=base_env)

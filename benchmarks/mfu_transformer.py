@@ -62,6 +62,12 @@ FLAGSHIP = {"dim": 768, "n_layers": 12, "n_heads": 12, "vocab": 32000,
 # MFU; an additional reporting arm (--model medium), never the headline.
 MEDIUM = {"dim": 1024, "n_layers": 24, "n_heads": 16, "vocab": 32000,
           "seq": 1024, "batch": 8}
+# Mid tier (~60M params, --model mid): between the CI-sized smoke and the
+# flagship. Exists for the flaky-tunnel bracket: if flagship-scale
+# compiles wedge the tunnel, this still lands a meaningful MXU number
+# and brackets the wedge threshold (smoke 0.5M -> mid 60M -> 135M).
+MID = {"dim": 512, "n_layers": 8, "n_heads": 8, "vocab": 32000,
+       "seq": 1024, "batch": 8}
 # Long-context arm (--model long): flagship model at seq 4096 — the
 # regime the flash kernel was tuned for (8.5x vs dense at this seq,
 # BASELINE.md). Same 8192 tokens/step as the flagship; remat + fused-CE
@@ -105,12 +111,25 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
     from distributed_pytorch_tpu.utils.profiler import (
         StepTimer, compiled_stats, fetch_fence, time_steps_amortized)
 
+    def phase(msg):
+        # "#"-prefixed stdout so (a) the last-line-JSON contract holds and
+        # (b) a tunnel wedge mid-run leaves the reached phase in the
+        # collector's kept stdout tail — the round-3/round-5 flagship
+        # hangs died with zero output, undiagnosable
+        print(f"# mfu phase: {msg}", flush=True)
+
+    # two lines on purpose: jax.devices() is the first backend RPC and
+    # can hang on a wedged tunnel — the config must already be on stdout
+    phase(f"start dim={dim} L={n_layers} batch={batch} seq={seq}")
+    phase(f"backend device={jax.devices()[0].device_kind}")
     attn_fn = make_flash_attn_fn(interpret=interpret) \
         if use_flash else None
     model = models.TransformerLM(vocab=vocab, dim=dim, n_layers=n_layers,
                                  n_heads=n_heads, max_seq=seq,
                                  attn_fn=attn_fn, remat=remat, dtype=dtype)
     params = model.init(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    phase("params initialized on device")
     n_params = count_params(params)
     opt = optim.adamw(3e-4)
     if master_f32:
@@ -138,15 +157,6 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1),
                                 0, vocab, dtype=jnp.int32)
 
-    # XLA's own FLOP count for one step (cross-check; includes remat /
-    # non-matmul work, so it can exceed the analytic model count).
-    try:
-        xla_flops = compiled_stats(
-            lambda p, o, t: step(p, o, t), params, opt_state, tokens
-        ).get("flops", 0.0)
-    except Exception:
-        xla_flops = 0.0
-
     # Headline timing: an amortized data-dependent chain with ONE host
     # materialization at the end. On the tunneled backend here,
     # block_until_ready can resolve on enqueue (benchmarks/fence_probe.py),
@@ -154,12 +164,41 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
     # final loss transitively waits for all n steps and cannot lie.
     out = step(params, opt_state, tokens)          # compile
     fetch_fence(out.loss)
+    phase("train step compiled + first step fetched")
     for _ in range(2):                             # cache warming
         out = step(out.params, out.opt_state, tokens)
     fetch_fence(out.loss)
+    phase(f"warm; timing {steps} chained steps")
     step_s, out = time_steps_amortized(
         lambda o: step(o.params, o.opt_state, tokens), out, steps,
         lambda o: o.loss)
+
+    tok_per_step = batch * seq
+    tokens_per_sec = tok_per_step / step_s
+    fwd_fpt = model_flops_per_token(dim, n_layers, vocab, seq)
+    train_flops_per_step = 3 * fwd_fpt * tok_per_step   # bwd = 2x fwd
+    achieved = train_flops_per_step / step_s
+
+    dev = jax.devices()[0]
+    peak = PEAK_BF16.get(dev.device_kind)
+    mfu = achieved / peak if peak else None
+    # the measurement exists NOW — put it in the stdout tail before the
+    # diagnostics below, so a wedge in them cannot lose the headline
+    phase(f"MEASURED step_ms={step_s * 1e3:.3f} "
+          f"tokens_per_sec={tokens_per_sec:.1f} "
+          f"mfu={mfu if mfu is None else round(mfu, 4)}")
+
+    # XLA's own FLOP count for one step (cross-check; includes remat /
+    # non-matmul work, so it can exceed the analytic model count). After
+    # the headline timing on purpose: it is a second full compile, and on
+    # the tunneled backend any extra RPC is a chance to wedge.
+    try:
+        xla_flops = compiled_stats(
+            lambda p, o, t: step(p, o, t), params, opt_state, tokens
+        ).get("flops", 0.0)
+    except Exception:
+        xla_flops = 0.0
+    phase("cost-model cross-check done")
 
     # diagnostic: per-step latency with a host-fetch fence each step —
     # includes one tunnel round trip per step, so it upper-bounds the
@@ -170,15 +209,6 @@ def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
             out = step(out.params, out.opt_state, tokens)
             h["fence"] = out.loss
     lat_summ = lat.summary()
-    tok_per_step = batch * seq
-    tokens_per_sec = tok_per_step / step_s
-    fwd_fpt = model_flops_per_token(dim, n_layers, vocab, seq)
-    train_flops_per_step = 3 * fwd_fpt * tok_per_step   # bwd = 2x fwd
-    achieved = train_flops_per_step / step_s
-
-    dev = jax.devices()[0]
-    peak = PEAK_BF16.get(dev.device_kind)
-    mfu = achieved / peak if peak else None
     return {
         "device": dev.device_kind,
         "platform": dev.platform,
@@ -231,6 +261,13 @@ def sweep(arms=None, steps: int = 20) -> dict:
         arms = [dict(batch=8), dict(batch=8, fused_ce=True),
                 dict(batch=8, fused_ce=True, master_f32=True),
                 dict(batch=16, fused_ce=True),
+                # no-remat large-batch arms: fused-CE never materializes
+                # the (B,S,vocab) logits, so batch 32 may fit in 16 GiB
+                # HBM without remat — remat arms pay ~0.1 MFU of
+                # uncounted recompute, so a fitting no-remat arm should
+                # dominate (round-3 sweep only ever ran 32/64 with remat)
+                dict(batch=32, fused_ce=True),
+                dict(batch=16, fused_ce=True, master_f32=True),
                 dict(batch=16, fused_ce=True, remat=True),
                 dict(batch=32, fused_ce=True, remat=True),
                 dict(batch=64, fused_ce=True, remat=True)]
@@ -275,6 +312,10 @@ def main(argv):
             cfg = dict(MEDIUM)
             arm = dict(remat=remat, fused_ce=fused_ce,
                        master_f32=master_f32)
+        elif model == "mid":
+            cfg = dict(MID)
+            arm = dict(remat=remat, fused_ce=fused_ce,
+                       master_f32=master_f32)
         elif model == "long":
             cfg = dict(LONGCTX)
             # remat + fused-CE on unless explicitly overridden: at seq
@@ -284,7 +325,7 @@ def main(argv):
                        master_f32=master_f32)
         else:
             print(json.dumps({"error": f"unknown --model {model!r} "
-                              "(choices: medium, long)"}))
+                              "(choices: mid, medium, long)"}))
             return 2
         if batch:
             cfg["batch"] = batch

@@ -70,9 +70,10 @@ def render(rows) -> str:
         mfu = res(src_stage).get("mfu_detail", {})
     med = res("bench_mfu_medium")
     lng = res("mfu_long")
+    mid = res("mfu_mid")
     # the metric table starts whenever ANY MFU row exists — a round where
     # the flagship stage wedged but medium/long landed still renders
-    if any(r.get("mfu") is not None for r in (mfu, med, lng)):
+    if any(r.get("mfu") is not None for r in (mfu, med, lng, mid)):
         lines += ["| Metric | Value | Source row |", "|---|---|---|"]
         if mfu.get("mfu") is not None:
             c = mfu.get("config", {})
@@ -91,11 +92,23 @@ def render(rows) -> str:
         if med.get("mfu") is not None:
             lines.append(f"| medium (~355M) MFU | {_fmt(med['mfu'], 4)} | "
                          f"stage bench_mfu_medium |")
+        if mid.get("mfu") is not None:
+            lines.append(f"| mid (~60M bracket tier) MFU | "
+                         f"{_fmt(mid['mfu'], 4)} | stage mfu_mid |")
         if lng.get("mfu") is not None:
             lines.append(
                 f"| long-context (seq 4096) MFU | {_fmt(lng['mfu'], 4)}"
                 f" (hw {_fmt(lng.get('mfu_hw') or 0, 4)}) | "
                 f"stage mfu_long |")
+        lines.append("")
+
+    smoke = res("mfu_smoke")
+    if smoke.get("step_ms_median") is not None:
+        lines.append(
+            f"Chip-liveness smoke (CI-sized model, not a perf claim): "
+            f"device {smoke.get('device')}, step "
+            f"{_fmt(smoke['step_ms_median'], 2)} ms, "
+            f"{live.get('mfu_smoke', {}).get('ts', '?')}.")
         lines.append("")
 
     dec = res("bench_decode")

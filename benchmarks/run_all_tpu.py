@@ -24,9 +24,13 @@ for transfer into BASELINE.md.
 With --watch the script becomes the recovery automation itself: it
 probes the backend every --interval seconds (subprocess-isolated — an
 in-process `jax.devices()` against a wedged tunnel hangs forever) and
-the moment a probe succeeds it runs the full priority queue once and
-exits. This is the committed, reproducible form of the watcher that
-previous rounds ran as an ad-hoc session process.
+the moment a probe succeeds it runs the priority queue. A stage that
+fails (or a mid-collection re-wedge) does not end the run: the loop
+returns to the watch and retries every not-yet-succeeded stage on the
+next heal, until all stages landed, a stage failed MAX_ATTEMPTS times,
+or --max-hours ran out — so the process may live for the whole budget.
+This is the committed, reproducible form of the watcher that previous
+rounds ran as an ad-hoc session process.
 
 Usage: python benchmarks/run_all_tpu.py [--quick] [--out FILE]
            [--watch] [--interval SECONDS] [--max-hours H]
@@ -42,6 +46,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 import bench  # noqa: E402  (the shared subprocess/JSON plumbing)
+
+# In watch mode a failed stage is retried on later heals; after this many
+# failures with a healthy backend it is skipped for the rest of the run (a
+# poison stage that wedges the tunnel must not starve the rest of the
+# queue, and a genuinely broken stage would otherwise retry forever).
+MAX_ATTEMPTS = 3
 
 
 def run_stage(name: str, argv, timeout_s: int, env: dict = None) -> dict:
@@ -139,30 +149,6 @@ def _run(argv):
         deadline = time.time() + 3600.0 * float(
             _flag_value(argv, "--max-hours", "12"))
 
-    while True:
-        if watching:
-            hours_left = max(0.0, (deadline - time.time()) / 3600.0)
-            if not watch_for_backend(interval_s, hours_left, out_path):
-                return 1
-        info = bench.wait_for_backend(max_tries=2, base_sleep_s=15.0)
-        if info:
-            break
-        rec = {"stage": "tpu_health_gate", "ok": False,
-               "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
-               "result": {"error": "no healthy TPU backend; not running "
-                          "any on-chip stage"}}
-        with open(out_path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
-        print(json.dumps(rec))
-        if not (watching and time.time() + interval_s < deadline):
-            # one-shot mode, or the watch budget is spent: give up. In
-            # watch mode with budget left, a post-heal flap (healthy
-            # probe, then re-wedge before the gate's re-probe) loops
-            # back into the watch instead of abandoning the run.
-            return 1
-        time.sleep(interval_s)
-    print(f"# TPU healthy: {info.get('kind')}", flush=True)
-
     # bench.py embeds the default-config MFU, min_ddp and decode stages.
     # min_ddp/decode are NOT re-measured standalone (every duplicated
     # minute on the flaky tunnel is another chance to wedge
@@ -179,9 +165,20 @@ def _run(argv):
     # that heals for twenty minutes and wedges again should still leave
     # a flagship-MFU row on file (round 3 lost its headline to exactly
     # this). Stage name "bench_mfu" is what bench.last_good_record and
-    # benchmarks/report.py treat as the flagship record.
-    stages = [("bench_mfu",
+    # benchmarks/report.py treat as the flagship record. mfu_smoke goes
+    # even before it: a <60s CI-sized run that proves the chip did real
+    # compute within a minute of a heal (the round-5 flagship attempt
+    # wedged the tunnel 30 minutes in and left NOTHING on file).
+    stages = [("mfu_smoke",
+               [py, path("benchmarks/mfu_transformer.py"), "--small"],
+               420, None),
+              ("bench_mfu",
                [py, path("bench.py"), "--stage", "mfu"], 1800, None),
+              # the ~60M bracket tier: if flagship-scale wedges the
+              # tunnel, this still lands a meaningful MXU number
+              ("mfu_mid",
+               [py, path("benchmarks/mfu_transformer.py"),
+                "--model", "mid"], 900, None),
               ("flash_attention",
                [py, path("benchmarks/flash_attention_tpu.py")], 2400,
                None),
@@ -195,15 +192,16 @@ def _run(argv):
                {"DPX_BENCH_SELFLOG": "0"})]
     if not quick:
         extra = [
-            # the MFU-candidate grid (batch8+fused-CE+master-f32, batch
-            # 16/32 remat arms, HBM cliff at 64) — the data that picks
-            # the next flagship config (round-4 verdict: push >= 0.45)
-            # 7200s: seven flagship-scale arms (7x compile) — sized to
-            # the file's timeout standard (outer > child worst case);
-            # both sweeps also progress-print per arm to stdout so even
-            # a SIGKILL keeps the completed arms in the stdout tail
+            # the MFU-candidate grid (batch8+fused-CE+master-f32, the
+            # no-remat batch 16/32 arms, remat arms, HBM cliff at 64) —
+            # the data that picks the next flagship config (round-4
+            # verdict: push >= 0.45). 10800s: nine flagship-scale arms
+            # (9x compile) — sized to the file's timeout standard (outer
+            # > child worst case); both sweeps also progress-print per
+            # arm to stdout so even a SIGKILL keeps the completed arms
+            # in the stdout tail
             ("mfu_sweep", [py, path("benchmarks/mfu_transformer.py"),
-                           "--sweep"], 7200, None),
+                           "--sweep"], 10800, None),
             # long-context arm: flagship model at seq 4096 — the regime
             # the flash kernel's 8.5x win lives in (remat+fused-CE on)
             ("mfu_long", [py, path("benchmarks/mfu_transformer.py"),
@@ -225,36 +223,95 @@ def _run(argv):
             ("mfu_remat", [py, path("benchmarks/mfu_transformer.py"),
                            "--remat"], 1800, None),
         ]
-        stages[2:2] = extra  # after bench_mfu + flash, before headline
+        # after smoke/flagship/mid/flash, before the composite headline —
+        # the multi-hour sweeps must not starve the priority stages
+        stages[4:4] = extra
 
-    results = []
-    with open(out_path, "a") as f:
-        for i, (name, cmd, timeout_s, env) in enumerate(stages):
-            if i > 0 and not bench.probe_backend(timeout_s=90):
-                # the tunnel wedged mid-collection: abort instead of
-                # burning each remaining stage's full timeout against a
-                # dead backend (stages already collected stay on file)
-                rec = {"stage": f"health_gate_before_{name}", "ok": False,
-                       "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
-                       "result": {"error": "tunnel wedged mid-collection;"
-                                  " aborting remaining stages"}}
-                results.append(rec)
+    # Collection loop. One-shot mode: a single pass, aborting on a
+    # mid-collection wedge. Watch mode: a stage that fails does NOT end
+    # the run — the loop returns to the watch and retries every
+    # not-yet-succeeded stage on the next heal, until all stages landed,
+    # a stage failed MAX_ATTEMPTS times with the backend healthy (a real
+    # bug / a poison stage that wedges the tunnel — skip it, the rest of
+    # the queue still deserves its shot), or the time budget ran out.
+    # Round-5 lesson: the first heal lasted 30 min, the flagship wedged
+    # it, and the old abort-on-wedge path threw away the whole round.
+    done, attempts = set(), {}
+
+    while True:
+        if watching:
+            hours_left = max(0.0, (deadline - time.time()) / 3600.0)
+            if not watch_for_backend(interval_s, hours_left, out_path):
+                return 1
+        info = bench.wait_for_backend(max_tries=2, base_sleep_s=15.0)
+        if not info:
+            rec = {"stage": "tpu_health_gate", "ok": False,
+                   "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                   "result": {"error": "no healthy TPU backend; not "
+                              "running any on-chip stage"}}
+            with open(out_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+            print(json.dumps(rec))
+            if not (watching and time.time() + interval_s < deadline):
+                # one-shot mode, or the watch budget is spent: give up.
+                # In watch mode with budget left, a post-heal flap
+                # (healthy probe, then re-wedge before the gate's
+                # re-probe) loops back into the watch instead of
+                # abandoning the run.
+                return 1
+            time.sleep(interval_s)
+            continue
+        print(f"# TPU healthy: {info.get('kind')}", flush=True)
+
+        ran_this_pass = False
+        with open(out_path, "a") as f:
+            for name, cmd, timeout_s, env in stages:
+                if name in done or attempts.get(name, 0) >= MAX_ATTEMPTS:
+                    continue
+                if ran_this_pass and not bench.probe_backend(timeout_s=90):
+                    # the tunnel wedged mid-collection: stop this pass
+                    # instead of burning each remaining stage's full
+                    # timeout against a dead backend (collected stages
+                    # stay on file; watch mode re-enters the watch)
+                    rec = {"stage": f"health_gate_before_{name}",
+                           "ok": False,
+                           "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                           "result": {"error": "tunnel wedged "
+                                      "mid-collection; "
+                                      + ("pausing queue until next heal"
+                                         if watching else
+                                         "aborting remaining stages "
+                                         "(one-shot mode)")}}
+                    f.write(json.dumps(rec) + "\n")
+                    f.flush()
+                    print(json.dumps(rec), flush=True)
+                    break
+                print(f"=== {name} ===", flush=True)
+                ran_this_pass = True
+                rec = run_stage(name, cmd, timeout_s, env=env)
+                rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+                if rec["ok"]:
+                    done.add(name)
+                else:
+                    attempts[name] = attempts.get(name, 0) + 1
+                    rec["attempt"] = attempts[name]
                 f.write(json.dumps(rec) + "\n")
                 f.flush()
-                print(json.dumps(rec), flush=True)
-                break
-            print(f"=== {name} ===", flush=True)
-            rec = run_stage(name, cmd, timeout_s, env=env)
-            rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
-            results.append(rec)
-            f.write(json.dumps(rec) + "\n")
-            f.flush()
-            print(json.dumps({k: rec[k] for k in ("stage", "ok", "wall_s")
-                              if k in rec}), flush=True)
+                print(json.dumps({k: rec[k]
+                                  for k in ("stage", "ok", "wall_s",
+                                            "attempt") if k in rec}),
+                      flush=True)
 
-    n_ok = sum(r["ok"] for r in results)
-    print(f"\n{n_ok}/{len(results)} stages ok -> {out_path}")
-    return 0 if n_ok == len(results) else 1
+        pending = [n for n, _, _, _ in stages
+                   if n not in done and attempts.get(n, 0) < MAX_ATTEMPTS]
+        print(f"\n{len(done)}/{len(stages)} stages ok, "
+              f"{len(pending)} pending -> {out_path}", flush=True)
+        if not pending:
+            return 0 if len(done) == len(stages) else 1
+        if not (watching and time.time() + interval_s < deadline):
+            return 1
+        # wedged (or flaky-failed) with watch budget left: re-watch,
+        # then retry the pending stages on the next heal
 
 
 if __name__ == "__main__":

@@ -1,0 +1,141 @@
+"""The committed recovery automation: benchmarks/run_all_tpu.py's
+watch/resume loop. Round-5 lesson encoded as contract: a tunnel that
+heals, wedges mid-collection, and heals again must still end with every
+stage collected — the old abort-on-wedge path threw a whole round's
+evidence away. All backend interaction is mocked; no chip, no
+subprocesses."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+import bench  # noqa: E402
+import run_all_tpu  # noqa: E402
+
+
+def _wire(monkeypatch, tmp_path, *, probe_script, stage_fails,
+          watch_healthy=True):
+    """Mock the world. probe_script: list of bools consumed by the
+    mid-collection health gate (exhausted -> True). stage_fails: dict
+    stage name -> number of times it fails before succeeding."""
+    calls = {"watch": 0, "probe": 0, "stages": []}
+    fails_left = dict(stage_fails)
+
+    monkeypatch.setattr(run_all_tpu, "watch_for_backend",
+                        lambda *a, **k: (calls.__setitem__(
+                            "watch", calls["watch"] + 1) or watch_healthy))
+    monkeypatch.setattr(bench, "wait_for_backend",
+                        lambda **k: {"kind": "fake-tpu"})
+
+    def fake_probe(timeout_s=120):
+        i = calls["probe"]
+        calls["probe"] += 1
+        return probe_script[i] if i < len(probe_script) else True
+
+    monkeypatch.setattr(bench, "probe_backend", fake_probe)
+
+    def fake_stage(name, cmd, timeout_s, env=None):
+        calls["stages"].append(name)
+        if fails_left.get(name, 0) > 0:
+            fails_left[name] -= 1
+            return {"stage": name, "ok": False,
+                    "result": {"error": "mock timeout"}}
+        return {"stage": name, "ok": True, "result": {"mock": True}}
+
+    monkeypatch.setattr(run_all_tpu, "run_stage", fake_stage)
+    monkeypatch.setattr(run_all_tpu.time, "sleep", lambda s: None)
+    out = tmp_path / "rows.jsonl"
+    return calls, out
+
+
+def _rows(out):
+    return [json.loads(l) for l in out.read_text().splitlines()]
+
+
+def test_priority_order_smoke_then_flagship():
+    """mfu_smoke must be the first stage and the flagship second — the
+    first minutes of a heal are the only minutes you are promised."""
+    # stage list is built inside _run; assert via a dry parse of the file
+    src = open(os.path.join(REPO, "benchmarks", "run_all_tpu.py")).read()
+    assert src.index('("mfu_smoke"') < src.index('("bench_mfu"')
+    assert src.index('("bench_mfu"') < src.index('("flash_attention"')
+
+
+def test_watch_resumes_after_midcollection_wedge(monkeypatch, tmp_path):
+    # pass 1: smoke ok; flagship fails; gate before flash sees a wedge.
+    # pass 2 (after re-watch): flagship retried ok, flash + headline ok.
+    calls, out = _wire(monkeypatch, tmp_path,
+                       probe_script=[True, False],
+                       stage_fails={"bench_mfu": 1})
+    rc = run_all_tpu._run(["--watch", "--interval", "0",
+                           "--max-hours", "1", "--quick",
+                           "--out", str(out)])
+    assert rc == 0
+    assert calls["stages"] == ["mfu_smoke", "bench_mfu",      # pass 1
+                               "bench_mfu", "mfu_mid",          # pass 2
+                               "flash_attention", "bench_headline"]
+    assert calls["watch"] == 2  # initial heal + re-watch after the wedge
+    rows = _rows(out)
+    gates = [r for r in rows if r["stage"].startswith("health_gate")]
+    assert len(gates) == 1 and "pausing queue" in str(gates[0]["result"])
+    failed = [r for r in rows if r["stage"] == "bench_mfu" and not r["ok"]]
+    assert failed and failed[0]["attempt"] == 1
+
+
+def test_poison_stage_skipped_after_max_attempts(monkeypatch, tmp_path):
+    # flagship fails every time with a healthy backend: after
+    # MAX_ATTEMPTS tries it is skipped so the rest still collects.
+    calls, out = _wire(monkeypatch, tmp_path, probe_script=[],
+                       stage_fails={"bench_mfu": 99})
+    rc = run_all_tpu._run(["--watch", "--interval", "0",
+                           "--max-hours", "1", "--quick",
+                           "--out", str(out)])
+    assert rc == 1  # not everything landed — the record must say so
+    assert calls["stages"].count("bench_mfu") == run_all_tpu.MAX_ATTEMPTS
+    # every other stage succeeded exactly once
+    for name in ("mfu_smoke", "mfu_mid", "flash_attention",
+                 "bench_headline"):
+        assert calls["stages"].count(name) == 1
+    attempts = [r["attempt"] for r in _rows(out)
+                if r["stage"] == "bench_mfu"]
+    assert attempts == [1, 2, 3]
+
+
+def test_oneshot_aborts_on_wedge_without_retry(monkeypatch, tmp_path):
+    calls, out = _wire(monkeypatch, tmp_path,
+                       probe_script=[False],  # wedge right after smoke
+                       stage_fails={})
+    rc = run_all_tpu._run(["--quick", "--out", str(out)])
+    assert rc == 1
+    assert calls["stages"] == ["mfu_smoke"]  # flagship never launched
+    assert calls["watch"] == 0
+
+
+def test_full_queue_priority_and_headline_last(monkeypatch, tmp_path):
+    """Non-quick: the multi-hour sweep extras splice AFTER the priority
+    stages (smoke, flagship, mid bracket, flash) and the composite
+    headline stays last — a wedge during the ~3h sweep must not have
+    starved the stages added to land early after a heal."""
+    calls, out = _wire(monkeypatch, tmp_path, probe_script=[],
+                       stage_fails={})
+    rc = run_all_tpu._run(["--out", str(out)])
+    assert rc == 0
+    assert calls["stages"][:5] == ["mfu_smoke", "bench_mfu", "mfu_mid",
+                                   "flash_attention", "mfu_sweep"]
+    assert calls["stages"][-1] == "bench_headline"
+
+
+def test_all_ok_single_pass(monkeypatch, tmp_path):
+    calls, out = _wire(monkeypatch, tmp_path, probe_script=[],
+                       stage_fails={})
+    rc = run_all_tpu._run(["--quick", "--out", str(out)])
+    assert rc == 0
+    assert calls["stages"] == ["mfu_smoke", "bench_mfu", "mfu_mid",
+                               "flash_attention", "bench_headline"]
+    assert all(r["ok"] for r in _rows(out))
