@@ -172,6 +172,45 @@ def test_report_renders_latest_nonretracted(tmp_path):
     assert "dispatch-rate artifact" in md
 
 
+def test_roofline_floors_and_measured_wiring():
+    """The analytic roofline: flagship is compute-bound on v5e (this is
+    the 'not memory-bound, the gap is attackable' claim BASELINE leans
+    on), ceilings are sane, and the measured-row join takes the newest
+    non-retracted ok row."""
+    from benchmarks import roofline
+    from benchmarks.mfu_transformer import FLAGSHIP
+
+    a = roofline.analyze(FLAGSHIP)
+    assert a["bound"] == "compute"
+    assert a["compute_floor_ms"] > a["hbm_floor_ms"]
+    assert 0 < a["mfu_ceiling_no_overlap"] < a["mfu_ceiling"] <= 1.0
+    # fused-CE removes the logits item entirely
+    af = roofline.analyze(FLAGSHIP, fused_ce=True)
+    assert af["hbm_items_gb"]["logits_f32"] == 0.0
+    assert af["hbm_gb_per_step"] < a["hbm_gb_per_step"]
+    # param count agrees with the live model to within norm/bias noise
+    assert abs(a["n_params"] - 135e6) / 135e6 < 0.02
+
+    rows = [
+        {"stage": "bench_mfu", "ok": True,
+         "result": {"step_ms_median": 99.0}},
+        {"stage": "bench_mfu", "ok": True,
+         "result": {"step_ms_median": 76.3}},
+        {"stage": "bench_mfu", "ok": False,
+         "result": {"step_ms_median": 1.0}},
+        {"stage": "bench_mfu", "ok": True, "retracted": True,
+         "result": {"step_ms_median": 2.0}},
+    ]
+    assert roofline.measured_step_ms(rows, "bench_mfu") == 76.3
+    assert roofline.measured_step_ms(rows, "mfu_mid") is None
+    # a NEWER ok row without a step time must yield None, not silently
+    # fall back to the stale 76.3 (keeps roofline consistent with
+    # report.latest_per_stage about which measurement is current)
+    rows.append({"stage": "bench_mfu", "ok": True,
+                 "result": {"error": "partial"}})
+    assert roofline.measured_step_ms(rows, "bench_mfu") is None
+
+
 def test_graft_entry_compiles_single_device():
     """entry() must stay jittable — the driver compile-checks it."""
     import importlib.util
