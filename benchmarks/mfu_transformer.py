@@ -18,8 +18,11 @@ Usage: python benchmarks/mfu_transformer.py             (flagship, ~135M)
        python benchmarks/mfu_transformer.py --sweep     (batch/remat/fused-CE arms)
        python benchmarks/mfu_transformer.py --model medium   (~355M arm)
        python benchmarks/mfu_transformer.py --model long     (seq 4096 arm)
-       flags: --batch N --remat --fused-ce --no-fused-ce --no-remat
-              --master-f32
+       flags: --batch N --steps N --remat --fused-ce --no-fused-ce
+              --no-remat --master-f32
+       (--sweep isolates each arm in a subprocess with a per-arm
+       timeout and probes the backend between arms, unless
+       JAX_PLATFORMS=cpu)
 """
 
 from __future__ import annotations
@@ -252,11 +255,37 @@ def _flag_val(argv, flag, default, cast=int):
     return default
 
 
-def sweep(arms=None, steps: int = 20) -> dict:
+def _arm_argv(arm) -> list:
+    """Round-trip a sweep arm dict into CLI flags (subprocess mode)."""
+    unknown = set(arm) - {"batch", "fused_ce", "remat", "master_f32"}
+    if unknown:
+        raise ValueError(f"sweep arm has no CLI mapping for {unknown}")
+    argv = []
+    if "batch" in arm:
+        argv += ["--batch", str(arm["batch"])]
+    for key, flag in (("fused_ce", "--fused-ce"), ("remat", "--remat"),
+                      ("master_f32", "--master-f32")):
+        if arm.get(key):
+            argv.append(flag)
+    return argv
+
+
+def sweep(arms=None, steps: int = 20,
+          isolate: Optional[bool] = None) -> dict:
     """Try several (batch, remat, fused_ce) arms and report the best MFU.
 
     An arm that OOMs (or otherwise dies) is recorded with its error and
-    skipped — finding the HBM cliff is part of the sweep's job."""
+    skipped — finding the HBM cliff is part of the sweep's job.
+
+    ``isolate`` (default: auto — on unless JAX_PLATFORMS=cpu) runs each
+    arm as its own subprocess with a hard per-arm timeout and probes the
+    backend between arms: on the tunneled TPU here a wedge mid-arm would
+    otherwise hang the WHOLE sweep until the collector's outer timeout,
+    losing every later arm — per-arm isolation caps the damage at one
+    arm and keeps collecting if the tunnel recovers (probe gate aborts
+    early when it doesn't, leaving the per-arm records)."""
+    if isolate is None:
+        isolate = os.environ.get("JAX_PLATFORMS", "") != "cpu"
     if arms is None:
         arms = [dict(batch=8), dict(batch=8, fused_ce=True),
                 dict(batch=8, fused_ce=True, master_f32=True),
@@ -274,16 +303,60 @@ def sweep(arms=None, steps: int = 20) -> dict:
     results, best = [], None
     for arm in arms:
         label = json.dumps(arm, sort_keys=True)
-        try:
-            rec = run(steps=steps, **arm)
+        rec, err, extra = None, None, {}
+        if isolate:
+            import bench  # repo root is on sys.path (module preamble)
+            if not bench.probe_backend(timeout_s=90):
+                results.append({"arm": arm, "error":
+                                "backend wedged; sweep aborted early"})
+                print(f"# arm {label}: {json.dumps(results[-1])}",
+                      flush=True)
+                break
+            try:
+                argv = _arm_argv(arm)
+            except ValueError as e:
+                results.append({"arm": arm, "error": str(e)})
+                print(f"# arm {label}: {json.dumps(results[-1])}",
+                      flush=True)
+                continue
+            payload = bench.run_json_subprocess(
+                [sys.executable, os.path.abspath(__file__),
+                 "--steps", str(steps)] + argv,
+                900, label=f"sweep arm {label}", keep_stdout_tail=True)
+            if payload.get("mfu") is not None \
+                    or payload.get("tokens_per_sec") is not None:
+                # a record was printed: keep the measurements. Strip the
+                # error/rc a nonzero exit AFTER printing would add — a
+                # top-level "error" key would mark the whole sweep stage
+                # failed in the collector and burn a ~3h retry on data
+                # already collected — but surface it on the arm row.
+                rec = dict(payload)
+                arm_err = rec.pop("error", None)
+                arm_rc = rec.pop("rc", None)
+                if arm_err is not None:
+                    extra = {"arm_error": str(arm_err)[:300],
+                             "arm_rc": arm_rc}
+            else:
+                err = str(payload.get("error", "no record"))[:300]
+                # keep the child's per-phase progress lines — they show
+                # WHERE a wedged arm hung (the whole point of phase())
+                for k in ("stdout_tail", "stderr_tail"):
+                    if payload.get(k):
+                        extra[k] = str(payload[k])[-500:]
+        else:
+            try:
+                rec = run(steps=steps, **arm)
+            except Exception as e:  # noqa: BLE001 — OOM arms expected
+                err = f"{type(e).__name__}: {str(e)[:300]}"
+        if rec is not None:
             results.append({"arm": arm, "mfu": rec["mfu"],
                             "tokens_per_sec": rec["tokens_per_sec"],
-                            "step_ms_median": rec["step_ms_median"]})
+                            "step_ms_median": rec["step_ms_median"],
+                            **extra})
             if best is None or (rec["mfu"] or 0) > (best["mfu"] or 0):
                 best = rec
-        except Exception as e:  # noqa: BLE001 — OOM arms are expected
-            results.append({"arm": arm,
-                            "error": f"{type(e).__name__}: {str(e)[:300]}"})
+        else:
+            results.append({"arm": arm, "error": err, **extra})
         # stdout on purpose: the collector's timeout handler keeps the
         # stdout tail, so completed arms survive a mid-sweep SIGKILL
         # ("#" lines don't disturb the parse-last-line-as-JSON contract)
@@ -298,11 +371,13 @@ def main(argv):
     fused_ce = "--fused-ce" in argv
     master_f32 = "--master-f32" in argv
     batch = _flag_val(argv, "--batch", None)
+    steps = _flag_val(argv, "--steps", None)  # sweep arms pass their own
     if "--sweep" in argv:
         if remat or fused_ce or batch or master_f32:
             print("# --sweep runs its own fixed arm grid; --batch/--remat/"
-                  "--fused-ce/--master-f32 are ignored", file=sys.stderr)
-        rec = sweep()
+                  "--fused-ce/--master-f32 are ignored (--steps is "
+                  "honored)", file=sys.stderr)
+        rec = sweep(**({"steps": steps} if steps else {}))
     elif "--small" in argv:
         rec = run(dim=128, n_layers=2, n_heads=4, vocab=512, seq=256,
                   batch=batch or 4, steps=5, remat=remat, fused_ce=fused_ce,
@@ -329,10 +404,11 @@ def main(argv):
             return 2
         if batch:
             cfg["batch"] = batch
-        rec = run(steps=20, **arm, **cfg)
+        rec = run(steps=steps or 20, **arm, **cfg)
     else:
         rec = run(remat=remat, fused_ce=fused_ce, master_f32=master_f32,
-                  **({"batch": batch} if batch else {}))
+                  **({"batch": batch} if batch else {}),
+                  **({"steps": steps} if steps else {}))
     # one compact line: collectors parse the last stdout line as JSON
     print(json.dumps(rec))
     return 0

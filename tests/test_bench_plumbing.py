@@ -172,6 +172,67 @@ def test_report_renders_latest_nonretracted(tmp_path):
     assert "dispatch-rate artifact" in md
 
 
+def test_sweep_arm_isolation_and_abort():
+    """--sweep subprocess mode: arms round-trip to CLI flags, a healthy
+    probe launches per-arm subprocesses whose records are collected, and
+    a wedged probe aborts the sweep early instead of hanging until the
+    collector's outer timeout (the round-5 mid-sweep wedge mode)."""
+    import pytest as _pytest
+
+    from benchmarks import mfu_transformer as mt
+
+    assert mt._arm_argv({"batch": 32, "fused_ce": True}) == \
+        ["--batch", "32", "--fused-ce"]
+    assert mt._arm_argv({"remat": True, "master_f32": True}) == \
+        ["--remat", "--master-f32"]
+    with _pytest.raises(ValueError):
+        mt._arm_argv({"batch": 8, "dtype": "f32"})  # no CLI mapping
+
+    calls = {"probe": 0, "sub": []}
+
+    def fake_probe(timeout_s=120):
+        calls["probe"] += 1
+        return calls["probe"] < 5  # wedge before the last arm
+
+    def fake_sub(argv, timeout_s, **kw):
+        calls["sub"].append(argv)
+        n = len(calls["sub"])
+        if n == 2:   # record printed, then nonzero exit
+            return {"mfu": 0.5, "tokens_per_sec": 2.0,
+                    "step_ms_median": 1.0, "error": "rc 1", "rc": 1}
+        if n == 3:   # wedged arm: timeout with kept phase lines
+            return {"error": "sweep arm timed out after 900s",
+                    "stdout_tail": "# mfu phase: warm; timing"}
+        return {"mfu": 0.4, "tokens_per_sec": 1.0, "step_ms_median": 2.0}
+
+    import bench as bench_mod
+    orig = (bench_mod.probe_backend, bench_mod.run_json_subprocess)
+    bench_mod.probe_backend = fake_probe
+    bench_mod.run_json_subprocess = fake_sub
+    try:
+        out = mt.sweep(arms=[dict(batch=8), dict(batch=16),
+                             dict(dtype="f32"),  # no CLI mapping
+                             dict(batch=32), dict(batch=64)],
+                       steps=7, isolate=True)
+    finally:
+        bench_mod.probe_backend, bench_mod.run_json_subprocess = orig
+    assert len(calls["sub"]) == 3  # bad arm skipped, last arm aborted
+    assert all("--steps" in a and "7" in a for a in calls["sub"])
+    sw = out["sweep"]
+    assert sw[0]["mfu"] == 0.4
+    # nonzero-exit-with-record: measurements kept, error surfaced on the
+    # arm row, NOT on the top-level record (a top-level "error" would
+    # fail the whole stage in the collector and burn a ~3h retry)
+    assert sw[1]["mfu"] == 0.5 and sw[1]["arm_error"] == "rc 1"
+    assert out["mfu"] == 0.5 and "error" not in out
+    # unmappable arm recorded and skipped, sweep continues
+    assert "no CLI mapping" in sw[2]["error"]
+    # wedged arm keeps the child's phase lines for hang diagnosis
+    assert "mfu phase" in sw[3]["stdout_tail"]
+    # probe wedge before the final arm aborts the remainder
+    assert "aborted early" in sw[4]["error"]
+
+
 def test_roofline_floors_and_measured_wiring():
     """The analytic roofline: flagship is compute-bound on v5e (this is
     the 'not memory-bound, the gap is attackable' claim BASELINE leans
