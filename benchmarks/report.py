@@ -7,7 +7,12 @@ prints a markdown summary ready to paste into BASELINE.md (plus one JSON
 line for tooling). Retracted rows are listed by stage + reason so the
 retraction trail stays visible.
 
-Usage: python benchmarks/report.py [--log FILE]
+Usage: python benchmarks/report.py [--log FILE] [--write-baseline]
+
+--write-baseline splices the rendered section into BASELINE.md between
+the BEGIN/END MEASURED AUTO markers (the watcher runs this after every
+pass that lands a stage, so fresh evidence reaches BASELINE.md on disk
+even when no one is at the keyboard).
 """
 
 from __future__ import annotations
@@ -203,23 +208,62 @@ def render(rows) -> str:
     return "\n".join(lines)
 
 
+BASELINE_PATH = os.path.join(REPO, "BASELINE.md")
+MARK_BEGIN = ("<!-- BEGIN MEASURED AUTO (regenerated by "
+              "benchmarks/report.py --write-baseline; do not edit by "
+              "hand) -->")
+MARK_END = "<!-- END MEASURED AUTO -->"
+
+
+def write_baseline(md: str, path: str = None) -> bool:
+    """Replace the marker-delimited span in BASELINE.md with ``md``.
+    Returns False (no write) when the markers are absent/corrupted —
+    never clobbers prose outside the span."""
+    path = path or BASELINE_PATH
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except (OSError, ValueError):  # ValueError covers UnicodeDecodeError
+        return False
+    b = text.find(MARK_BEGIN)
+    e = text.find(MARK_END)
+    if b == -1 or e == -1 or e < b:
+        return False
+    new = (text[:b + len(MARK_BEGIN)] + "\n" + md.rstrip() + "\n"
+           + text[e:])
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(new)
+    os.replace(tmp, path)
+    return True
+
+
 def main(argv):
     path = DEFAULT_LOG
     if "--log" in argv:
         i = argv.index("--log")
         if i + 1 >= len(argv):
-            print("usage: report.py [--log FILE]", file=sys.stderr)
+            print("usage: report.py [--log FILE] [--write-baseline]",
+                  file=sys.stderr)
             return 2
         path = argv[i + 1]
     rows = load_rows(path)
     md = render(rows)
     print(md)
+    rc = 0
+    if "--write-baseline" in argv:
+        ok = write_baseline(md)
+        status = "updated" if ok else "NOT updated (markers missing)"
+        print(f"# BASELINE.md {status}", file=sys.stderr)
+        rc = 0 if ok else 1
+    # the JSON summary line prints on EVERY path — tooling parses the
+    # last stdout line even when the baseline write failed
     live = latest_per_stage(rows)
     print(json.dumps({"stages_on_file": sorted(live),
                       "n_rows": len(rows),
                       "n_retracted": sum(bool(r.get("retracted"))
                                          for r in rows)}))
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

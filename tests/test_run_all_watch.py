@@ -50,6 +50,10 @@ def _wire(monkeypatch, tmp_path, *, probe_script, stage_fails,
 
     monkeypatch.setattr(run_all_tpu, "run_stage", fake_stage)
     monkeypatch.setattr(run_all_tpu.time, "sleep", lambda s: None)
+    monkeypatch.setattr(
+        run_all_tpu, "regenerate_baseline",
+        lambda *a, **k: calls.__setitem__(
+            "regen", calls.get("regen", 0) + 1))
     out = tmp_path / "rows.jsonl"
     return calls, out
 
@@ -139,3 +143,30 @@ def test_all_ok_single_pass(monkeypatch, tmp_path):
     assert calls["stages"] == ["mfu_smoke", "bench_mfu", "mfu_mid",
                                "flash_attention", "bench_headline"]
     assert all(r["ok"] for r in _rows(out))
+    # evidence landed -> BASELINE.md regeneration ran for the pass
+    assert calls.get("regen", 0) == 1
+
+
+def test_write_baseline_splices_between_markers(tmp_path):
+    """report.write_baseline replaces ONLY the marker-delimited span and
+    refuses to touch a file whose markers are missing."""
+    from benchmarks import report
+
+    doc = tmp_path / "BASELINE.md"
+    doc.write_text("intro prose\n" + report.MARK_BEGIN
+                   + "\nstale tables\n" + report.MARK_END
+                   + "\noutro prose\n")
+    assert report.write_baseline("## fresh tables", path=str(doc))
+    text = doc.read_text()
+    assert "## fresh tables" in text and "stale tables" not in text
+    assert text.startswith("intro prose") and "outro prose" in text
+    # idempotent: a second write replaces the span again, not nests it
+    assert report.write_baseline("## fresher", path=str(doc))
+    text2 = doc.read_text()
+    assert "## fresher" in text2 and "fresh tables" not in text2
+    assert text2.count(report.MARK_BEGIN) == 1
+
+    bare = tmp_path / "no_markers.md"
+    bare.write_text("hand-written prose only\n")
+    assert not report.write_baseline("## x", path=str(bare))
+    assert bare.read_text() == "hand-written prose only\n"
