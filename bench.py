@@ -512,6 +512,28 @@ def _stage_main(stage: str) -> int:
     return 0
 
 
+def attach_roofline(rec: dict) -> None:
+    """The analytic roofline travels WITH the headline: floors, the
+    overlap/no-overlap MFU ceilings, and (when the flagship measured)
+    the efficiency gap — so the record answers "is this number
+    physics-bound or attackable?" on its own (benchmarks/roofline.py).
+    Best-effort: never blocks the record."""
+    try:
+        from benchmarks.mfu_transformer import FLAGSHIP
+        from benchmarks.roofline import analyze, attach_measured
+        rl = attach_measured(
+            analyze(FLAGSHIP),
+            rec.get("mfu_detail", {}).get("step_ms_median"))
+        rec["roofline_flagship"] = {
+            k: rl[k] for k in
+            ("compute_floor_ms", "hbm_floor_ms", "bound", "mfu_ceiling",
+             "mfu_ceiling_no_overlap", "measured_step_ms",
+             "efficiency_gap_x") if k in rl}
+    except Exception as e:  # noqa: BLE001
+        rec.setdefault("warnings", []).append(
+            f"roofline attach failed: {type(e).__name__}: {e}")
+
+
 def main():
     rec = {
         "metric": "transformer_lm_mfu_single_chip",
@@ -589,6 +611,7 @@ def main():
             pass
 
     rec["dp8"] = bench_dp8()
+    attach_roofline(rec)
 
     # the composite headline record is itself a raw-JSON trace — except
     # under run_all_tpu, whose bench_headline stage wrapper already logs

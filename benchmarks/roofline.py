@@ -130,6 +130,20 @@ def analyze(cfg, *, device_kind: str = "TPU v5 lite",
     }
 
 
+def attach_measured(analysis: dict, meas_ms) -> dict:
+    """Join a measured step time onto an analyze() result: records
+    measured_step_ms and the efficiency gap vs the binding floor. The
+    ONE definition of the join rule — bench.attach_roofline and main()
+    both use it, so the headline record and the roofline report can
+    never disagree about the same measurement."""
+    if meas_ms:
+        analysis["measured_step_ms"] = meas_ms
+        analysis["efficiency_gap_x"] = round(
+            meas_ms / max(analysis["compute_floor_ms"],
+                          analysis["hbm_floor_ms"]), 2)
+    return analysis
+
+
 def measured_step_ms(rows, stage: str):
     """The NEWEST ok non-retracted row's step_ms_median for a stage —
     None when that row lacks one (no silent fallback to a stale older
@@ -169,12 +183,8 @@ def main(argv):
     for name, cfg, arm, stage in configs:
         a = analyze(cfg, **arm)
         meas = measured_step_ms(rows, stage) if stage else None
-        gap = None
-        if meas is not None:
-            gap = round(meas / max(a["compute_floor_ms"],
-                                   a["hbm_floor_ms"]), 2)
-            a["measured_step_ms"] = meas
-            a["efficiency_gap_x"] = gap
+        attach_measured(a, meas)
+        gap = a.get("efficiency_gap_x")
         out["configs"][name] = a
         print(f"# {name}: {a['n_params']/1e6:.0f}M | "
               f"{a['model_tflops_per_step']} | {a['hbm_gb_per_step']} | "

@@ -272,6 +272,24 @@ def test_roofline_floors_and_measured_wiring():
     assert roofline.measured_step_ms(rows, "bench_mfu") is None
 
 
+def test_attach_roofline_on_headline_record():
+    """The headline record carries the analytic floors, and the
+    efficiency gap is computed only when a measured step exists."""
+    rec = {"mfu_detail": {"step_ms_median": 76.3}}
+    bench.attach_roofline(rec)
+    rl = rec["roofline_flagship"]
+    assert rl["bound"] == "compute"
+    assert rl["measured_step_ms"] == 76.3
+    assert rl["efficiency_gap_x"] == round(
+        76.3 / rl["compute_floor_ms"], 2)
+    assert "warnings" not in rec
+
+    bare = {}
+    bench.attach_roofline(bare)
+    assert "efficiency_gap_x" not in bare["roofline_flagship"]
+    assert bare["roofline_flagship"]["compute_floor_ms"] > 0
+
+
 def test_graft_entry_compiles_single_device():
     """entry() must stay jittable — the driver compile-checks it."""
     import importlib.util
