@@ -59,8 +59,16 @@ PEAK_BF16 = {
 # The flagship single-chip benchmark config (GPT-2-small class). bench.py
 # measures its torch-CPU baseline from THESE constants — change them here
 # and every consumer (run() defaults, the vs_baseline denominator) follows.
+# The arm flags (fused_ce/remat/master_f32) are part of the flagship
+# identity too: run() defaults to them, so promoting a sweep winner to
+# flagship is a one-dict edit picked up by every consumer (bench.py
+# --stage mfu and mfu_medium, the CLI default path, the roofline join).
+# Sweep arms are immune on purpose: they pin every arm flag explicitly
+# so the recorded arm labels always describe what ran.
 FLAGSHIP = {"dim": 768, "n_layers": 12, "n_heads": 12, "vocab": 32000,
-            "seq": 1024, "batch": 8}
+            "seq": 1024, "batch": 8,
+            "fused_ce": False, "remat": False, "master_f32": False}
+ARM_FLAGS = ("fused_ce", "remat", "master_f32")
 # GPT-2-medium class (~355M params): bigger matmuls -> higher attainable
 # MFU; an additional reporting arm (--model medium), never the headline.
 MEDIUM = {"dim": 1024, "n_layers": 24, "n_heads": 16, "vocab": 32000,
@@ -101,9 +109,10 @@ def count_params(params) -> int:
 def run(dim: int = FLAGSHIP["dim"], n_layers: int = FLAGSHIP["n_layers"],
         n_heads: int = FLAGSHIP["n_heads"], vocab: int = FLAGSHIP["vocab"],
         seq: int = FLAGSHIP["seq"], batch: int = FLAGSHIP["batch"],
-        steps: int = 30, dtype=jnp.bfloat16, remat: bool = False,
-        use_flash: bool = True, fused_ce: bool = False,
-        master_f32: bool = False,
+        steps: int = 30, dtype=jnp.bfloat16,
+        remat: bool = FLAGSHIP["remat"],
+        use_flash: bool = True, fused_ce: bool = FLAGSHIP["fused_ce"],
+        master_f32: bool = FLAGSHIP["master_f32"],
         interpret: Optional[bool] = None) -> dict:
     from distributed_pytorch_tpu import models, optim
     from distributed_pytorch_tpu.ops import make_flash_attn_fn
@@ -256,8 +265,13 @@ def _flag_val(argv, flag, default, cast=int):
 
 
 def _arm_argv(arm) -> list:
-    """Round-trip a sweep arm dict into CLI flags (subprocess mode)."""
-    unknown = set(arm) - {"batch", "fused_ce", "remat", "master_f32"}
+    """Round-trip a sweep arm dict into CLI flags (subprocess mode).
+
+    Every arm flag is passed EXPLICITLY (--fused-ce or --no-fused-ce,
+    never absent): an absent flag would fall back to the FLAGSHIP
+    default in the child, so after a flagship promotion the arm label
+    would no longer describe what ran."""
+    unknown = set(arm) - ({"batch"} | set(ARM_FLAGS))
     if unknown:
         raise ValueError(f"sweep arm has no CLI mapping for {unknown}")
     argv = []
@@ -265,8 +279,8 @@ def _arm_argv(arm) -> list:
         argv += ["--batch", str(arm["batch"])]
     for key, flag in (("fused_ce", "--fused-ce"), ("remat", "--remat"),
                       ("master_f32", "--master-f32")):
-        if arm.get(key):
-            argv.append(flag)
+        argv.append(flag if arm.get(key)
+                    else flag.replace("--", "--no-", 1))
     return argv
 
 
@@ -345,7 +359,12 @@ def sweep(arms=None, steps: int = 20,
                         extra[k] = str(payload[k])[-500:]
         else:
             try:
-                rec = run(steps=steps, **arm)
+                # arm flags pinned explicitly (False unless the arm sets
+                # them) — mirrors _arm_argv's explicit on/off flags, so
+                # both isolation modes measure the same grid even after
+                # a flagship promotion changes run()'s defaults
+                rec = run(steps=steps,
+                          **{**{k: False for k in ARM_FLAGS}, **arm})
             except Exception as e:  # noqa: BLE001 — OOM arms expected
                 err = f"{type(e).__name__}: {str(e)[:300]}"
         if rec is not None:
@@ -366,38 +385,49 @@ def sweep(arms=None, steps: int = 20,
     return out
 
 
+def _tristate(argv, flag):
+    """--flag -> True, --no-flag -> False, absent -> None (= defer to
+    run()'s defaults, i.e. the FLAGSHIP arm-flag identity)."""
+    if flag in argv:
+        return True
+    if flag.replace("--", "--no-", 1) in argv:
+        return False
+    return None
+
+
 def main(argv):
-    remat = "--remat" in argv
-    fused_ce = "--fused-ce" in argv
-    master_f32 = "--master-f32" in argv
+    tri = {"remat": _tristate(argv, "--remat"),
+           "fused_ce": _tristate(argv, "--fused-ce"),
+           "master_f32": _tristate(argv, "--master-f32")}
+    explicit = {k: v for k, v in tri.items() if v is not None}
     batch = _flag_val(argv, "--batch", None)
     steps = _flag_val(argv, "--steps", None)  # sweep arms pass their own
     if "--sweep" in argv:
-        if remat or fused_ce or batch or master_f32:
+        if explicit or batch:
             print("# --sweep runs its own fixed arm grid; --batch/--remat/"
                   "--fused-ce/--master-f32 are ignored (--steps is "
                   "honored)", file=sys.stderr)
         rec = sweep(**({"steps": steps} if steps else {}))
     elif "--small" in argv:
+        # CI-sized smoke: arm flags explicit-off unless flagged — the
+        # flagship recipe is irrelevant at this scale
         rec = run(dim=128, n_layers=2, n_heads=4, vocab=512, seq=256,
-                  batch=batch or 4, steps=5, remat=remat, fused_ce=fused_ce,
-                  master_f32=master_f32)
+                  batch=batch or 4, steps=5,
+                  **{k: tri[k] or False for k in tri})
     elif (model := _flag_val(argv, "--model", "flagship", str)) != "flagship":
         if model == "medium":
             cfg = dict(MEDIUM)
-            arm = dict(remat=remat, fused_ce=fused_ce,
-                       master_f32=master_f32)
+            arm = dict(explicit)  # unflagged -> flagship recipe
         elif model == "mid":
             cfg = dict(MID)
-            arm = dict(remat=remat, fused_ce=fused_ce,
-                       master_f32=master_f32)
+            arm = dict(explicit)
         elif model == "long":
             cfg = dict(LONGCTX)
             # remat + fused-CE on unless explicitly overridden: at seq
             # 4096 the logits and per-layer activations dominate HBM
-            arm = dict(remat="--no-remat" not in argv,
-                       fused_ce="--no-fused-ce" not in argv,
-                       master_f32=master_f32)
+            arm = dict(remat=tri["remat"] is not False,
+                       fused_ce=tri["fused_ce"] is not False,
+                       master_f32=tri["master_f32"] or False)
         else:
             print(json.dumps({"error": f"unknown --model {model!r} "
                               "(choices: mid, medium, long)"}))
@@ -406,7 +436,10 @@ def main(argv):
             cfg["batch"] = batch
         rec = run(steps=steps or 20, **arm, **cfg)
     else:
-        rec = run(remat=remat, fused_ce=fused_ce, master_f32=master_f32,
+        # the flagship path: unflagged arm flags defer to run()'s
+        # defaults — the FLAGSHIP dict — so a promotion changes this
+        # path and bench.py --stage mfu identically
+        rec = run(**explicit,
                   **({"batch": batch} if batch else {}),
                   **({"steps": steps} if steps else {}))
     # one compact line: collectors parse the last stdout line as JSON

@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -61,16 +62,23 @@ def count_params(cfg) -> int:
     return V * d + L * per_layer + V * d  # emb + blocks + untied head
 
 
-def hbm_bytes_per_step(cfg, *, fused_ce: bool = False,
-                       remat: bool = False,
-                       master_f32: bool = False) -> dict:
+def hbm_bytes_per_step(cfg, *, fused_ce: Optional[bool] = None,
+                       remat: Optional[bool] = None,
+                       master_f32: Optional[bool] = None) -> dict:
     """Itemized HBM traffic for one train step, bytes.
 
     A deliberate lower-bound model: each item counted once at its
     minimum unavoidable traffic (e.g. params read once for forward and
     once for backward, moments read+written once). Real executions
     re-stream tiles; that inefficiency is what the measured gap shows.
+
+    Arm flags left as None default from the config dict itself (the
+    FLAGSHIP identity carries them), same contract as :func:`analyze`.
     """
+    fused_ce = cfg.get("fused_ce", False) if fused_ce is None else fused_ce
+    remat = cfg.get("remat", False) if remat is None else remat
+    master_f32 = (cfg.get("master_f32", False) if master_f32 is None
+                  else master_f32)
     P = count_params(cfg)
     B, S, d, L, V = (cfg["batch"], cfg["seq"], cfg["dim"],
                      cfg["n_layers"], cfg["vocab"])
@@ -100,8 +108,15 @@ def hbm_bytes_per_step(cfg, *, fused_ce: bool = False,
 
 
 def analyze(cfg, *, device_kind: str = "TPU v5 lite",
-            fused_ce: bool = False, remat: bool = False,
-            master_f32: bool = False) -> dict:
+            fused_ce: Optional[bool] = None, remat: Optional[bool] = None,
+            master_f32: Optional[bool] = None) -> dict:
+    # arm flags default from the config dict itself (FLAGSHIP carries
+    # its arm flags as part of the flagship identity) so a flagship
+    # promotion propagates here without touching call sites
+    fused_ce = cfg.get("fused_ce", False) if fused_ce is None else fused_ce
+    remat = cfg.get("remat", False) if remat is None else remat
+    master_f32 = (cfg.get("master_f32", False) if master_f32 is None
+                  else master_f32)
     peak = PEAK_BF16[device_kind]
     bw = HBM_GBPS[device_kind]
     tok = cfg["batch"] * cfg["seq"]

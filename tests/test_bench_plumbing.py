@@ -181,12 +181,19 @@ def test_sweep_arm_isolation_and_abort():
 
     from benchmarks import mfu_transformer as mt
 
+    # every arm flag is explicit on/off — an absent flag would pick up
+    # the FLAGSHIP default in the child after a flagship promotion
     assert mt._arm_argv({"batch": 32, "fused_ce": True}) == \
-        ["--batch", "32", "--fused-ce"]
+        ["--batch", "32", "--fused-ce", "--no-remat", "--no-master-f32"]
     assert mt._arm_argv({"remat": True, "master_f32": True}) == \
-        ["--remat", "--master-f32"]
+        ["--no-fused-ce", "--remat", "--master-f32"]
     with _pytest.raises(ValueError):
         mt._arm_argv({"batch": 8, "dtype": "f32"})  # no CLI mapping
+    # the child CLI round-trips the explicit negatives to False and the
+    # positives to True (tristate: absent defers to FLAGSHIP)
+    assert mt._tristate(["--fused-ce"], "--fused-ce") is True
+    assert mt._tristate(["--no-fused-ce"], "--fused-ce") is False
+    assert mt._tristate([], "--fused-ce") is None
 
     calls = {"probe": 0, "sub": []}
 
