@@ -90,6 +90,15 @@ def wait_for_backend(max_tries: int = 4, base_sleep_s: float = 30.0) -> dict:
     return {}
 
 
+def progress(msg: str) -> None:
+    """One flushed "#"-prefixed stdout line — the progress contract every
+    on-chip stage leans on: "#" preserves the parse-last-line-as-JSON
+    collector contract, and the flush makes the line survive a collector
+    SIGKILL (block-buffered pipes lose unflushed output), so a wedged
+    stage's kept stdout tail shows exactly how far it got."""
+    print(f"# {msg}", flush=True)
+
+
 def run_json_subprocess(argv, timeout_s: int, *, label: str,
                         env: dict = None,
                         keep_stdout_tail: bool = False) -> dict:

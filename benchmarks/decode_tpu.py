@@ -165,20 +165,32 @@ def run_gqa_compare(small: bool = False) -> dict:
     kw = dict(dim=128, n_layers=2, n_heads=4, vocab=512, prompt_len=16,
               max_new=32, batch=2) if small else {}
     n_kv = 1 if small else 3                         # group 4
-    mha = run(**kw)
-    gqa = run(n_kv_heads=n_kv, **kw)
-    gqa_int8 = run(n_kv_heads=n_kv, int8_weights=True, **kw)
+
+    import bench
+
+    def arm(msg, fn, *a, **k):
+        # per-arm progress (bench.progress contract): a tunnel wedge
+        # mid-arm leaves WHICH arm hung in the collector's stdout tail
+        bench.progress(f"decode arm: {msg}")
+        return fn(*a, **k)
+
+    mha = arm("mha", run, **kw)
+    gqa = arm("gqa", run, n_kv_heads=n_kv, **kw)
+    gqa_int8 = arm("gqa_int8", run, n_kv_heads=n_kv, int8_weights=True,
+                   **kw)
     # pinned arm: weight stream tied into the scan so int8 dequant can't
     # be hoisted (generate.py:pin_weight_stream). int8 vs int8_pinned is
     # the empirical answer to "did XLA hoist the dequant": if pinned is
     # faster, the plain arm was streaming bf16.
-    gqa_int8_pin = run(n_kv_heads=n_kv, int8_weights=True,
-                       pin_weight_stream=True, **kw)
+    gqa_int8_pin = arm("gqa_int8_pinned", run, n_kv_heads=n_kv,
+                       int8_weights=True, pin_weight_stream=True, **kw)
     # rolling-cache arm: sliding window = 1/3 of the total length, so
     # the cache the decode step streams shrinks 3x (models/generate.py
     # rolling buffer) — stacks with GQA's group-factor shrink
     win = 16 if small else 128
-    gqa_window = run(n_kv_heads=n_kv, window=win, **kw)
+    gqa_window = arm("gqa_window", run, n_kv_heads=n_kv, window=win,
+                     **kw)
+    bench.progress("decode arms done")
     base = mha["decode_tokens_per_sec"]
     return {"mha": mha, "gqa": gqa, "gqa_int8": gqa_int8,
             "gqa_int8_pinned": gqa_int8_pin,

@@ -174,6 +174,11 @@ def speedup_table(dtype=jnp.bfloat16, b=4, h=8, d=64):
 
 
 def main():
+    # line-buffer stdout: the collector SIGKILLs a wedged stage at its
+    # timeout, and a block-buffered pipe would lose every progress line
+    # printed before the hang (the round-5 zero-output-timeout mode)
+    sys.stdout.reconfigure(line_buffering=True)
+    print("flash_attention_tpu: querying backend (first RPC)...")
     dev = jax.devices()[0]
     print(f"device: {dev.device_kind} ({dev.platform})")
     if dev.platform != "tpu":

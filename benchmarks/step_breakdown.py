@@ -125,13 +125,23 @@ def run(dim=FLAGSHIP["dim"], n_layers=FLAGSHIP["n_layers"],
         # negligible FLOPs vs the real attention matmuls
         return v + 0.0 * (q + k.repeat(q.shape[-3] // k.shape[-3], -3))
 
+    import bench
+
+    def arm(name, thunk):
+        # the banner prints BEFORE any of the arm's work — a zero-arg
+        # thunk defers even setup (build/opt.init allocate on device),
+        # so a wedge during setup is attributed to the right arm in the
+        # collector's kept stdout tail
+        bench.progress(f"breakdown arm: {name}")
+        rows[name] = thunk()
+
     rows = {}
     model, params = build(flash)
     st = opt.init(params)
 
-    rows["full"] = _time_step(make_train_step(ce_loss(model), opt,
-                                              donate=False),
-                              params, st, tokens, steps)
+    arm("full", lambda: _time_step(
+        make_train_step(ce_loss(model), opt, donate=False),
+        params, st, tokens, steps))
 
     @jax.jit
     def fwd_bwd(params, opt_state, toks):
@@ -148,22 +158,27 @@ def run(dim=FLAGSHIP["dim"], n_layers=FLAGSHIP["n_layers"],
         from distributed_pytorch_tpu.parallel.spmd import SpmdStepOutput
         return SpmdStepOutput(params, opt_state, loss + eps * gsum, {})
 
-    rows["no_opt"] = _time_step(fwd_bwd, params, st, tokens, steps)
-    rows["fwd"] = _time_fwd(ce_loss(model), params, tokens, steps)
+    arm("no_opt", lambda: _time_step(fwd_bwd, params, st, tokens, steps))
+    arm("fwd", lambda: _time_fwd(ce_loss(model), params, tokens, steps))
 
-    m2, p2 = build(attn_identity)
-    rows["attn_stub"] = _time_step(
-        make_train_step(ce_loss(m2), opt, donate=False), p2,
-        opt.init(p2), tokens, steps)
+    def attn_stub_arm():
+        m2, p2 = build(attn_identity)
+        return _time_step(make_train_step(ce_loss(m2), opt,
+                                          donate=False),
+                          p2, opt.init(p2), tokens, steps)
+    arm("attn_stub", attn_stub_arm)
 
-    rows["no_head"] = _time_step(
+    arm("no_head", lambda: _time_step(
         make_train_step(headless_loss(model), opt, donate=False),
-        params, st, tokens, steps)
+        params, st, tokens, steps))
 
-    m3, p3 = build(None)  # dense einsum core
-    rows["dense_attn"] = _time_step(
-        make_train_step(ce_loss(m3), opt, donate=False), p3,
-        opt.init(p3), tokens, steps)
+    def dense_arm():
+        m3, p3 = build(None)  # dense einsum core
+        return _time_step(make_train_step(ce_loss(m3), opt,
+                                          donate=False),
+                          p3, opt.init(p3), tokens, steps)
+    arm("dense_attn", dense_arm)
+    bench.progress("breakdown arms done")
 
     full = rows["full"]
     ms = {k: round(v * 1e3, 3) for k, v in rows.items()}
