@@ -99,6 +99,16 @@ def progress(msg: str) -> None:
     print(f"# {msg}", flush=True)
 
 
+def arm(label: str, thunk):
+    """Banner-then-run: announce ``label`` via :func:`progress`, then
+    execute the zero-arg ``thunk`` and return its result. The one shared
+    shape for multi-arm benchmark stages — the banner prints BEFORE any
+    of the arm's work (setup included), so a tunnel wedge anywhere in
+    the arm is attributed to the right label in the kept stdout tail."""
+    progress(label)
+    return thunk()
+
+
 def run_json_subprocess(argv, timeout_s: int, *, label: str,
                         env: dict = None,
                         keep_stdout_tail: bool = False) -> dict:

@@ -169,10 +169,9 @@ def run_gqa_compare(small: bool = False) -> dict:
     import bench
 
     def arm(msg, fn, *a, **k):
-        # per-arm progress (bench.progress contract): a tunnel wedge
-        # mid-arm leaves WHICH arm hung in the collector's stdout tail
-        bench.progress(f"decode arm: {msg}")
-        return fn(*a, **k)
+        # bench.arm contract: a tunnel wedge mid-arm leaves WHICH arm
+        # hung in the collector's kept stdout tail
+        return bench.arm(f"decode arm: {msg}", lambda: fn(*a, **k))
 
     mha = arm("mha", run, **kw)
     gqa = arm("gqa", run, n_kv_heads=n_kv, **kw)

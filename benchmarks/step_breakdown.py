@@ -128,14 +128,14 @@ def run(dim=FLAGSHIP["dim"], n_layers=FLAGSHIP["n_layers"],
     import bench
 
     def arm(name, thunk):
-        # the banner prints BEFORE any of the arm's work — a zero-arg
-        # thunk defers even setup (build/opt.init allocate on device),
-        # so a wedge during setup is attributed to the right arm in the
-        # collector's kept stdout tail
-        bench.progress(f"breakdown arm: {name}")
-        rows[name] = thunk()
+        # bench.arm: banner BEFORE any of the arm's work (setup deferred
+        # into the thunk), so a wedge during build/opt.init is
+        # attributed to the right arm in the collector's stdout tail
+        rows[name] = bench.arm(f"breakdown arm: {name}", thunk)
 
     rows = {}
+    bench.progress("breakdown: building flagship model (first device "
+                   "allocation)")
     model, params = build(flash)
     st = opt.init(params)
 

@@ -279,6 +279,31 @@ def test_roofline_floors_and_measured_wiring():
     assert roofline.measured_step_ms(rows, "bench_mfu") is None
 
 
+def test_mfu_record_schema_contract():
+    """The keys every consumer joins on (collector ok-gate, report
+    tables, roofline measured-join, sweep best-arm pick) — a tiny
+    in-process run must produce them all with sane values."""
+    from benchmarks.mfu_transformer import run
+
+    rec = run(dim=64, n_layers=1, n_heads=2, vocab=128, seq=128,
+              batch=2, steps=2, use_flash=False)
+    for key in ("device", "platform", "config", "n_params",
+                "step_ms_median", "per_step_fetch_fenced_ms_median",
+                "tokens_per_sec", "model_tflops_per_step",
+                "achieved_tflops_per_sec", "mfu", "mfu_hw",
+                "timing_method", "steps_timed"):
+        assert key in rec, key
+    assert rec["step_ms_median"] > 0 and rec["tokens_per_sec"] > 0
+    assert rec["timing_method"] == "amortized_chain_fetch_fence"
+    cfg = rec["config"]
+    for key in ("dim", "batch", "seq", "attention", "remat", "fused_ce",
+                "optimizer"):
+        assert key in cfg, key
+    assert cfg["attention"] == "dense"  # use_flash=False
+    # error-free record: the collector's ok-gate is "error" not in rec
+    assert "error" not in rec
+
+
 def test_attach_roofline_on_headline_record():
     """The headline record carries the analytic floors, and the
     efficiency gap is computed only when a measured step exists."""
