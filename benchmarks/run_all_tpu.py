@@ -112,7 +112,12 @@ def watch_for_backend(interval_s: float, max_hours: float,
     while True:
         n += 1
         t0 = time.time()
-        ok = bench.probe_backend(timeout_s=120)
+        # 45s, not 120: a healthy probe answers in ~6s, and a probe hung
+        # against a wedged tunnel gets SIGKILLed at the timeout — a kill
+        # that lands just AFTER a heal can re-wedge the tunnel (killed
+        # clients wedge it), so the hung-probe window is kept as narrow
+        # as detection reliability allows
+        ok = bench.probe_backend(timeout_s=45)
         stamp = time.strftime("%H:%M:%S")
         print(f"[watch {stamp}] probe {n}: "
               f"{'HEALTHY' if ok else 'down'} ({time.time() - t0:.0f}s)",
