@@ -54,12 +54,18 @@ MAX_BASELINE_SPREAD = 0.10
 # ---------------------------------------------------------------------------
 
 
-def probe_backend(timeout_s: int = 120) -> dict:
+def probe_backend(timeout_s: int = 45) -> dict:
     """Probe JAX backend init in a SUBPROCESS (a wedged tunnel hangs the
     whole process — a timeout around an in-process jax.devices() call
     cannot recover it). Only a real TPU counts as healthy: a CPU
     fallback would silently run the flagship bench on the host (with
-    interpret-mode pallas — hours, and no meaningful MFU)."""
+    interpret-mode pallas — hours, and no meaningful MFU).
+
+    The 45s default is deliberate at every call site: a healthy probe
+    answers in ~6s, and a probe hung against a wedged tunnel gets
+    SIGKILLed at the timeout — a kill landing just after a heal can
+    re-wedge the tunnel (killed clients wedge it), so the hung-probe
+    window is kept as narrow as detection reliability allows."""
     code = ("import jax, json; d = jax.devices()[0]; "
             "print(json.dumps({'platform': d.platform, "
             "'kind': d.device_kind}))")

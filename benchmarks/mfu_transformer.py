@@ -320,7 +320,7 @@ def sweep(arms=None, steps: int = 20,
         rec, err, extra = None, None, {}
         if isolate:
             import bench  # repo root is on sys.path (module preamble)
-            if not bench.probe_backend(timeout_s=90):
+            if not bench.probe_backend():
                 results.append({"arm": arm, "error":
                                 "backend wedged; sweep aborted early"})
                 print(f"# arm {label}: {json.dumps(results[-1])}",

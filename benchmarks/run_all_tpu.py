@@ -104,20 +104,18 @@ def watch_for_backend(interval_s: float, max_hours: float,
     in-process probe would hang with it. Returns True on a healthy
     probe; on expiry appends a watch_expired row so the round's record
     shows the watcher ran and for how long. The budget is approximate:
-    a probe in flight at the deadline may overrun it by up to the 120s
-    probe timeout (immaterial against multi-hour budgets).
+    a probe in flight at the deadline may overrun it by up to the probe
+    timeout (45s — see probe_backend; immaterial against multi-hour
+    budgets).
     """
     deadline = time.time() + max_hours * 3600.0
     n = 0
     while True:
         n += 1
         t0 = time.time()
-        # 45s, not 120: a healthy probe answers in ~6s, and a probe hung
-        # against a wedged tunnel gets SIGKILLed at the timeout — a kill
-        # that lands just AFTER a heal can re-wedge the tunnel (killed
-        # clients wedge it), so the hung-probe window is kept as narrow
-        # as detection reliability allows
-        ok = bench.probe_backend(timeout_s=45)
+        # default 45s timeout: see probe_backend's docstring (narrow
+        # hung-probe window; a kill after a heal can re-wedge the tunnel)
+        ok = bench.probe_backend()
         stamp = time.strftime("%H:%M:%S")
         print(f"[watch {stamp}] probe {n}: "
               f"{'HEALTHY' if ok else 'down'} ({time.time() - t0:.0f}s)",
@@ -295,7 +293,7 @@ def _run(argv):
             for name, cmd, timeout_s, env in stages:
                 if name in done or attempts.get(name, 0) >= MAX_ATTEMPTS:
                     continue
-                if ran_this_pass and not bench.probe_backend(timeout_s=90):
+                if ran_this_pass and not bench.probe_backend():
                     # the tunnel wedged mid-collection: stop this pass
                     # instead of burning each remaining stage's full
                     # timeout against a dead backend (collected stages
