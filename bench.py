@@ -455,7 +455,8 @@ _DP8_CODE = r"""
 import json, time
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from distributed_pytorch_tpu.runtime.jax_compat import ensure_cpu_devices
+ensure_cpu_devices(8)
 import jax.numpy as jnp
 import numpy as np
 import distributed_pytorch_tpu as dist
@@ -507,10 +508,96 @@ print(json.dumps({"steps_per_sec": round(med, 1),
 """
 
 
+# 32 MiB f32 gradient bucket: big enough that the ring is bandwidth-
+# bound even on loopback (real DDP buckets are tens of MB — ResNet-50's
+# full gradient is ~98 MB), which is the regime the quantized wire is
+# for; at a few MiB the 8-process mesh is scheduling-latency-bound and
+# wire width barely matters. Median-of-5 runs: the mesh shares a small
+# contended host, single runs swing 2x.
+COMM_BUCKET_ELEMS = 1 << 23
+COMM_WORLD = 8
+COMM_REPS = 6
+
+
+def _dp8_comm_worker(rank, world, q, n_elems, reps, runs):
+    """Host-ring comm microbench worker: the same flat gradient bucket
+    allreduced over the native TCP ring, f32 wire vs quantized (block
+    int8) wire. Barrier-fenced so every timed window measures all
+    ranks' slowest path; rank 0 reports."""
+    import numpy as np
+
+    import distributed_pytorch_tpu as dist
+    from distributed_pytorch_tpu.runtime import context
+
+    dist.init_process_group(rank, world)
+    comm = context.get_host_comm()
+    try:
+        rng = np.random.default_rng(rank)
+        x = rng.standard_normal(n_elems).astype(np.float32)
+
+        def timed(op):
+            samples = []
+            for _ in range(runs):
+                comm.barrier()
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    op(x.copy())
+                comm.barrier()
+                samples.append(reps / (time.perf_counter() - t0))
+            samples.sort()
+            return samples[len(samples) // 2], samples
+
+        # one untimed warm rep each (socket buffers, allocator)
+        comm.allreduce(x.copy())
+        comm.allreduce_q8(x.copy())
+        f32_sps, f32_runs = timed(comm.allreduce)
+        q_sps, q_runs = timed(comm.allreduce_q8)
+        if rank == 0:
+            from distributed_pytorch_tpu.comm import wire
+            q.put({
+                "comm_world": world,
+                "comm_bucket_mb": round(n_elems * 4 / (1 << 20), 2),
+                # per-rank wire payload of ONE allreduce of the bucket
+                "comm_bytes": wire.quant_ring_allreduce_wire_bytes(
+                    n_elems, world) // world,
+                "comm_f32_bytes": wire.ring_allreduce_wire_bytes(
+                    n_elems, world) // world,
+                "comm_quant_steps_per_sec": round(q_sps, 2),
+                "comm_f32_steps_per_sec": round(f32_sps, 2),
+                "comm_runs": {"f32": [round(r, 2) for r in f32_runs],
+                              "quant": [round(r, 2) for r in q_runs]},
+            })
+    finally:
+        dist.cleanup()
+
+
+def bench_dp8_comm() -> dict:
+    """8-process native-ring gradient-bucket allreduce: f32 vs quantized
+    wire, reported into the dp8 record (comm_bytes /
+    comm_quant_steps_per_sec acceptance fields)."""
+    import multiprocessing as mp
+
+    from distributed_pytorch_tpu.runtime.multiprocess import (
+        launch_multiprocess)
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    launch_multiprocess(_dp8_comm_worker, COMM_WORLD, q,
+                        COMM_BUCKET_ELEMS, COMM_REPS, 5)
+    return q.get(timeout=60)
+
+
 def bench_dp8() -> dict:
-    return run_json_subprocess(
+    rec = run_json_subprocess(
         [sys.executable, "-c", _DP8_CODE], 600, label="dp8 bench",
         env={"JAX_PLATFORMS": "cpu", "DPX_CPU_DEVICES": "8"})
+    comm = run_json_subprocess(
+        [sys.executable, os.path.abspath(__file__), "--stage", "dp8_comm"],
+        600, label="dp8 comm bench", env={"JAX_PLATFORMS": "cpu"})
+    if "error" in comm:
+        rec["comm_error"] = comm["error"]
+    rec.update({k: v for k, v in comm.items() if k.startswith("comm_")})
+    return rec
 
 
 # ---------------------------------------------------------------------------
@@ -528,6 +615,8 @@ def _stage_main(stage: str) -> int:
         print(json.dumps(mfu_run(steps=20, **MEDIUM)))
     elif stage == "min_ddp":
         print(json.dumps(bench_min_ddp()))
+    elif stage == "dp8_comm":
+        print(json.dumps(bench_dp8_comm()))
     elif stage == "decode":
         from benchmarks.decode_tpu import run_gqa_compare
         print(json.dumps(run_gqa_compare()))

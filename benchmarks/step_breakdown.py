@@ -203,7 +203,79 @@ def run(dim=FLAGSHIP["dim"], n_layers=FLAGSHIP["n_layers"],
             "mfu_full": round(fl / rows["full"] / peak, 4) if peak else None}
 
 
+def run_comm(world=8, hidden=1024, in_dim=256, batch_per_rank=8,
+             steps=30) -> dict:
+    """Gradient-reduce comm breakdown on the virtual CPU mesh: the same
+    DP step with ``grad_reduce="mean"`` (exact f32 pmean) vs ``"quant"``
+    (block-int8 bucket), plus per-step wire-byte accounting from
+    ``comm/primitives``. The quantized-vs-f32 comm cost of the tentpole
+    quantized collective layer, measured as REAL compiled steps (XLA
+    fusion effects stay in). Per-step comm seconds = step-time delta vs
+    a world-1 compute-only step on the same per-rank batch.
+
+    Run with ``--comm`` (forces JAX_PLATFORMS=cpu + an 8-device virtual
+    mesh, so it works on any host); invoke in a fresh process — the
+    platform switch must precede backend init.
+    """
+    import numpy as np
+
+    from distributed_pytorch_tpu.runtime.jax_compat import ensure_cpu_devices
+
+    jax.config.update("jax_platforms", "cpu")
+    ensure_cpu_devices(world)
+    os.environ.setdefault("DPX_CPU_DEVICES", str(world))
+
+    import distributed_pytorch_tpu as dist
+    from distributed_pytorch_tpu import models, optim
+    from distributed_pytorch_tpu.comm import primitives as prim
+    from distributed_pytorch_tpu.ops.losses import cross_entropy
+    from distributed_pytorch_tpu.parallel import make_train_step
+
+    model = models.DummyModel(in_dim=in_dim, hidden_dim=hidden,
+                              n_classes=16)
+    opt = optim.adamw(1e-4)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return cross_entropy(model.apply(p, x), y), {}
+
+    def arm(world_size, grad_reduce):
+        dist.cleanup()
+        dist.init_process_group(rank=0, world_size=world_size)
+        params = model.init(jax.random.PRNGKey(0))
+        gb = batch_per_rank * world_size
+        x = dist.shard_batch(np.random.default_rng(0).standard_normal(
+            (gb, in_dim)).astype(np.float32))
+        y = dist.shard_batch((np.arange(gb) % 16).astype(np.int32))
+        step = make_train_step(loss_fn, opt, donate=False,
+                               grad_reduce=grad_reduce)
+        return _time_step(step, params, opt.init(params), (x, y), steps)
+
+    n_grad = sum(x.size for x in jax.tree_util.tree_leaves(
+        model.init(jax.random.PRNGKey(0))))
+    base_s = arm(1, "mean")          # compute-only floor (no dp axis)
+    mean_s = arm(world, "mean")
+    quant_s = arm(world, "quant")
+    dist.cleanup()
+    f32_bytes = prim.ring_allreduce_wire_bytes(n_grad, world)
+    return {
+        "world": world,
+        "grad_elems": n_grad,
+        "step_ms": {"world1": round(base_s * 1e3, 3),
+                    "mean": round(mean_s * 1e3, 3),
+                    "quant": round(quant_s * 1e3, 3)},
+        "comm_ms": {"mean": round((mean_s - base_s) * 1e3, 3),
+                    "quant": round((quant_s - base_s) * 1e3, 3)},
+        "wire_bytes_per_step": {
+            "mean_f32": f32_bytes,
+            "quant": prim.quantized_pmean_wire_bytes(n_grad, world)},
+    }
+
+
 def main(argv):
+    if "--comm" in argv:
+        print(json.dumps(run_comm(steps=_flag(argv, "--steps", 30))))
+        return 0
     rec = run(batch=_flag(argv, "--batch", FLAGSHIP["batch"]),
               seq=_flag(argv, "--seq", FLAGSHIP["seq"]),
               steps=_flag(argv, "--steps", 20))

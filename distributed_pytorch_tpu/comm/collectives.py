@@ -77,17 +77,24 @@ def _reduce_stacked(x: jnp.ndarray, op: str) -> jnp.ndarray:
     raise ValueError(f'"{op}" is an invalid reduce operation!')
 
 
-def all_reduce(tensor, op: str = "sum"):
+def all_reduce(tensor, op: str = "sum", wire: str = "exact"):
     """All-reduce over the rank axis (reference ``distributed.py:119-133``).
 
     world==1: identity. world>1: ``tensor`` is stacked ``(world, *S)``; the
     result is stacked with every row equal to the reduction. Invalid ``op``
     raises ``ValueError`` like the reference (``distributed.py:131``); as
     there, validation happens only on the distributed path.
+
+    ``wire="quant"`` opts the HOST front door's sum/avg into the
+    block-int8 ring (:mod:`.wire`, ~4x less TCP traffic, lossy). The
+    single-controller path has no wire to compress — XLA moves exact
+    bytes over ICI — so it ignores the hint and stays exact (the flag is
+    accepted for cross-front-door call-site parity).
     """
     comm = context.get_host_comm()
     if comm is not None:
-        return host_backend.all_reduce(comm, tensor, op)
+        return host_backend.all_reduce(comm, tensor, op, wire=wire)
+    host_backend._check_wire(wire)
     if context.get_world_size() == 1:
         return tensor
     x = _check_stacked(jnp.asarray(tensor), "all_reduce")
@@ -164,7 +171,7 @@ def broadcast(tensor, src: int = 0):
     return jnp.broadcast_to(x[src][None], x.shape)
 
 
-def sync_params(params: Sequence):
+def sync_params(params: Sequence, wire: str = "exact"):
     """Synchronize a sequence of tensors from rank 0 (reference
     ``distributed.py:163-170``).
 
@@ -172,10 +179,16 @@ def sync_params(params: Sequence):
     devices, so this re-asserts replicated placement (a no-op when already
     replicated) rather than moving bytes. It exists for the reference's
     stated use case — non-DDP/EMA params after load — where the input may be
-    host or per-device data."""
+    host or per-device data.
+
+    ``wire="quant"``: on the host front door rank 0's floats broadcast in
+    the block-int8 format (every rank, rank 0 included, adopts the
+    dequantized value — still bit-identical everywhere). Ignored on the
+    single-controller path, which moves no bytes to begin with."""
     comm = context.get_host_comm()
     if comm is not None:
-        return host_backend.sync_params(comm, params)
+        return host_backend.sync_params(comm, params, wire=wire)
+    host_backend._check_wire(wire)
     if not context.is_initialized():
         return list(params)
     return [jax.device_put(p, context.replicated_sharding()) for p in params]

@@ -13,6 +13,14 @@ The transport is native ring-allreduce / hub rooted ops
 All functions take/return numpy arrays (host-resident data; accelerator
 arrays are converted in, which is exactly what torch's gloo path does with
 CPU staging).
+
+Wire formats: every collective defaults to the exact full-width wire.
+``all_reduce``/``sync_params`` additionally accept ``wire="quant"`` — the
+block-scaled int8 format of :mod:`.wire` (~4x less TCP traffic, lossy,
+bit-identical across ranks). The REFERENCE-EXACT contracts are never
+quantized: ``reduce`` (non-root buffers untouched) and ``gather``
+(zeros-on-non-primary) always move exact full-width bytes, as does any
+integer payload (f64 ring keeps integer sums exact).
 """
 
 from __future__ import annotations
@@ -21,22 +29,49 @@ from typing import List, Sequence
 
 import numpy as np
 
+from . import wire as _wire
+
+#: Wire formats a lossy-tolerant collective accepts.
+WIRE_FORMATS = ("exact", "quant")
+
+
+def _check_wire(wire: str) -> str:
+    if wire not in WIRE_FORMATS:
+        raise ValueError(f"wire must be one of {WIRE_FORMATS}, got {wire!r}")
+    return wire
+
 
 def _to_np(tensor) -> np.ndarray:
     return np.asarray(tensor)
 
 
-def all_reduce(comm, tensor, op: str = "sum"):
+def all_reduce(comm, tensor, op: str = "sum", wire: str = "exact"):
     """Reference distributed.py:119-133: sum or sum/world, in every rank.
-    (max/min supported too, matching the SPMD front door's extension.)"""
+    (max/min supported too, matching the SPMD front door's extension.)
+
+    ``wire="quant"`` ships sum/avg over the chunk-pipelined int8 ring
+    (:meth:`..runtime.native.HostComm.allreduce_q8`) — opt-in and only
+    where lossy is safe: float data under sum/avg. max/min and integer
+    payloads always use the exact ring (an int8 max would corrupt the
+    winner's exact value; integers must sum exactly)."""
     x = _to_np(tensor)
     if op not in ("sum", "avg", "max", "min"):
         raise ValueError(f'"{op}" is an invalid reduce operation!')
+    _check_wire(wire)
     orig_dtype = x.dtype
     if op in ("max", "min"):
-        stacked = comm.all_gather(np.ascontiguousarray(x))
-        return (stacked.max(axis=0) if op == "max"
-                else stacked.min(axis=0))
+        # elementwise ring reduce — same 2*(W-1)/W bytes as sum (the old
+        # emulation all-gathered the full tensor from every rank)
+        if x.dtype == np.float32:
+            return comm.allreduce(x.copy(), op=op)
+        work = comm.allreduce(x.astype(np.float64), op=op)
+        return work.astype(orig_dtype) if x.dtype != np.float64 else work
+    if (wire == "quant" and x.dtype.kind not in "iub"
+            and comm.world > 1):
+        work = comm.allreduce_q8(x.astype(np.float32, copy=True))
+        if op == "avg":
+            work = work / comm.world
+        return work.astype(orig_dtype) if orig_dtype != np.float32 else work
     work = x.astype(np.float64) if x.dtype.kind in "iub" else x.copy()
     comm.allreduce(work)
     if op == "avg":
@@ -83,9 +118,40 @@ def broadcast(comm, tensor, src: int = 0):
     return comm.broadcast(x, src=src)
 
 
-def sync_params(comm, params: Sequence) -> list:
-    """Reference distributed.py:163-170: broadcast each tensor from 0."""
-    return [comm.broadcast(_to_np(p).copy(), src=0) for p in params]
+def sync_params(comm, params: Sequence, wire: str = "exact") -> list:
+    """Reference distributed.py:163-170: broadcast each tensor from 0.
+
+    ``wire="quant"``: rank 0 block-quantizes each FLOAT32 tensor
+    (:mod:`.wire` format) and broadcasts the int8+scales frame instead of
+    full-width bytes (~4x less traffic for big param syncs). EVERY rank —
+    rank 0 included — adopts the dequantized value, so params stay
+    bit-identical across ranks (the only guarantee sync_params makes;
+    the absolute values move by at most one quantization step). All
+    other dtypes (integers, f16, f64) always broadcast exact."""
+    _check_wire(wire)
+    out = []
+    for p in params:
+        x = _to_np(p)
+        # quantize f32 only: f64 would silently lose precision through
+        # the f32 cast beyond the one-step bound, and f16 is already
+        # half-width — both broadcast exact, as do integers
+        if wire == "quant" and x.dtype == np.float32 and comm.world > 1:
+            n = x.size
+            nb = _wire.num_blocks(n)
+            frame = np.empty(_wire.quant_wire_bytes(n), np.uint8)
+            if comm.rank == 0:
+                q, scales = _wire.quantize_blocks(
+                    x.astype(np.float32).ravel())
+                frame[:4 * nb] = scales.view(np.uint8)
+                frame[4 * nb:] = q.view(np.uint8)
+            comm.broadcast(frame, src=0)
+            scales = frame[:4 * nb].view(np.float32)
+            q = frame[4 * nb:].view(np.int8)
+            out.append(_wire.dequantize_blocks(q, scales)
+                       .reshape(x.shape).astype(x.dtype))
+        else:
+            out.append(comm.broadcast(x.copy(), src=0))
+    return out
 
 
 def barrier(comm):

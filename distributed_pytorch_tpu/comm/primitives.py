@@ -84,10 +84,25 @@ def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int, tiled: bool
                           concat_axis=concat_axis, tiled=tiled)
 
 
-# default quantization-block width for quantized_pmean — exported so
-# bucketing callers (parallel/data_parallel._reduce_grads) can pad each
-# leaf to a block multiple and keep scale blocks from spanning leaves
-QUANT_BLOCK = 1024
+# quantization-block width shared with the host front door's wire format
+# (comm/wire.py is the single source of truth) — exported so bucketing
+# callers (parallel/data_parallel._reduce_grads) can pad each leaf to a
+# block multiple and keep scale blocks from spanning leaves
+from .wire import (QUANT_BLOCK, quant_ring_allreduce_wire_bytes,  # noqa: E402,F401
+                   quant_wire_bytes, ring_allreduce_wire_bytes)
+
+
+def quantized_pmean_wire_bytes(n: int, world: int,
+                               block: int = QUANT_BLOCK) -> int:
+    """Total wire bytes (all devices, both legs) of one
+    :func:`quantized_pmean` over an n-element bucket: in each leg
+    (all-to-all, then all-gather) every device ships world-1 quantized
+    chunks of the zero-padded bucket's 1/world rows."""
+    if world <= 1:
+        return 0
+    padded = n + ((-n) % (world * block))
+    chunk = quant_wire_bytes(padded // world, block)
+    return 2 * world * (world - 1) * chunk
 
 
 def quantized_pmean(x, axis_name: str, *, block: int = QUANT_BLOCK):
@@ -122,21 +137,21 @@ def quantized_pmean(x, axis_name: str, *, block: int = QUANT_BLOCK):
         flat = jnp.pad(flat, (0, pad))
     nb = flat.shape[0] // (n * block)
 
-    def quant(v):                       # (..., nb, block) -> q, scales
-        amax = jnp.max(jnp.abs(v), axis=-1, keepdims=True)
-        scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
-        return jnp.round(v / scale).astype(jnp.int8), scale
+    # the shared block codec (ops/quant.py == comm/wire.py rule: clip to
+    # [-127,127] — round(amax/scale) can land on 128 and wrap int8 —
+    # plus the integer-exact snap for small integer payloads)
+    from ..ops.quant import dequantize_grad_blocks, quantize_grad_blocks
 
-    q, scale = quant(flat.reshape(n, nb, block))
+    q, scale = quantize_grad_blocks(flat.reshape(n, nb, block))
     # row i of the result = device i's row <my_index>: every device
     # ends up holding all n quantized versions of ITS chunk
     rq = all_to_all(q, axis_name, split_axis=0, concat_axis=0)
     rs = all_to_all(scale, axis_name, split_axis=0, concat_axis=0)
-    partial = jnp.sum(rq.astype(jnp.float32) * rs, axis=0) / n  # (nb, blk)
-    q2, scale2 = quant(partial)
+    partial = jnp.sum(dequantize_grad_blocks(rq, rs), axis=0) / n  # (nb,blk)
+    q2, scale2 = quantize_grad_blocks(partial)
     gq = all_gather(q2[None], axis_name, axis=0, tiled=True)
     gs = all_gather(scale2[None], axis_name, axis=0, tiled=True)
-    out = (gq.astype(jnp.float32) * gs).ravel()
+    out = dequantize_grad_blocks(gq, gs).ravel()
     if pad:
         out = out[:size]
     return out.reshape(shape).astype(dtype)

@@ -1,0 +1,257 @@
+"""Quantized collective wire format — the single source of truth.
+
+Both comm front doors speak this format when a caller opts into
+``wire="quant"`` / ``grad_reduce="quant"``:
+
+* the native TCP ring (``native/dpxhost.cpp:dpx_allreduce_q8``) encodes
+  and decodes it in C++ on the host-process front door, and
+* the SPMD front door's :func:`..comm.primitives.quantized_pmean` uses
+  the same block rule in jnp (via :mod:`..ops.quant`).
+
+**Block codec** (EQuARX-style, arxiv 2506.17615): the flat f32 payload is
+cut into blocks of :data:`QUANT_BLOCK` elements (last block ragged). Per
+block: ``amax = max|v|``; ``scale = 1`` if ``amax == 0``; ``scale = 1``
+if every value is an integer with ``amax <= 127`` (small-magnitude
+integer payloads — step counters, one-hot count buckets — transfer
+EXACTLY); else ``scale = amax/127``. ``q = clip(rint(v * (127/amax)),
+-127, 127)`` as int8 (quantization multiplies by the f32 inverse — the
+vectorizable form all three implementations share). One f32 scale per
+block keeps LOCAL dynamic range: a tiny layernorm grad never shares a
+scale with an embedding grad.
+
+**Chunk framing**: a contiguous run of blocks is framed as
+``[f32 scales x nblocks][int8 q x nelems]`` — scatter-gather friendly
+(two plain memcpys each side, no per-chunk header; both peers derive
+every length from ``(n, block, chunk_blocks, step)``).
+
+**Ring schedule** (:func:`simulate_quant_ring` is the executable spec;
+the C++ implements it chunk-pipelined): reduce-scatter leg — each hop
+quantizes the f32 partial of the outgoing segment, the receiver
+dequantize-accumulates in f32; all-gather leg — the segment owner
+quantizes its reduced segment ONCE, replaces its own copy with the
+dequantized value, and the quantized bytes are forwarded UNCHANGED
+around the ring, so every rank decodes identical bytes and the result
+is bit-identical on all ranks.
+
+Everything here is numpy-only (no jax import): the torch front door and
+spawned rank workers use it without touching an XLA backend, and the
+numpy sim is bit-exact against the C++ (same IEEE f32 ops in the same
+order), which the native parity test leans on.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+#: Quantization-block width (elements per f32 scale). Exported through
+#: :mod:`.primitives` for bucketing callers.
+QUANT_BLOCK = 1024
+
+#: Blocks per wire chunk on the native ring (256 KiB of int8 payload at
+#: the default block): small enough that peers' compute phases overlap
+#: in-flight socket transfer, large enough that the extra lockstep
+#: rounds don't dominate on small oversubscribed hosts (measured: on a
+#: 2-core/8-rank loopback mesh, 64 KiB chunks cost ~25% of the ring's
+#: throughput in pure scheduling; 256 KiB recovers it while still
+#: splitting every >256 KiB segment for overlap).
+QUANT_CHUNK_BLOCKS = 256
+
+SCALE_BYTES = 4  # one f32 scale per block
+
+
+# ---------------------------------------------------------------------------
+# block codec (numpy reference; C++ and jnp mirror it)
+# ---------------------------------------------------------------------------
+
+
+def _block_codec(x: np.ndarray,
+                 block: int = QUANT_BLOCK) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-block (dequant scales, quant inverses) for a flat f32 array.
+
+    Quantization MULTIPLIES by the f32 inverse ``127/amax`` rather than
+    dividing by ``amax/127`` — the native codec does the same (a
+    vectorized multiply), and grids must agree bit for bit. Fully
+    vectorized: this runs per training step on the error-feedback path,
+    so a per-block Python loop would sit on the hot path the quantized
+    ring exists to speed up (zero-padding the ragged tail changes
+    neither amax nor the all-integer test)."""
+    x = np.ascontiguousarray(x, dtype=np.float32).ravel()
+    nb = num_blocks(x.size, block)
+    pad = nb * block - x.size
+    v = (np.pad(x, (0, pad)) if pad else x).reshape(nb, block)
+    amax = np.abs(v).max(axis=1)
+    # integer-exact snap: small-magnitude integer payloads round-trip
+    # exactly (scale 1, |q| <= 127)
+    unit = (amax == 0.0) | ((amax <= 127.0)
+                            & (v == np.rint(v)).all(axis=1))
+    safe = np.where(unit, np.float32(1.0), amax)  # no 0-div warnings
+    one = np.float32(1.0)
+    scales = np.where(unit, one, safe / np.float32(127.0))
+    invs = np.where(unit, one, np.float32(127.0) / safe)
+    return scales.astype(np.float32), invs.astype(np.float32)
+
+
+def block_scales(x: np.ndarray, block: int = QUANT_BLOCK) -> np.ndarray:
+    """Per-block dequantization scales for a flat f32 array."""
+    return _block_codec(x, block)[0]
+
+
+def quantize_blocks(x: np.ndarray,
+                    block: int = QUANT_BLOCK) -> Tuple[np.ndarray, np.ndarray]:
+    """Flat f32 -> (int8 q of same length, f32 scales per block)."""
+    x = np.ascontiguousarray(x, dtype=np.float32).ravel()
+    scales, invs = _block_codec(x, block)
+    per_elem = np.repeat(invs, block)[:x.size]
+    q = np.clip(np.rint(x * per_elem), -127, 127).astype(np.int8)
+    return q, scales
+
+
+def dequantize_blocks(q: np.ndarray, scales: np.ndarray,
+                      block: int = QUANT_BLOCK) -> np.ndarray:
+    """(int8 q, f32 scales) -> f32 values (``q * scale`` per block)."""
+    per_elem = np.repeat(scales.astype(np.float32), block)[:q.size]
+    return q.astype(np.float32) * per_elem
+
+
+# ---------------------------------------------------------------------------
+# segment grid: how the ring splits n elements across world ranks
+# ---------------------------------------------------------------------------
+
+
+def num_blocks(n: int, block: int = QUANT_BLOCK) -> int:
+    return (n + block - 1) // block
+
+
+def segment_blocks(n: int, world: int,
+                   block: int = QUANT_BLOCK) -> List[Tuple[int, int]]:
+    """Block-aligned ring segments: ``[(start_block, n_blocks)] * world``.
+
+    Blocks are distributed as evenly as possible (first ``rem`` segments
+    get one extra); a segment never splits a block, so no quantization
+    scale ever spans two ranks' segments.
+    """
+    nb = num_blocks(n, block)
+    base, rem = divmod(nb, world)
+    out, start = [], 0
+    for s in range(world):
+        cnt = base + (1 if s < rem else 0)
+        out.append((start, cnt))
+        start += cnt
+    return out
+
+
+def block_span_elems(start_block: int, nblocks: int, n: int,
+                     block: int = QUANT_BLOCK) -> Tuple[int, int]:
+    """(element offset, element count) covered by a run of blocks."""
+    lo = start_block * block
+    hi = min((start_block + nblocks) * block, n)
+    return lo, max(hi - lo, 0)
+
+
+def span_wire_bytes(start_block: int, nblocks: int, n: int,
+                    block: int = QUANT_BLOCK) -> int:
+    """Wire bytes of a framed run of blocks: scales + int8 payload."""
+    _, elems = block_span_elems(start_block, nblocks, n, block)
+    return SCALE_BYTES * nblocks + elems
+
+
+# ---------------------------------------------------------------------------
+# byte accounting (what the bench and tests assert on)
+# ---------------------------------------------------------------------------
+
+
+def quant_wire_bytes(n: int, block: int = QUANT_BLOCK) -> int:
+    """Bytes for ONE quantized copy of an n-element payload."""
+    return n + SCALE_BYTES * num_blocks(n, block)
+
+
+def ring_allreduce_wire_bytes(n: int, world: int, elem_size: int = 4) -> int:
+    """Total wire bytes (all ranks, both legs) of the full-width ring
+    all-reduce (``native/dpxhost.cpp:ring_allreduce``): 2*(world-1) hops
+    per segment, segments of ceil(n/world) elements (last ragged)."""
+    if world <= 1:
+        return 0
+    chunk = (n + world - 1) // world
+    total_seg_elems = 0
+    for s in range(world):
+        lo = chunk * s
+        total_seg_elems += max(min(lo + chunk, n) - lo, 0)
+    return 2 * (world - 1) * total_seg_elems * elem_size
+
+
+def quant_ring_allreduce_wire_bytes(n: int, world: int,
+                                    block: int = QUANT_BLOCK) -> int:
+    """Total wire bytes (all ranks, both legs) of the quantized ring
+    (``dpx_allreduce_q8``): each segment travels world-1 hops per leg in
+    framed int8+scales form."""
+    if world <= 1:
+        return 0
+    total = 0
+    for start, cnt in segment_blocks(n, world, block):
+        total += 2 * (world - 1) * span_wire_bytes(start, cnt, n, block)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# executable spec: the quantized ring, simulated in numpy
+# ---------------------------------------------------------------------------
+
+
+def simulate_quant_ring(per_rank: Sequence[np.ndarray],
+                        block: int = QUANT_BLOCK
+                        ) -> Tuple[List[np.ndarray], int]:
+    """Run the quantized ring schedule on in-memory "ranks".
+
+    ``per_rank``: one equal-shape array per rank. Returns ``(results,
+    wire_bytes)`` where ``results[r]`` is rank r's reduced SUM (callers
+    divide by world for a mean) and ``wire_bytes`` is the total bytes
+    that would cross the wire. The arithmetic (op kind and order) is
+    bit-identical to ``dpx_allreduce_q8``, so this doubles as the parity
+    oracle for the native path — and all results are bit-identical
+    across ranks by construction of the byte-forwarding all-gather leg.
+    """
+    w = len(per_rank)
+    shape = per_rank[0].shape
+    data = [np.ascontiguousarray(x, dtype=np.float32).ravel().copy()
+            for x in per_rank]
+    n = data[0].size
+    if w == 1:
+        return [data[0].reshape(shape)], 0
+    segs = segment_blocks(n, w, block)
+    bytes_moved = 0
+
+    def span(seg):
+        lo, cnt = block_span_elems(segs[seg][0], segs[seg][1], n, block)
+        return slice(lo, lo + cnt)
+
+    # reduce-scatter: quantize the outgoing f32 partial each hop,
+    # receiver dequantize-accumulates (all sends of a step happen "at
+    # once": quantize from the pre-step snapshot, like the real ring)
+    for step in range(w - 1):
+        sends = {}
+        for r in range(w):
+            send_seg = (r - step) % w
+            q, s = quantize_blocks(data[r][span(send_seg)], block)
+            sends[r] = (q, s)
+            bytes_moved += q.size + SCALE_BYTES * s.size
+        for r in range(w):
+            recv_seg = (r - step - 1) % w
+            q, s = sends[(r - 1) % w]
+            data[r][span(recv_seg)] += dequantize_blocks(q, s, block)
+
+    # all-gather: owner quantizes once; bytes forwarded unchanged
+    wires = {}
+    for r in range(w):
+        own = (r + 1) % w
+        q, s = quantize_blocks(data[r][span(own)], block)
+        wires[own] = (q, s)
+        data[r][span(own)] = dequantize_blocks(q, s, block)
+    for step in range(w - 1):
+        for r in range(w):
+            recv_seg = (r - step) % w
+            q, s = wires[recv_seg]
+            data[r][span(recv_seg)] = dequantize_blocks(q, s, block)
+            bytes_moved += q.size + SCALE_BYTES * s.size
+    return [d.reshape(shape) for d in data], bytes_moved

@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..runtime.jax_compat import tpu_compiler_params as _compiler_params
+
 # Large-negative mask value instead of -inf: -inf - (-inf) = NaN would
 # poison the online-softmax rescaling for fully-masked tiles.
 _MASK = -0.7 * float(jnp.finfo(jnp.float32).max)
@@ -292,7 +294,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
             pltpu.VMEM((bq, _LANES), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL),
+        compiler_params=_compiler_params(dimension_semantics=_PARALLEL),
         interpret=_interpret_default(interpret),
     )(q3, k3, v3)
     o = o3[:, :s_q].reshape(b, h, s_q, d)
@@ -464,7 +466,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
                    jax.ShapeDtypeStruct((b * h, sk_p, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL),
+        compiler_params=_compiler_params(dimension_semantics=_PARALLEL),
         interpret=interp,
     )(q3, k3, v3, g3, lse3, delta3)
     dk3, dv3 = dkv
@@ -485,7 +487,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
         out_specs=q_spec2,
         out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL),
+        compiler_params=_compiler_params(dimension_semantics=_PARALLEL),
         interpret=interp,
     )(q3, k3, v3, g3, lse3, delta3)
 

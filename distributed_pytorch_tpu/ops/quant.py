@@ -1,4 +1,4 @@
-"""Weight-only int8 quantization for inference.
+"""int8 quantization: weight-only inference tables AND gradient wire codec.
 
 Single-token decode streams every parameter once per token — it is
 bandwidth-bound, not FLOP-bound (benchmarks/decode_tpu.py) — so halving
@@ -16,6 +16,15 @@ becomes ``{"w_q": int8, "w_scale": f32}`` and ``nn.core`` consumes
 either form — every model/call-site works unchanged on a quantized
 tree. The reference has no inference path at all, let alone a quantized
 one (SURVEY.md §5).
+
+The GRADIENT side (:func:`quantize_grad_blocks` /
+:func:`dequantize_grad_blocks` / :class:`ErrorFeedback`) is the jnp
+face of the collective wire codec defined in
+:mod:`..comm.wire` — symmetric per-block int8 with the integer-exact
+snap — used by :func:`..comm.primitives.quantized_pmean` inside the
+compiled step and by the host-backend quantized ring's error-feedback
+pre-compensation. Same block rule everywhere, so the two comm front
+doors quantize identically.
 """
 
 from __future__ import annotations
@@ -109,3 +118,77 @@ def quantized_bytes(params: Any) -> int:
     decode streams per token."""
     return sum(x.size * x.dtype.itemsize
                for x in jax.tree_util.tree_leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# gradient wire codec (jnp face of comm/wire.py's block format)
+# ---------------------------------------------------------------------------
+
+
+def quantize_grad_blocks(v: jnp.ndarray):
+    """Symmetric per-block int8 gradient quantizer.
+
+    ``v``: f32 ``(..., block)`` — the trailing axis is one quantization
+    block. Per block: ``scale = amax/127`` with two snaps matching
+    ``comm/wire.py``: all-zero blocks get scale 1 (exact zeros), and
+    blocks of INTEGERS with ``amax <= 127`` get scale 1 (small-magnitude
+    integer payloads — counters, token tallies — transfer exactly).
+    Returns ``(q int8, scale f32 (..., 1))``.
+    """
+    v = v.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(v), axis=-1, keepdims=True)
+    int_exact = jnp.logical_and(
+        amax <= 127.0,
+        jnp.all(v == jnp.round(v), axis=-1, keepdims=True))
+    unit = jnp.logical_or(amax == 0.0, int_exact)
+    scale = jnp.where(unit, jnp.float32(1.0), amax / jnp.float32(127.0))
+    # quantize by the f32 INVERSE (multiply) — same grid as the native
+    # codec and comm/wire.py, which vectorize the multiply
+    inv = jnp.where(unit, jnp.float32(1.0), jnp.float32(127.0) / amax)
+    q = jnp.clip(jnp.round(v * inv), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_grad_blocks(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`quantize_grad_blocks` (f32 output)."""
+    return q.astype(jnp.float32) * scale
+
+
+class ErrorFeedback:
+    """Error-feedback residual for repeated lossy gradient reduction.
+
+    The classic compressed-SGD correction (1-bit SGD / EF-SGD): the
+    quantization error of step t is carried into step t+1's input, so
+    the TIME-AVERAGE of what crosses the wire converges to the true
+    gradient instead of accumulating bias — systematic rounding (e.g. a
+    tiny gradient always rounding to zero under a big block-mate's
+    scale) is recovered on later steps.
+
+        ef = ErrorFeedback()
+        compensated = ef.compensate(flat_grads)   # quantization-aware
+        ... lossy all-reduce of `compensated` ...
+
+    ``compensate`` adds the carried residual, rounds the result onto the
+    int8 grid it will be transmitted on (so the FIRST wire hop is
+    exact), and stores the new residual. Host-resident (numpy) state —
+    this wraps the eager per-rank-process reduce path, not the compiled
+    SPMD step.
+    """
+
+    def __init__(self, block: int = None):
+        from ..comm import wire
+        self._wire = wire
+        self.block = block or wire.QUANT_BLOCK
+        self.residual = None
+
+    def compensate(self, flat):
+        import numpy as np
+
+        flat = np.ascontiguousarray(flat, dtype=np.float32).ravel()
+        if self.residual is None or self.residual.size != flat.size:
+            self.residual = np.zeros(flat.size, np.float32)
+        e = flat + self.residual
+        q, s = self._wire.quantize_blocks(e, self.block)
+        grid = self._wire.dequantize_blocks(q, s, self.block)
+        self.residual = e - grid
+        return grid

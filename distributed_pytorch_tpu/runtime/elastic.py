@@ -59,7 +59,8 @@ def _child_bootstrap(target, args, child_env):
         jax.config.update("jax_platforms", plat)
         n = os.environ.get("DPX_CPU_DEVICES")
         if plat == "cpu" and n:
-            jax.config.update("jax_num_cpu_devices", int(n))
+            from .jax_compat import ensure_cpu_devices
+            ensure_cpu_devices(int(n))
     target(*args)
 
 

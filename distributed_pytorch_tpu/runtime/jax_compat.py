@@ -1,0 +1,86 @@
+"""Version portability for the few JAX APIs that moved between releases.
+
+The framework targets current JAX (``jax.shard_map`` with ``check_vma``,
+the ``jax_num_cpu_devices`` config) but must also run on the 0.4.x line
+this container ships, where ``shard_map`` lives in
+``jax.experimental.shard_map`` (with ``check_rep`` instead of
+``check_vma``) and the virtual CPU device count is an XLA flag. Every
+call site imports from here instead of feature-testing locally.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+
+import jax
+
+__all__ = ["shard_map", "ensure_cpu_devices", "tpu_compiler_params"]
+
+
+def tpu_compiler_params(**kw):
+    """Pallas-TPU compiler params across the rename: current JAX calls
+    the dataclass ``pltpu.CompilerParams``; 0.4.x named it
+    ``pltpu.TPUCompilerParams``."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_SHARD_MAP_PARAMS = None
+
+
+def _shard_map_params():
+    global _SHARD_MAP_PARAMS
+    if _SHARD_MAP_PARAMS is None:
+        try:
+            _SHARD_MAP_PARAMS = frozenset(
+                inspect.signature(_shard_map).parameters)
+        except (TypeError, ValueError):
+            _SHARD_MAP_PARAMS = frozenset()
+    return _SHARD_MAP_PARAMS
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False, **kw):
+    """``jax.shard_map`` with the replication-check flag translated.
+
+    Current JAX names the flag ``check_vma``; the 0.4.x experimental API
+    calls it ``check_rep``. Either way ``False`` means "trust the
+    out_specs" — the framework's shard_map islands use collectives whose
+    replication the checker cannot always prove.
+    """
+    params = _shard_map_params()
+    if "check_vma" in params:
+        kw["check_vma"] = check_vma
+    elif "check_rep" in params:
+        kw["check_rep"] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)
+
+
+def ensure_cpu_devices(n: int) -> None:
+    """Ask XLA for ``n`` virtual CPU devices, before backend init.
+
+    Current JAX exposes this as the ``jax_num_cpu_devices`` config; older
+    releases only honor ``--xla_force_host_platform_device_count`` in
+    ``XLA_FLAGS`` (still read at first backend initialization, so setting
+    it after ``import jax`` works as long as no device query ran yet).
+    Callers should verify ``jax.device_count()`` afterwards — if a
+    backend was already initialized with fewer devices, neither route can
+    grow it.
+    """
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+        return
+    except AttributeError:
+        pass
+    flag = f"--xla_force_host_platform_device_count={n}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()

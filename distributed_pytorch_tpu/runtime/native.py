@@ -30,10 +30,27 @@ def _build() -> None:
     # written file must never be dlopen'd.
     src = os.path.join(_NATIVE_DIR, "dpxhost.cpp")
     tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    # flags mirror native/Makefile: -fno-math-errno (NOT fast-math) keeps
+    # the quantized codec bit-identical to comm/wire.py while letting
+    # lrintf/fabsf inline and the quant loops vectorize
     subprocess.run(
-        ["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-o", tmp, src],
+        ["g++", "-O3", "-fno-math-errno", "-fPIC", "-std=c++17", "-shared",
+         "-o", tmp, src],
         check=True, capture_output=True)
     os.replace(tmp, _LIB_PATH)
+
+
+def _needs_build() -> bool:
+    """Missing OR stale: a checkout where dpxhost.cpp is newer than the
+    built .so must rebuild, or new symbols (e.g. dpx_allreduce_q8) would
+    silently be missing from an old library."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    try:
+        src = os.path.join(_NATIVE_DIR, "dpxhost.cpp")
+        return os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return False
 
 
 def load_library():
@@ -42,7 +59,7 @@ def load_library():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
+        if _needs_build():
             _build()
         lib = ctypes.CDLL(_LIB_PATH)
         lib.dpx_comm_init.restype = ctypes.c_void_p
@@ -62,6 +79,19 @@ def load_library():
                                           ctypes.POINTER(ctypes.c_double),
                                           ctypes.c_int64]
         lib.dpx_allreduce_f64.restype = ctypes.c_int
+        lib.dpx_allreduce_f32_op.argtypes = [ctypes.c_void_p,
+                                             ctypes.POINTER(ctypes.c_float),
+                                             ctypes.c_int64, ctypes.c_int]
+        lib.dpx_allreduce_f32_op.restype = ctypes.c_int
+        lib.dpx_allreduce_f64_op.argtypes = [ctypes.c_void_p,
+                                             ctypes.POINTER(ctypes.c_double),
+                                             ctypes.c_int64, ctypes.c_int]
+        lib.dpx_allreduce_f64_op.restype = ctypes.c_int
+        lib.dpx_allreduce_q8.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(ctypes.c_float),
+                                         ctypes.c_int64, ctypes.c_int,
+                                         ctypes.c_int]
+        lib.dpx_allreduce_q8.restype = ctypes.c_int
         lib.dpx_reduce_f32.argtypes = [ctypes.c_void_p,
                                        ctypes.POINTER(ctypes.c_float),
                                        ctypes.c_int64]
@@ -86,10 +116,21 @@ class HostComm:
     ``base_port`` (the MASTER_PORT analog, reference distributed.py:48-49).
     """
 
+    #: allreduce op codes (mirror dpxhost.cpp's enum)
+    _OPS = {"sum": 0, "max": 1, "min": 2}
+
     def __init__(self, master_addr: str, base_port: int, rank: int,
                  world: int, timeout_ms: int = 30000):
         import socket as _socket
 
+        # late imports: runtime/__init__ imports this module eagerly, and
+        # comm/__init__ imports runtime.context — binding here (after all
+        # packages finished loading) avoids the cycle
+        from ..comm import wire as _wire
+        from ..utils.profiler import CommStats
+
+        self._wire = _wire
+        self.stats = CommStats()
         self._lib = load_library()
         # the native layer takes dotted-quad only; resolve hostnames (e.g.
         # 'localhost', the reference's MASTER_ADDR default) here
@@ -118,28 +159,62 @@ class HostComm:
         if rc != 0:
             raise RuntimeError(f"native {what} failed (rank {self.rank})")
 
-    def allreduce(self, arr: np.ndarray) -> np.ndarray:
-        """In-place ring allreduce (sum) on a float32/float64 array."""
+    def allreduce(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
+        """In-place ring allreduce on a float32/float64 array.
+
+        ``op``: ``sum`` (the classic ring) or elementwise ``max``/``min``
+        — same ring, same 2*(W-1)/W bytes per rank (the max/min path used
+        to all-gather the whole tensor from every rank, W x the traffic).
+        """
+        if op not in self._OPS:
+            raise ValueError(f"allreduce op must be sum|max|min, got {op!r}")
         arr = np.ascontiguousarray(arr)
-        if arr.dtype == np.float32:
-            rc = self._lib.dpx_allreduce_f32(
-                self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                arr.size)
-        elif arr.dtype == np.float64:
-            rc = self._lib.dpx_allreduce_f64(
-                self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-                arr.size)
-        else:
-            raise TypeError(f"allreduce supports f32/f64, got {arr.dtype}")
+        code = self._OPS[op]
+        nbytes = self._wire.ring_allreduce_wire_bytes(
+            arr.size, self.world, arr.dtype.itemsize) // max(self.world, 1)
+        with self.stats.timed(f"allreduce_{op}", nbytes):
+            if arr.dtype == np.float32:
+                rc = self._lib.dpx_allreduce_f32_op(
+                    self._h,
+                    arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    arr.size, code)
+            elif arr.dtype == np.float64:
+                rc = self._lib.dpx_allreduce_f64_op(
+                    self._h,
+                    arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                    arr.size, code)
+            else:
+                raise TypeError(
+                    f"allreduce supports f32/f64, got {arr.dtype}")
         self._check(rc, "allreduce")
+        return arr
+
+    def allreduce_q8(self, arr: np.ndarray, block: int = None,
+                     chunk_blocks: int = None) -> np.ndarray:
+        """In-place QUANTIZED ring allreduce (sum) on a float32 array.
+
+        Block-scaled int8 wire format (comm/wire.py), chunk-pipelined;
+        LOSSY (one quantization step per hop) but bit-identical across
+        ranks. ~4x less wire traffic than :meth:`allreduce`."""
+        block = block or self._wire.QUANT_BLOCK
+        chunk_blocks = chunk_blocks or self._wire.QUANT_CHUNK_BLOCKS
+        arr = np.ascontiguousarray(arr, dtype=np.float32)
+        nbytes = self._wire.quant_ring_allreduce_wire_bytes(
+            arr.size, self.world, block) // max(self.world, 1)
+        with self.stats.timed("allreduce_q8", nbytes):
+            rc = self._lib.dpx_allreduce_q8(
+                self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                arr.size, block, chunk_blocks)
+        self._check(rc, "allreduce_q8")
         return arr
 
     def reduce(self, arr: np.ndarray) -> np.ndarray:
         """Rooted sum to rank 0 (non-root buffers unchanged)."""
         arr = np.ascontiguousarray(arr, dtype=np.float32)
-        rc = self._lib.dpx_reduce_f32(
-            self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            arr.size)
+        with self.stats.timed("reduce", arr.nbytes):
+            rc = self._lib.dpx_reduce_f32(
+                self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                arr.size)
         self._check(rc, "reduce")
         return arr
 
@@ -147,14 +222,15 @@ class HostComm:
         """Rooted gather to rank 0: returns the list there, None elsewhere."""
         arr = np.ascontiguousarray(arr)
         nbytes = arr.nbytes
-        if self.rank == 0:
-            recv = np.zeros((self.world,) + arr.shape, dtype=arr.dtype)
-            rc = self._lib.dpx_gather(
-                self._h, arr.tobytes(), nbytes,
-                recv.ctypes.data_as(ctypes.c_char_p))
-            self._check(rc, "gather")
-            return [recv[r] for r in range(self.world)]
-        rc = self._lib.dpx_gather(self._h, arr.tobytes(), nbytes, None)
+        with self.stats.timed("gather", nbytes):
+            if self.rank == 0:
+                recv = np.zeros((self.world,) + arr.shape, dtype=arr.dtype)
+                rc = self._lib.dpx_gather(
+                    self._h, arr.tobytes(), nbytes,
+                    recv.ctypes.data_as(ctypes.c_char_p))
+                self._check(rc, "gather")
+                return [recv[r] for r in range(self.world)]
+            rc = self._lib.dpx_gather(self._h, arr.tobytes(), nbytes, None)
         self._check(rc, "gather")
         return None
 
@@ -171,10 +247,14 @@ class HostComm:
 
     def broadcast(self, arr: np.ndarray, src: int = 0) -> np.ndarray:
         arr = np.ascontiguousarray(arr)
-        rc = self._lib.dpx_broadcast(
-            self._h, arr.ctypes.data_as(ctypes.c_char_p), arr.nbytes, src)
+        with self.stats.timed("broadcast", arr.nbytes):
+            rc = self._lib.dpx_broadcast(
+                self._h, arr.ctypes.data_as(ctypes.c_char_p), arr.nbytes,
+                src)
         self._check(rc, "broadcast")
         return arr
 
     def barrier(self):
-        self._check(self._lib.dpx_barrier(self._h), "barrier")
+        with self.stats.timed("barrier", 4):
+            rc = self._lib.dpx_barrier(self._h)
+        self._check(rc, "barrier")

@@ -62,6 +62,57 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+class CommStats:
+    """Per-process communication accounting: calls, wall seconds, and
+    payload bytes on the wire, per collective op.
+
+    The host front door's :class:`..runtime.native.HostComm` owns one and
+    feeds every collective through :meth:`timed`, so a training loop can
+    diff :meth:`snapshot` around a step to attribute per-step comm time
+    and bytes (quantized-vs-f32 wire cost shows up directly — see
+    ``benchmarks/step_breakdown.py``'s comm arms). Bytes are the WIRE
+    payload this rank sends (e.g. the int8+scales framing for the
+    quantized ring), not the logical tensor size.
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.per_op: Dict[str, Dict[str, float]] = {}
+
+    def record(self, op: str, nbytes: int, seconds: float) -> None:
+        d = self.per_op.setdefault(
+            op, {"calls": 0, "seconds": 0.0, "bytes": 0})
+        d["calls"] += 1
+        d["seconds"] += seconds
+        d["bytes"] += int(nbytes)
+
+    @contextlib.contextmanager
+    def timed(self, op: str, nbytes: int):
+        """Time a collective and record its wire bytes; also emits a
+        trace annotation so the op shows on XProf timelines."""
+        t0 = time.perf_counter()
+        try:
+            with annotate(f"comm:{op}"):
+                yield
+        finally:
+            self.record(op, nbytes, time.perf_counter() - t0)
+
+    def snapshot(self) -> Dict[str, float]:
+        """Totals so far: {calls, seconds, bytes} summed over ops."""
+        out = {"calls": 0, "seconds": 0.0, "bytes": 0}
+        for d in self.per_op.values():
+            out["calls"] += d["calls"]
+            out["seconds"] += d["seconds"]
+            out["bytes"] += d["bytes"]
+        return out
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-op totals (a copy; safe to serialize)."""
+        return {op: dict(d) for op, d in self.per_op.items()}
+
+
 def device_memory_stats(device=None) -> Dict[str, Any]:
     """Per-device allocator stats (bytes in use, peak, limit) where the
     backend exposes them; empty dict otherwise (XLA-CPU has none)."""

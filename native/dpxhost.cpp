@@ -18,16 +18,35 @@
 // the same out-of-order-start tolerance as a TCPStore rendezvous.
 //
 // Collectives:
-//   * allreduce (f32/f64, sum): ring reduce-scatter + ring all-gather —
-//     the bandwidth-optimal Gloo/NCCL algorithm (2*(W-1)/W * bytes moved
-//     per rank).
+//   * allreduce (f32/f64; sum/max/min elementwise): ring reduce-scatter +
+//     ring all-gather — the bandwidth-optimal Gloo/NCCL algorithm
+//     (2*(W-1)/W * bytes moved per rank).
+//   * allreduce_q8 (f32 sum): the same ring with the block-scaled int8
+//     wire format of comm/wire.py — per CHUNK of blocks the sender
+//     quantizes the f32 partial, ships [f32 scales][int8 payload], and
+//     the receiver dequantize-accumulates in f32; the all-gather leg
+//     forwards the owner's quantized bytes UNCHANGED so every rank
+//     decodes identical bytes (bit-identical results on all ranks).
+//     Chunking pipelines compute against the wire: while this rank
+//     quantizes/accumulates chunk k, chunk k-1 drains from the kernel
+//     socket buffer and the peer's chunk k is already in flight —
+//     with one monolithic chunk those phases would serialize globally.
+//     ~4x less traffic than the f32 ring (int8 + one f32 scale per
+//     block); numerics are LOSSY (bounded by one quantization step per
+//     hop) and mirrored bit-for-bit by comm/wire.py:simulate_quant_ring.
 //   * reduce (to 0), gather (to 0), broadcast (from src), barrier: hub.
+//     Rooted ops stay reference-exact full-width — the quantized format
+//     is never applied to them.
 //
 // C ABI only (ctypes-friendly); no exceptions cross the boundary.
 
 #include <arpa/inet.h>
 #include <cerrno>
+#include <cmath>
 #include <poll.h>
+#if defined(__SSE2__)
+#include <emmintrin.h>
+#endif
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -258,10 +277,32 @@ void dpx_comm_destroy(void* handle) {
 int dpx_rank(void* handle) { return static_cast<Comm*>(handle)->rank; }
 int dpx_world(void* handle) { return static_cast<Comm*>(handle)->world; }
 
-// Ring allreduce, sum, element type selected by elem_size (4=f32, 8=f64).
-// Bandwidth-optimal: reduce-scatter then all-gather, each W-1 hops of
-// n/W elements.
-static int ring_allreduce(Comm* c, char* data, int64_t n, int elem_size) {
+// Elementwise reduce ops for the full-width ring (kOpSum matches the
+// original sum-only ring bit-for-bit).
+enum { kOpSum = 0, kOpMax = 1, kOpMin = 2 };
+
+#define DPX_REDUCE_INTO(NAME, T)                                           \
+  static void NAME(T* d, const T* s, int64_t n, int op) {                  \
+    switch (op) {                                                          \
+      case kOpMax:                                                         \
+        for (int64_t i = 0; i < n; i++) d[i] = (s[i] > d[i]) ? s[i] : d[i];\
+        break;                                                             \
+      case kOpMin:                                                         \
+        for (int64_t i = 0; i < n; i++) d[i] = (s[i] < d[i]) ? s[i] : d[i];\
+        break;                                                             \
+      default:                                                             \
+        for (int64_t i = 0; i < n; i++) d[i] += s[i];                      \
+    }                                                                      \
+  }
+DPX_REDUCE_INTO(reduce_into_f32, float)
+DPX_REDUCE_INTO(reduce_into_f64, double)
+#undef DPX_REDUCE_INTO
+
+// Ring allreduce, element type selected by elem_size (4=f32, 8=f64), op
+// from the enum above. Bandwidth-optimal: reduce-scatter then all-gather,
+// each W-1 hops of n/W elements.
+static int ring_allreduce(Comm* c, char* data, int64_t n, int elem_size,
+                          int op) {
   if (c->world == 1) return 0;
   const int w = c->world;
   const int64_t chunk = (n + w - 1) / w;  // elements per segment (last ragged)
@@ -286,13 +327,13 @@ static int ring_allreduce(Comm* c, char* data, int64_t n, int elem_size) {
                   recv_buf.data(), static_cast<size_t>(rlen) * elem_size) != 0)
       return -1;
     if (elem_size == 4) {
-      float* d = reinterpret_cast<float*>(seg_ptr(recv_seg));
-      const float* s = reinterpret_cast<const float*>(recv_buf.data());
-      for (int64_t i = 0; i < rlen; i++) d[i] += s[i];
+      reduce_into_f32(reinterpret_cast<float*>(seg_ptr(recv_seg)),
+                      reinterpret_cast<const float*>(recv_buf.data()), rlen,
+                      op);
     } else {
-      double* d = reinterpret_cast<double*>(seg_ptr(recv_seg));
-      const double* s = reinterpret_cast<const double*>(recv_buf.data());
-      for (int64_t i = 0; i < rlen; i++) d[i] += s[i];
+      reduce_into_f64(reinterpret_cast<double*>(seg_ptr(recv_seg)),
+                      reinterpret_cast<const double*>(recv_buf.data()), rlen,
+                      op);
     }
   }
   // all-gather the reduced segments around the ring
@@ -311,12 +352,304 @@ static int ring_allreduce(Comm* c, char* data, int64_t n, int elem_size) {
 
 int dpx_allreduce_f32(void* handle, float* data, int64_t n) {
   return ring_allreduce(static_cast<Comm*>(handle),
-                        reinterpret_cast<char*>(data), n, 4);
+                        reinterpret_cast<char*>(data), n, 4, kOpSum);
 }
 
 int dpx_allreduce_f64(void* handle, double* data, int64_t n) {
   return ring_allreduce(static_cast<Comm*>(handle),
-                        reinterpret_cast<char*>(data), n, 8);
+                        reinterpret_cast<char*>(data), n, 8, kOpSum);
+}
+
+// op: 0 sum, 1 elementwise max, 2 elementwise min. The max/min ring moves
+// the same 2*(W-1)/W*bytes as sum — replacing the old all-gather-then-
+// reduce-locally emulation (W x full-tensor traffic) for those ops.
+int dpx_allreduce_f32_op(void* handle, float* data, int64_t n, int op) {
+  return ring_allreduce(static_cast<Comm*>(handle),
+                        reinterpret_cast<char*>(data), n, 4, op);
+}
+
+int dpx_allreduce_f64_op(void* handle, double* data, int64_t n, int op) {
+  return ring_allreduce(static_cast<Comm*>(handle),
+                        reinterpret_cast<char*>(data), n, 8, op);
+}
+
+// ---------------------------------------------------------------------------
+// Quantized ring allreduce (sum) — the comm/wire.py block format in C.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// q[i] = clip(rint(src[i] * inv), -127, 127) — the codec's quant rule
+// (comm/wire.py multiplies by the same f32 inverse; lrintf/cvtps2dq and
+// np.rint all round half-to-even, and the integer-domain clamp equals
+// the float-domain clip bit for bit). Precondition: |src*inv| well
+// inside int32 range — guaranteed by inv <= 127/amax.
+void quant_row(const float* src, int64_t len, float inv, int8_t* dst) {
+#if defined(__SSE2__)
+  // hand-vectorized: the scalar loop is the quantized ring's hot spot
+  // (gcc won't pick cvtps2dq for lrintf on baseline x86-64), and this
+  // path is bit-identical to the scalar tail below
+  const __m128 vinv = _mm_set1_ps(inv);
+  const __m128i hi = _mm_set1_epi16(127), lo = _mm_set1_epi16(-127);
+  int64_t i = 0;
+  for (; i + 16 <= len; i += 16) {
+    __m128i a = _mm_cvtps_epi32(_mm_mul_ps(_mm_loadu_ps(src + i), vinv));
+    __m128i b = _mm_cvtps_epi32(_mm_mul_ps(_mm_loadu_ps(src + i + 4), vinv));
+    __m128i c = _mm_cvtps_epi32(_mm_mul_ps(_mm_loadu_ps(src + i + 8), vinv));
+    __m128i d =
+        _mm_cvtps_epi32(_mm_mul_ps(_mm_loadu_ps(src + i + 12), vinv));
+    __m128i ab = _mm_min_epi16(_mm_max_epi16(_mm_packs_epi32(a, b), lo), hi);
+    __m128i cd = _mm_min_epi16(_mm_max_epi16(_mm_packs_epi32(c, d), lo), hi);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i),
+                     _mm_packs_epi16(ab, cd));
+  }
+#else
+  int64_t i = 0;
+#endif
+  for (; i < len; i++) {
+    long t = lrintf(src[i] * inv);
+    if (t > 127) t = 127;
+    if (t < -127) t = -127;
+    dst[i] = static_cast<int8_t>(t);
+  }
+}
+
+// Quantize `n` f32 values into the framed wire form: scales[] gets one
+// f32 per block, q[] one int8 per element. Block rule mirrors
+// comm/wire.py exactly (same IEEE ops): scale 1 for all-zero blocks and
+// for integer blocks with amax <= 127 (exact transfer), else amax/127,
+// quantizing by the f32 INVERSE 127/amax (multiply, not divide — and the
+// numpy side does the same, so grids agree bit for bit).
+void quantize_span(const float* v, int64_t n, int block, float* scales,
+                   int8_t* q) {
+  for (int64_t b = 0, lo = 0; lo < n; b++, lo += block) {
+    int64_t len = (lo + block > n) ? n - lo : block;
+    const float* src = v + lo;
+    float amax = 0.0f;
+    for (int64_t i = 0; i < len; i++) {
+      float a = fabsf(src[i]);
+      if (a > amax) amax = a;
+    }
+    // integer-exact snap: only worth scanning when amax admits it, and
+    // the scan exits at the first fractional value (one compare for
+    // typical float gradients). |v| <= 127 here, so lrintf cannot
+    // overflow.
+    bool allint = false;
+    if (amax != 0.0f && amax <= 127.0f) {
+      allint = true;
+      for (int64_t i = 0; i < len; i++) {
+        if (static_cast<float>(lrintf(src[i])) != src[i]) {
+          allint = false;
+          break;
+        }
+      }
+    }
+    bool unit = (amax == 0.0f || allint);
+    scales[b] = unit ? 1.0f : amax / 127.0f;
+    quant_row(src, len, unit ? 1.0f : 127.0f / amax, q + lo);
+  }
+}
+
+// acc[i] (+)= q[i] * scale — `assign` overwrites (all-gather leg),
+// otherwise accumulates (reduce-scatter leg). Same op order as
+// comm/wire.py:dequantize_blocks.
+void dequant_span(const float* scales, const int8_t* q, int64_t n, int block,
+                  float* acc, bool assign) {
+  for (int64_t b = 0, lo = 0; lo < n; b++, lo += block) {
+    int64_t len = (lo + block > n) ? n - lo : block;
+    float scale = scales[b];
+    const int8_t* src = q + lo;
+    float* dst = acc + lo;
+    if (assign) {
+      for (int64_t i = 0; i < len; i++)
+        dst[i] = static_cast<float>(src[i]) * scale;
+    } else {
+      for (int64_t i = 0; i < len; i++)
+        dst[i] += static_cast<float>(src[i]) * scale;
+    }
+  }
+}
+
+// Block-aligned segment grid (comm/wire.py:segment_blocks): world
+// segments of whole blocks, first `rem` segments one block larger.
+struct QGrid {
+  int64_t n;
+  int block;
+  int64_t nblocks;
+  int world;
+
+  QGrid(int64_t n_, int block_, int world_)
+      : n(n_), block(block_),
+        nblocks((n_ + block_ - 1) / block_), world(world_) {}
+
+  int64_t seg_start_block(int seg) const {
+    int64_t base = nblocks / world, rem = nblocks % world;
+    return seg * base + (seg < rem ? seg : rem);
+  }
+  int64_t seg_nblocks(int seg) const {
+    int64_t base = nblocks / world, rem = nblocks % world;
+    return base + (seg < rem ? 1 : 0);
+  }
+  // elements covered by blocks [b0, b0+nb)
+  int64_t span_elems(int64_t b0, int64_t nb) const {
+    int64_t lo = b0 * block;
+    int64_t hi = (b0 + nb) * block;
+    if (hi > n) hi = n;
+    return (hi > lo) ? hi - lo : 0;
+  }
+  int64_t wire_bytes(int64_t b0, int64_t nb) const {
+    return 4 * nb + span_elems(b0, nb);
+  }
+};
+
+// One pipelined hop: stream `send` (blocks [sb0, sb0+snb) quantized from
+// `data`, or pre-encoded bytes from `fwd`) while receiving the peer's
+// framed chunks into `acc`/`keep`, chunk_blocks blocks at a time.
+// Receiving side dequantizes into data (accumulate or assign); when
+// `keep` != null the raw received bytes are also stored for forwarding
+// next hop (all-gather leg).
+int q8_hop(Comm* c, const QGrid& g, float* data, int chunk_blocks,
+           int send_seg, const char* fwd, int recv_seg, bool assign,
+           char* sbuf, char* rbuf, char* keep) {
+  int64_t snb_total = g.seg_nblocks(send_seg);
+  int64_t rnb_total = g.seg_nblocks(recv_seg);
+  int64_t sb0 = g.seg_start_block(send_seg);
+  int64_t rb0 = g.seg_start_block(recv_seg);
+  int64_t nchunks_s = (snb_total + chunk_blocks - 1) / chunk_blocks;
+  int64_t nchunks_r = (rnb_total + chunk_blocks - 1) / chunk_blocks;
+  int64_t nchunks = (nchunks_s > nchunks_r) ? nchunks_s : nchunks_r;
+  int64_t fwd_off = 0, keep_off = 0;
+  for (int64_t k = 0; k < nchunks; k++) {
+    // sender side: frame chunk k of send_seg
+    int64_t sn = 0;
+    const char* sptr = nullptr;
+    if (k < nchunks_s) {
+      int64_t cb0 = sb0 + k * chunk_blocks;
+      int64_t cnb = (k == nchunks_s - 1) ? snb_total - k * chunk_blocks
+                                         : chunk_blocks;
+      sn = g.wire_bytes(cb0, cnb);
+      if (fwd) {
+        sptr = fwd + fwd_off;  // forward pre-encoded bytes unchanged
+        fwd_off += sn;
+      } else {
+        quantize_span(data + cb0 * g.block, g.span_elems(cb0, cnb), g.block,
+                      reinterpret_cast<float*>(sbuf),
+                      reinterpret_cast<int8_t*>(sbuf + 4 * cnb));
+        sptr = sbuf;
+      }
+    }
+    // receiver side: chunk k of recv_seg
+    int64_t rn = 0;
+    int64_t cb0r = rb0 + k * chunk_blocks;
+    int64_t cnbr = 0;
+    if (k < nchunks_r) {
+      cnbr = (k == nchunks_r - 1) ? rnb_total - k * chunk_blocks
+                                  : chunk_blocks;
+      rn = g.wire_bytes(cb0r, cnbr);
+    }
+    if (send_recv(c->ring_send_fd, sptr, static_cast<size_t>(sn),
+                  c->ring_recv_fd, rbuf, static_cast<size_t>(rn)) != 0)
+      return -1;
+    if (rn > 0) {
+      dequant_span(reinterpret_cast<const float*>(rbuf),
+                   reinterpret_cast<const int8_t*>(rbuf + 4 * cnbr),
+                   g.span_elems(cb0r, cnbr), g.block,
+                   data + cb0r * g.block, assign);
+      if (keep) {
+        memcpy(keep + keep_off, rbuf, static_cast<size_t>(rn));
+        keep_off += rn;
+      }
+    }
+  }
+  return 0;
+}
+
+}  // namespace
+
+// Quantized ring allreduce (sum) on f32 data, in place. `block` elements
+// share one f32 scale; `chunk_blocks` blocks form one pipelined wire
+// chunk. Result is bit-identical on every rank (all-gather leg decodes
+// identical forwarded bytes) and bit-identical to
+// comm/wire.py:simulate_quant_ring.
+int dpx_allreduce_q8(void* handle, float* data, int64_t n, int block,
+                     int chunk_blocks) {
+  Comm* c = static_cast<Comm*>(handle);
+  if (c->world == 1 || n == 0) return 0;
+  if (block <= 0 || chunk_blocks <= 0) return -1;
+  const int w = c->world;
+  QGrid g(n, block, w);
+
+  // scratch: one chunk each way + two full-segment wire buffers for the
+  // byte-forwarding all-gather leg
+  int64_t max_seg_wire = 0, max_seg_nb = 0;
+  for (int s = 0; s < w; s++) {
+    int64_t wb = g.wire_bytes(g.seg_start_block(s), g.seg_nblocks(s));
+    if (wb > max_seg_wire) max_seg_wire = wb;
+    if (g.seg_nblocks(s) > max_seg_nb) max_seg_nb = g.seg_nblocks(s);
+  }
+  int64_t cb = (chunk_blocks < max_seg_nb) ? chunk_blocks : max_seg_nb;
+  if (cb < 1) cb = 1;
+  int64_t max_chunk_wire = 4 * cb + cb * static_cast<int64_t>(block);
+  std::vector<char> sbuf(static_cast<size_t>(max_chunk_wire));
+  std::vector<char> rbuf(static_cast<size_t>(max_chunk_wire));
+
+  // reduce-scatter: quantize the f32 partial of the outgoing segment
+  // each hop; receiver dequantize-accumulates. After w-1 steps rank r
+  // holds the full (lossily accumulated) sum of segment (r+1)%w.
+  for (int step = 0; step < w - 1; step++) {
+    int send_seg = (c->rank - step + w) % w;
+    int recv_seg = (c->rank - step - 1 + w) % w;
+    if (q8_hop(c, g, data, static_cast<int>(cb), send_seg, nullptr,
+               recv_seg, /*assign=*/false, sbuf.data(), rbuf.data(),
+               nullptr) != 0)
+      return -1;
+  }
+
+  // all-gather: owner quantizes its reduced segment ONCE, replaces its
+  // own f32 copy with the dequantized value, and the bytes are forwarded
+  // unchanged — every rank decodes identical bytes.
+  std::vector<char> fwd(static_cast<size_t>(max_seg_wire));
+  std::vector<char> keep(static_cast<size_t>(max_seg_wire));
+  {
+    int own = (c->rank + 1) % w;
+    int64_t b0 = g.seg_start_block(own), nb = g.seg_nblocks(own);
+    int64_t elems = g.span_elems(b0, nb);
+    quantize_span(data + b0 * g.block, elems, g.block,
+                  reinterpret_cast<float*>(fwd.data()),
+                  reinterpret_cast<int8_t*>(fwd.data() + 4 * nb));
+    dequant_span(reinterpret_cast<const float*>(fwd.data()),
+                 reinterpret_cast<const int8_t*>(fwd.data() + 4 * nb),
+                 elems, g.block, data + b0 * g.block, /*assign=*/true);
+    // repack to chunk framing: fwd currently holds [all scales][all q];
+    // hops send per-chunk frames, so re-encode into chunk order
+    if (nb > cb) {
+      std::vector<char> frames(static_cast<size_t>(max_seg_wire));
+      int64_t off = 0;
+      for (int64_t k = 0; k * cb < nb; k++) {
+        int64_t cb0 = b0 + k * cb;
+        int64_t cnb = ((k + 1) * cb > nb) ? nb - k * cb : cb;
+        memcpy(frames.data() + off, fwd.data() + 4 * (k * cb),
+               static_cast<size_t>(4 * cnb));
+        off += 4 * cnb;
+        int64_t qoff = g.span_elems(b0, k * cb);
+        memcpy(frames.data() + off, fwd.data() + 4 * nb + qoff,
+               static_cast<size_t>(g.span_elems(cb0, cnb)));
+        off += g.span_elems(cb0, cnb);
+      }
+      fwd.swap(frames);
+    }
+  }
+  for (int step = 0; step < w - 1; step++) {
+    int send_seg = (c->rank + 1 - step + w) % w;
+    int recv_seg = (c->rank - step + w) % w;
+    bool last = (step == w - 2);
+    if (q8_hop(c, g, data, static_cast<int>(cb), send_seg, fwd.data(),
+               recv_seg, /*assign=*/true, sbuf.data(), rbuf.data(),
+               last ? nullptr : keep.data()) != 0)
+      return -1;
+    fwd.swap(keep);
+  }
+  return 0;
 }
 
 // Rooted reduce (sum) to rank 0 via the hub. Non-root buffers unchanged

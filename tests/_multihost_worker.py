@@ -15,13 +15,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)  # 4 local x 2 procs = 8 global
+
+from distributed_pytorch_tpu.runtime.jax_compat import ensure_cpu_devices  # noqa: E402
+
+ensure_cpu_devices(4)  # 4 local x 2 procs = 8 global
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
 from distributed_pytorch_tpu.runtime import multihost  # noqa: E402
+from distributed_pytorch_tpu.runtime.jax_compat import shard_map  # noqa: E402
 
 
 def main(coordinator: str, num_procs: int, proc_id: int) -> int:
@@ -48,7 +52,7 @@ def main(coordinator: str, num_procs: int, proc_id: int) -> int:
         g = jax.grad(lambda w: jnp.mean((x * w) ** 2))(w)
         return jax.lax.pmean(jax.lax.pmean(g, "dp"), "dp_outer")
 
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), P(("dp_outer", "dp"))),
         out_specs=P(), check_vma=False))

@@ -22,7 +22,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+
+from distributed_pytorch_tpu.runtime.jax_compat import ensure_cpu_devices  # noqa: E402
+
+ensure_cpu_devices(8)
 os.environ.setdefault("DPX_CPU_DEVICES", "8")
 
 import pytest  # noqa: E402
