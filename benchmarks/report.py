@@ -168,10 +168,23 @@ def render(rows) -> str:
         for a in arms:
             mfu_cell = (_fmt(a["mfu"], 4) if a.get("mfu") is not None
                         else "n/a")
+            # † marks arms that printed a record but then exited nonzero
+            # (arm_error/arm_rc): suspect measurements must be visibly
+            # distinct from clean rows (ADVICE round 5)
+            mark = " †" if a.get("arm_error") else ""
             lines.append(
-                f"| `{json.dumps(a['arm'], sort_keys=True)}` | "
+                f"| `{json.dumps(a['arm'], sort_keys=True)}`{mark} | "
                 f"{mfu_cell} | {_fmt(a.get('tokens_per_sec', 0))} | "
                 f"{_fmt(a.get('step_ms_median', 0), 2)} |")
+        suspect = [a for a in arms if a.get("arm_error")]
+        if suspect:
+            lines.append("")
+            for a in suspect:
+                lines.append(
+                    f"† `{json.dumps(a['arm'], sort_keys=True)}` exited "
+                    f"nonzero after printing its record "
+                    f"(rc {a.get('arm_rc')}): "
+                    f"{str(a['arm_error'])[:90]}")
         failed = [a for a in sw["sweep"] if a.get("error")]
         if failed:
             lines.append("")

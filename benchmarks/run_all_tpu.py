@@ -34,7 +34,13 @@ rounds ran as an ad-hoc session process.
 
 Usage: python benchmarks/run_all_tpu.py [--quick] [--out FILE]
            [--watch] [--interval SECONDS] [--max-hours H]
-           [--done-flag FILE]
+           [--done-flag FILE] [--write-baseline]
+
+BASELINE.md's measured section is regenerated only when collecting into
+the DEFAULT results log (benchmarks/tpu_results.jsonl) — a trial run
+with a scratch --out must not silently replace the repo's evidence with
+its rows (ADVICE round 5). Pass --write-baseline to force regeneration
+from a non-default log.
 """
 
 import json
@@ -162,9 +168,14 @@ def main(argv):
 
 def _run(argv):
     quick = "--quick" in argv
-    out_path = _flag_value(argv, "--out",
-                           os.path.join(REPO, "benchmarks",
-                                        "tpu_results.jsonl"))
+    default_out = os.path.join(REPO, "benchmarks", "tpu_results.jsonl")
+    out_path = _flag_value(argv, "--out", default_out)
+    # BASELINE.md only regenerates from the repo's canonical log (or on
+    # explicit request): a scratch --out run must never rewrite the
+    # committed measured section from its own rows
+    write_baseline = ("--write-baseline" in argv
+                      or os.path.abspath(out_path)
+                      == os.path.abspath(default_out))
     py = sys.executable
 
     watching = "--watch" in argv
@@ -332,7 +343,11 @@ def _run(argv):
         print(f"\n{len(done)}/{len(stages)} stages ok, "
               f"{len(pending)} pending -> {out_path}", flush=True)
         if len(done) > n_done_before:  # only passes that landed a stage
-            regenerate_baseline(py, out_path)
+            if write_baseline:
+                regenerate_baseline(py, out_path)
+            else:
+                print("# BASELINE.md regen skipped: non-default --out "
+                      "(pass --write-baseline to force)", flush=True)
         if not pending:
             return 0 if len(done) == len(stages) else 1
         if not (watching and time.time() + interval_s < deadline):

@@ -21,6 +21,14 @@ bit-identical across ranks). The REFERENCE-EXACT contracts are never
 quantized: ``reduce`` (non-root buffers untouched) and ``gather``
 (zeros-on-non-primary) always move exact full-width bytes, as does any
 integer payload (f64 ring keeps integer sums exact).
+
+Failure semantics: every collective here observes the native per-op
+deadline (``DPX_COMM_TIMEOUT_MS``) and raises the typed
+:class:`~..runtime.native.CommError` hierarchy re-exported below —
+``CommPeerDied`` (a rank died mid-collective), ``CommTimeout`` (wedged
+peer/link), ``CommCorrupt`` (quant frame failed CRC32). A failed op
+tears this rank's links down, so peers fail within one deadline tick
+instead of deadlocking (see docs/failures.md).
 """
 
 from __future__ import annotations
@@ -29,6 +37,8 @@ from typing import List, Sequence
 
 import numpy as np
 
+from ..runtime.native import (CommCorrupt, CommError,  # noqa: F401
+                              CommPeerDied, CommTimeout)
 from . import wire as _wire
 
 #: Wire formats a lossy-tolerant collective accepts.

@@ -1,13 +1,15 @@
 """Runtime: device/mesh discovery, process-group lifecycle, launchers
 (SPMD single-controller + native per-rank multiprocess), failure
-detection (supervision, heartbeats, orphan cleanup)."""
-from . import (context, elastic, launcher, multihost, multiprocess, native,
-               watchdog)
+detection (supervision, heartbeats, orphan cleanup), deterministic fault
+injection, and the typed comm-failure hierarchy."""
+from . import (context, elastic, faults, launcher, multihost, multiprocess,
+               native, watchdog)
 from .context import (DATA_AXIS, MESH_AXES, device_count, get_device,
                       get_host_comm, get_mesh, get_rank, get_world_size,
                       init_mesh, init_process_group, is_initialized)
 from .elastic import ElasticResult, elastic_attempt, elastic_run, is_elastic
 from .launcher import find_free_port, launch
 from .multiprocess import launch_multiprocess
+from .native import CommCorrupt, CommError, CommPeerDied, CommTimeout
 from .watchdog import (Heartbeat, HeartbeatMonitor, ProcessSupervisor,
                        StalledWorker, WorkerFailure, kill_orphan_workers)

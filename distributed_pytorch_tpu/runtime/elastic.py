@@ -78,6 +78,8 @@ def elastic_run(target: Callable, args: Sequence = (), *,
     crashing-on-start worker must not busy-loop the host). ``env``
     entries are exported to the child (on top of the parent's
     environment)."""
+    from ..utils.logging import append_event
+
     ctx = mp.get_context(ctx_method)
     codes = []
     for attempt in range(max_restarts + 1):
@@ -87,19 +89,39 @@ def elastic_run(target: Callable, args: Sequence = (), *,
         p = ctx.Process(target=_child_bootstrap,
                         args=(target, tuple(args), child_env))
         p.start()
-        p.join()
+        try:
+            p.join()
+        except BaseException:
+            # supervisor interrupted (KeyboardInterrupt, an exception in
+            # our own machinery): the child must not outlive us as an
+            # orphan still holding ports/checkpoint locks
+            if p.is_alive():
+                p.terminate()
+                p.join(5)
+                if p.is_alive():
+                    p.kill()
+                    p.join()
+            raise
         codes.append(p.exitcode)
         if p.exitcode == 0:
+            if attempt > 0:
+                append_event("elastic_recovered", restarts=attempt,
+                             exitcodes=codes)
             return ElasticResult(restarts=attempt, exitcodes=tuple(codes))
+        append_event("elastic_worker_exit", attempt=attempt,
+                     exitcode=p.exitcode,
+                     restarts_left=max_restarts - attempt)
         if attempt < max_restarts:
             sleep = backoff_s * (2 ** attempt)
             print(f"# elastic: attempt {attempt} exited "
                   f"{p.exitcode}; relaunching in {sleep:.1f}s "
                   f"({max_restarts - attempt} restart(s) left)", flush=True)
             time.sleep(sleep)
+    append_event("elastic_giveup", attempts=max_restarts + 1,
+                 exitcodes=codes)
     raise WorkerFailure(
         f"worker failed {max_restarts + 1} times "
-        f"(exit codes {codes}); giving up")
+        f"(exit codes {codes}); giving up", exitcode=codes[-1])
 
 
 def elastic_attempt() -> int:

@@ -1,0 +1,254 @@
+"""Deterministic fault injection for the host comm stack.
+
+The watchdog/elastic recovery paths (fail-fast supervision, heartbeats,
+restart-from-checkpoint) existed before this module but were only ever
+exercised by *synthetic* failures (a worker raising on cue). This module
+injects the real thing — a rank hard-dying mid-collective, a stalled
+host, a dropped connection — on a deterministic, test-addressable
+schedule, so the chaos tests in ``tests/test_faults.py`` can assert the
+whole detect → attribute → abort → relaunch → resume story end to end.
+
+Faults are specified via the ``DPX_FAULT`` environment variable (so a
+spawned rank process picks its fault up with zero plumbing) or
+programmatically via :func:`install`. The spec grammar::
+
+    DPX_FAULT = spec [';' spec ...]
+    spec      = action '@' key '=' value [',' key '=' value ...]
+    action    = 'kill' | 'delay' | 'drop_conn'
+    key       = 'step' | 'rank' | 'op' | 'call' | 'ms' | 'attempt'
+
+Examples::
+
+    kill@step=3,rank=1            # rank 1 hard-exits at train step 3
+    delay@op=allreduce,ms=500     # stall every allreduce 500 ms
+    drop_conn@step=2              # sever the comm links at step 2
+    kill@op=allreduce,call=2,rank=1,attempt=0
+        # rank 1 dies entering its 2nd allreduce, but only on elastic
+        # attempt 0 — the relaunch runs clean (the resume-bit-exact test)
+
+Matching semantics (all present keys must match; absent keys match
+everything):
+
+- ``rank``    — the calling rank (passed by the hook call sites).
+- ``op``      — the comm op name; specs carrying ``op`` fire from
+  :func:`on_comm_op` (the :class:`~.native.HostComm` methods call it
+  before every native collective).
+- ``call``    — the Nth (1-based) invocation of that op in this process.
+- ``step``    — the training step; specs *without* ``op`` fire from
+  :func:`on_step` (train loops call it once per step); specs *with*
+  ``op`` use it as an additional filter against the latest step seen.
+- ``attempt`` — the elastic restart attempt (``DPX_ELASTIC_ATTEMPT``),
+  so a fault can be scoped to the first launch only.
+- ``ms``      — the stall duration for ``delay``.
+
+Actions:
+
+- ``kill``      — ``os._exit(KILL_EXIT_CODE)``: a hard death with no
+  cleanup, indistinguishable from a SIGKILL/OOM to everyone else.
+- ``delay``     — sleep ``ms`` milliseconds at the match point (drives a
+  peer's :class:`~.native.CommTimeout` / a stale heartbeat).
+- ``drop_conn`` — abort the native comm links (``HostComm.abort``):
+  peers observe peer-closed, this rank's next op raises.
+
+Everything is deterministic: no randomness, counters only advance at
+hook call sites, and a given (spec, call history) always injects at the
+same point.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+import weakref
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+#: Env var holding the fault spec(s).
+FAULT_ENV = "DPX_FAULT"
+
+#: Exit code of an injected ``kill`` — distinct from real crashes so a
+#: supervisor/test can tell an injected death from an organic one.
+KILL_EXIT_CODE = 43
+
+_ACTIONS = ("kill", "delay", "drop_conn")
+_INT_KEYS = ("step", "rank", "call", "ms", "attempt")
+
+
+@dataclass
+class FaultSpec:
+    action: str
+    step: Optional[int] = None
+    rank: Optional[int] = None
+    op: Optional[str] = None
+    call: Optional[int] = None
+    ms: Optional[int] = None
+    attempt: Optional[int] = None
+    fired: bool = field(default=False, compare=False)
+
+    def matches_rank_attempt(self, rank: Optional[int]) -> bool:
+        # a rank-scoped spec never fires from a hook that cannot say
+        # which rank it is — firing "just in case" would turn a
+        # one-rank kill into a whole-world kill
+        if self.rank is not None and (rank is None or rank != self.rank):
+            return False
+        if self.attempt is not None:
+            cur = int(os.environ.get("DPX_ELASTIC_ATTEMPT", "0"))
+            if cur != self.attempt:
+                return False
+        return True
+
+
+def parse_fault_spec(spec: str) -> List[FaultSpec]:
+    """Parse a ``DPX_FAULT`` string into :class:`FaultSpec` objects.
+
+    Raises ``ValueError`` on malformed input — a typo'd fault spec that
+    silently injects nothing would make a chaos test vacuously green.
+    """
+    out = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        action, _, args = part.partition("@")
+        action = action.strip()
+        if action not in _ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r} (expected one of "
+                f"{_ACTIONS}) in {part!r}")
+        kw: Dict[str, object] = {}
+        for tok in filter(None, (t.strip() for t in args.split(","))):
+            key, eq, val = tok.partition("=")
+            if not eq or key not in _INT_KEYS + ("op",):
+                raise ValueError(f"bad fault key {tok!r} in {part!r}")
+            kw[key] = val if key == "op" else int(val)
+        if action == "delay" and "ms" not in kw:
+            raise ValueError(f"delay fault needs ms= in {part!r}")
+        out.append(FaultSpec(action=action, **kw))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# process-local injection state
+# ---------------------------------------------------------------------------
+
+_specs: Optional[List[FaultSpec]] = None
+_specs_src: Optional[str] = None     # the env/install string _specs parsed
+_op_calls: Dict[str, int] = {}       # op name -> calls seen so far
+_cur_step: Optional[int] = None      # latest step reported via on_step
+_comms: List = []                    # weakrefs to live HostComms
+_log: List[str] = []                 # injection sites that fired (tests)
+
+
+def install(spec: Optional[str]) -> List[FaultSpec]:
+    """Programmatically (re)install fault specs (None/"" clears them).
+    Also exports ``DPX_FAULT`` so spawned children inherit the faults."""
+    global _specs, _specs_src
+    if spec:
+        os.environ[FAULT_ENV] = spec
+    else:
+        os.environ.pop(FAULT_ENV, None)
+    _specs = parse_fault_spec(spec) if spec else []
+    _specs_src = spec or ""
+    return _specs
+
+
+def reset() -> None:
+    """Clear all injection state AND counters (test isolation). Also
+    drops ``DPX_FAULT`` from the environment — otherwise the next hook
+    call would re-parse it and resurrect the specs with fresh (unfired)
+    state."""
+    global _specs, _specs_src, _cur_step
+    os.environ.pop(FAULT_ENV, None)
+    _specs = None
+    _specs_src = None
+    _cur_step = None
+    _op_calls.clear()
+    _comms.clear()
+    _log.clear()
+
+
+def fired() -> List[str]:
+    """Injection sites that fired in this process (newest last)."""
+    return list(_log)
+
+
+def _active() -> List[FaultSpec]:
+    """The live spec list, re-parsed whenever ``DPX_FAULT`` changes."""
+    global _specs, _specs_src
+    env = os.environ.get(FAULT_ENV, "")
+    if _specs is None or env != _specs_src:
+        _specs = parse_fault_spec(env) if env else []
+        _specs_src = env
+    return _specs
+
+
+def register_comm(comm) -> None:
+    """Track a live HostComm so step-scoped ``drop_conn`` can reach it."""
+    _comms.append(weakref.ref(comm))
+
+
+def _live_comms():
+    out = []
+    for ref in list(_comms):
+        c = ref()
+        if c is None:
+            _comms.remove(ref)
+        else:
+            out.append(c)
+    return out
+
+
+def _fire(spec: FaultSpec, site: str, rank: Optional[int], comm) -> None:
+    if spec.action != "delay":
+        spec.fired = True  # kill/drop_conn are one-shot; delay repeats
+    _log.append(f"{spec.action}@{site}")
+    print(f"# fault-injection: {spec.action} firing at {site} "
+          f"(rank {rank})", file=sys.stderr, flush=True)
+    if spec.action == "kill":
+        os._exit(KILL_EXIT_CODE)  # hard death: no cleanup, like SIGKILL
+    elif spec.action == "delay":
+        time.sleep((spec.ms or 0) / 1000.0)
+    elif spec.action == "drop_conn":
+        targets = [comm] if comm is not None else _live_comms()
+        for c in targets:
+            c.abort()
+
+
+def on_comm_op(op: str, rank: Optional[int] = None, comm=None) -> None:
+    """Hook: called by the comm layer before every native collective."""
+    specs = _active()
+    if not specs:
+        return
+    n = _op_calls[op] = _op_calls.get(op, 0) + 1
+    for spec in specs:
+        if spec.op is None or spec.fired:
+            continue
+        if spec.op != op:
+            continue
+        if spec.call is not None and spec.call != n:
+            continue
+        if spec.step is not None and spec.step != _cur_step:
+            continue
+        if not spec.matches_rank_attempt(rank):
+            continue
+        _fire(spec, f"op={op},call={n}", rank, comm)
+
+
+def on_step(step: int, rank: Optional[int] = None) -> None:
+    """Hook: called by training loops once per step (before the step's
+    compute). Fires step-scoped specs and records the step so op-scoped
+    specs can filter on it."""
+    global _cur_step
+    _cur_step = step
+    specs = _active()
+    if not specs:
+        return
+    for spec in specs:
+        if spec.op is not None or spec.fired:
+            continue  # op-scoped specs fire from on_comm_op
+        if spec.step is not None and spec.step != step:
+            continue
+        if not spec.matches_rank_attempt(rank):
+            continue
+        _fire(spec, f"step={step}", rank, None)

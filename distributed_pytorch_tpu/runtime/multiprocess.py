@@ -33,7 +33,7 @@ import traceback
 from typing import Callable
 
 from .launcher import find_free_port
-from .watchdog import (WORKER_TAG_ENV, ProcessSupervisor,
+from .watchdog import (WORKER_TAG_ENV, ProcessSupervisor, WorkerFailure,
                        register_active_tag, unregister_active_tag)
 
 _CHILD_ENV = {
@@ -73,8 +73,17 @@ def _worker_shim(rank: int, world_size: int, master_port: int,
         os.environ["DPX_MASTER_PORT"] = str(master_port)
         os.environ["DPX_MASTER_ADDR"] = "127.0.0.1"
         worker_fn(rank, world_size, *args)
-    except Exception:
-        err_q.put((rank, traceback.format_exc()))
+    except Exception as e:
+        # typed comm failures carry structured attribution (which op,
+        # which peer) — ship it so the supervisor can name the dead rank
+        # even when that rank itself never reported (hard kill)
+        from .native import CommError
+        if isinstance(e, CommError):
+            err_q.put((rank, traceback.format_exc(),
+                       {"kind": type(e).__name__, "op": e.op,
+                        "peer": e.peer}))
+        else:
+            err_q.put((rank, traceback.format_exc()))
         raise
 
 
@@ -127,6 +136,16 @@ def launch_multiprocess(worker_fn: Callable, nprocs: int, *args,
             ProcessSupervisor(procs, err_q, grace_s=grace_s).terminate_all()
             raise
 
-        ProcessSupervisor(procs, err_q, grace_s=grace_s).join()
+        try:
+            ProcessSupervisor(procs, err_q, grace_s=grace_s).join()
+        except WorkerFailure as e:
+            # failure events land in the line-JSON metrics log (path via
+            # DPX_METRICS_LOG) so post-mortems see WHAT died, not just
+            # that the run ended
+            from ..utils.logging import append_event
+            append_event("worker_failure", rank=e.rank, op=e.op,
+                         kind=e.kind, exitcode=e.exitcode, world=nprocs,
+                         tag=tag)
+            raise
     finally:
         unregister_active_tag(tag)

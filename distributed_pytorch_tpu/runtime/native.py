@@ -23,6 +23,54 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libdpxhost.so")
 _lib = None
 _lib_lock = threading.Lock()
 
+#: Env var: per-collective deadline in ms for the native host group
+#: (0 disables). Finite by default — a wedged peer must become a typed
+#: error, never an infinite hang.
+COMM_TIMEOUT_ENV = "DPX_COMM_TIMEOUT_MS"
+DEFAULT_COMM_TIMEOUT_MS = 300_000
+
+#: Native error codes (mirror dpxhost.cpp's constants).
+_RC_PEER_CLOSED = -2
+_RC_TIMEOUT = -3
+_RC_CORRUPT = -4
+
+
+class CommError(RuntimeError):
+    """A native host collective failed.
+
+    Base of the typed failure hierarchy (ISSUE 2): carries enough to
+    *attribute* the failure — which rank raised, which op, and (when the
+    transport could tell) which peer is to blame — so supervisors and
+    elastic restart logic can act on structure instead of grepping
+    message strings.
+    """
+
+    def __init__(self, msg: str, *, op: str = "", rank: int = -1,
+                 peer: int = -1):
+        super().__init__(msg)
+        self.op = op
+        self.rank = rank
+        self.peer = peer
+
+
+class CommPeerDied(CommError):
+    """A peer closed its end mid-collective (orderly close, reset, or
+    the abort-propagation teardown of a failed rank)."""
+
+
+class CommTimeout(CommError):
+    """The per-op deadline (``DPX_COMM_TIMEOUT_MS``) elapsed — the peer
+    is wedged or the link stalled, but nothing closed."""
+
+    def __init__(self, msg: str, *, deadline_ms: int = 0, **kw):
+        super().__init__(msg, **kw)
+        self.deadline_ms = deadline_ms
+
+
+class CommCorrupt(CommError):
+    """A framed quantized payload failed its CRC32 integrity check —
+    transport or codec corruption that must never reach gradients."""
+
 
 def _build() -> None:
     # Build to a per-pid temp path and rename atomically: concurrently
@@ -104,6 +152,14 @@ def load_library():
         lib.dpx_broadcast.restype = ctypes.c_int
         lib.dpx_barrier.argtypes = [ctypes.c_void_p]
         lib.dpx_barrier.restype = ctypes.c_int
+        lib.dpx_set_timeout_ms.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.dpx_set_timeout_ms.restype = None
+        lib.dpx_get_timeout_ms.argtypes = [ctypes.c_void_p]
+        lib.dpx_get_timeout_ms.restype = ctypes.c_int
+        lib.dpx_last_error_peer.argtypes = [ctypes.c_void_p]
+        lib.dpx_last_error_peer.restype = ctypes.c_int
+        lib.dpx_comm_abort.argtypes = [ctypes.c_void_p]
+        lib.dpx_comm_abort.restype = None
         _lib = lib
         return lib
 
@@ -120,16 +176,19 @@ class HostComm:
     _OPS = {"sum": 0, "max": 1, "min": 2}
 
     def __init__(self, master_addr: str, base_port: int, rank: int,
-                 world: int, timeout_ms: int = 30000):
+                 world: int, timeout_ms: int = 30000,
+                 op_timeout_ms: Optional[int] = None):
         import socket as _socket
 
         # late imports: runtime/__init__ imports this module eagerly, and
         # comm/__init__ imports runtime.context — binding here (after all
         # packages finished loading) avoids the cycle
+        from . import faults as _faults
         from ..comm import wire as _wire
         from ..utils.profiler import CommStats
 
         self._wire = _wire
+        self._faults = _faults
         self.stats = CommStats()
         self._lib = load_library()
         # the native layer takes dotted-quad only; resolve hostnames (e.g.
@@ -138,16 +197,34 @@ class HostComm:
         self._h = self._lib.dpx_comm_init(
             addr.encode(), base_port, rank, world, timeout_ms)
         if not self._h:
-            raise RuntimeError(
+            raise CommError(
                 f"native rendezvous failed (rank {rank}/{world} on "
-                f"{master_addr}:{base_port})")
+                f"{master_addr}:{base_port})", op="init", rank=rank)
+        if op_timeout_ms is None:
+            try:
+                op_timeout_ms = int(os.environ.get(
+                    COMM_TIMEOUT_ENV, DEFAULT_COMM_TIMEOUT_MS))
+            except ValueError:
+                op_timeout_ms = DEFAULT_COMM_TIMEOUT_MS
+        self._lib.dpx_set_timeout_ms(self._h, op_timeout_ms)
+        self.op_timeout_ms = op_timeout_ms
         self.rank = rank
         self.world = world
+        _faults.register_comm(self)
 
     def close(self):
         if self._h:
             self._lib.dpx_comm_destroy(self._h)
             self._h = None
+
+    def abort(self):
+        """Tear down every link of this comm NOW (without destroying the
+        handle): blocked peers observe peer-closed within one deadline
+        tick, and every later op on this comm raises :class:`CommError`.
+        Called on local failure (abort propagation) and by fault
+        injection's ``drop_conn``."""
+        if self._h:
+            self._lib.dpx_comm_abort(self._h)
 
     def __del__(self):
         try:
@@ -155,9 +232,31 @@ class HostComm:
         except Exception:
             pass
 
+    def _pre_op(self, op: str):
+        """Fault-injection hook: consulted before every native call."""
+        self._faults.on_comm_op(op, rank=self.rank, comm=self)
+
     def _check(self, rc: int, what: str):
-        if rc != 0:
-            raise RuntimeError(f"native {what} failed (rank {self.rank})")
+        if rc == 0:
+            return
+        peer = self._lib.dpx_last_error_peer(self._h) if self._h else -1
+        where = f"(rank {self.rank}, op {what}"
+        where += f", peer {peer})" if peer >= 0 else ")"
+        if rc == _RC_PEER_CLOSED:
+            raise CommPeerDied(
+                f"peer closed connection mid-collective {where}",
+                op=what, rank=self.rank, peer=peer)
+        if rc == _RC_TIMEOUT:
+            raise CommTimeout(
+                f"deadline {self.op_timeout_ms}ms exceeded {where}",
+                op=what, rank=self.rank, peer=peer,
+                deadline_ms=self.op_timeout_ms)
+        if rc == _RC_CORRUPT:
+            raise CommCorrupt(
+                f"framed quant payload failed CRC32 {where}",
+                op=what, rank=self.rank, peer=peer)
+        raise CommError(f"native {what} failed {where} rc={rc}",
+                        op=what, rank=self.rank, peer=peer)
 
     def allreduce(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
         """In-place ring allreduce on a float32/float64 array.
@@ -168,6 +267,7 @@ class HostComm:
         """
         if op not in self._OPS:
             raise ValueError(f"allreduce op must be sum|max|min, got {op!r}")
+        self._pre_op("allreduce")
         arr = np.ascontiguousarray(arr)
         code = self._OPS[op]
         nbytes = self._wire.ring_allreduce_wire_bytes(
@@ -198,6 +298,7 @@ class HostComm:
         ranks. ~4x less wire traffic than :meth:`allreduce`."""
         block = block or self._wire.QUANT_BLOCK
         chunk_blocks = chunk_blocks or self._wire.QUANT_CHUNK_BLOCKS
+        self._pre_op("allreduce_q8")
         arr = np.ascontiguousarray(arr, dtype=np.float32)
         nbytes = self._wire.quant_ring_allreduce_wire_bytes(
             arr.size, self.world, block) // max(self.world, 1)
@@ -210,6 +311,7 @@ class HostComm:
 
     def reduce(self, arr: np.ndarray) -> np.ndarray:
         """Rooted sum to rank 0 (non-root buffers unchanged)."""
+        self._pre_op("reduce")
         arr = np.ascontiguousarray(arr, dtype=np.float32)
         with self.stats.timed("reduce", arr.nbytes):
             rc = self._lib.dpx_reduce_f32(
@@ -220,6 +322,7 @@ class HostComm:
 
     def gather(self, arr: np.ndarray) -> Optional[list]:
         """Rooted gather to rank 0: returns the list there, None elsewhere."""
+        self._pre_op("gather")
         arr = np.ascontiguousarray(arr)
         nbytes = arr.nbytes
         with self.stats.timed("gather", nbytes):
@@ -246,6 +349,7 @@ class HostComm:
         return self.broadcast(stacked, src=0)
 
     def broadcast(self, arr: np.ndarray, src: int = 0) -> np.ndarray:
+        self._pre_op("broadcast")
         arr = np.ascontiguousarray(arr)
         with self.stats.timed("broadcast", arr.nbytes):
             rc = self._lib.dpx_broadcast(
@@ -255,6 +359,7 @@ class HostComm:
         return arr
 
     def barrier(self):
+        self._pre_op("barrier")
         with self.stats.timed("barrier", 4):
             rc = self._lib.dpx_barrier(self._h)
         self._check(rc, "barrier")

@@ -54,8 +54,24 @@ def active_tags() -> frozenset:
 
 
 class WorkerFailure(RuntimeError):
-    """A worker process exited abnormally (carries rank + traceback when
-    the worker managed to report one)."""
+    """A worker process exited abnormally.
+
+    Structured attribution (ISSUE 2): ``rank`` is the rank attributed as
+    the failure ORIGIN — the reporting rank for an in-worker exception,
+    or the peer blamed by the survivors' typed
+    :class:`~.native.CommError` reports when the origin died without a
+    word (hard kill / OOM). ``op`` is the collective in flight, ``kind``
+    the CommError subclass name, ``exitcode`` the first abnormal exit.
+    """
+
+    def __init__(self, msg: str, *, rank: Optional[int] = None,
+                 op: Optional[str] = None, kind: Optional[str] = None,
+                 exitcode: Optional[int] = None):
+        super().__init__(msg)
+        self.rank = rank
+        self.op = op
+        self.kind = kind
+        self.exitcode = exitcode
 
 
 class ProcessSupervisor:
@@ -68,11 +84,23 @@ class ProcessSupervisor:
     """
 
     def __init__(self, procs: Sequence, err_q=None, grace_s: float = 5.0,
-                 poll_s: float = 0.05):
+                 poll_s: float = 0.05, settle_s: Optional[float] = None):
         self.procs = list(procs)
         self.err_q = err_q
         self.grace_s = grace_s
         self.poll_s = poll_s
+        if settle_s is None:
+            # Survivors of a comm failure fail ON THEIR OWN almost
+            # immediately (abort propagation: the dead rank's closed
+            # sockets cascade peer-closed around the ring,
+            # native/dpxhost.cpp) and their typed reports carry the
+            # attribution. Give them a short window before SIGTERM.
+            # Flat, not deadline-scaled: a peer that never exits (hung
+            # in compute, not comms) must still be swept in seconds —
+            # the window only fully elapses when someone is NOT dying
+            # on their own.
+            settle_s = 5.0
+        self.settle_s = settle_s
 
     def _first_failure(self) -> Optional[int]:
         for p in self.procs:
@@ -82,10 +110,16 @@ class ProcessSupervisor:
         return None
 
     def _drain_errors(self) -> List:
+        """Normalized to (rank, traceback, meta) — workers report plain
+        exceptions as 2-tuples and typed comm failures as 3-tuples with a
+        {kind, op, peer} meta dict (runtime/multiprocess._worker_shim)."""
         out = []
         if self.err_q is not None:
             while not self.err_q.empty():
-                out.append(self.err_q.get())
+                item = self.err_q.get()
+                if len(item) == 2:
+                    item = (item[0], item[1], {})
+                out.append(item)
         return out
 
     def terminate_all(self) -> None:
@@ -111,14 +145,59 @@ class ProcessSupervisor:
         code = self._first_failure()
         if code is None:
             return
+        # settle window: let survivors hit their own typed comm errors
+        # and report attribution before the SIGTERM sweep
+        deadline = time.monotonic() + self.settle_s
+        while (time.monotonic() < deadline
+               and any(p.exitcode is None for p in self.procs)):
+            time.sleep(self.poll_s)
         self.terminate_all()
         failures = self._drain_errors()
+
+        # Attribution: a comm-failure meta from any worker names the op
+        # in flight. Abort propagation cascades around the ring (each
+        # survivor blames its own upstream neighbor), so the rank that
+        # DIED is the one that got blamed but never reported a comm
+        # error of its own — it exited without a word (hard kill / OOM).
+        metas = [(r, m) for r, _, m in failures if m]
+        op = next((m["op"] for _, m in metas if m.get("op")), None)
+        kind = next((m["kind"] for _, m in metas if m.get("kind")), None)
+        blamed = sorted({m["peer"] for _, m in metas
+                         if m.get("peer", -1) is not None
+                         and m.get("peer", -1) >= 0})
+        reporters = {r for r, _, _ in failures}
+        silent = [b for b in blamed if b not in reporters]
+
         if failures:
-            rank, tb = failures[0]
-            raise WorkerFailure(f"worker process (rank {rank}) failed:\n{tb}")
+            rank, tb, _ = failures[0]
+            # origin preference: a blamed rank that never reported (died
+            # silently) > a rank blamed by a CommTimeout (the direct
+            # observation of a wedge — peer-closed blames are just the
+            # abort cascade) > lowest blamed > the first reporter
+            timeout_blamed = sorted(
+                {m["peer"] for _, m in metas
+                 if m.get("kind") == "CommTimeout"
+                 and m.get("peer", -1) is not None
+                 and m.get("peer", -1) >= 0})
+            origin = (silent[0] if silent
+                      else timeout_blamed[0] if timeout_blamed
+                      else blamed[0] if blamed else rank)
+            msg = f"worker process (rank {rank}) failed:\n{tb}"
+            if blamed:
+                o_kind = next((m["kind"] for _, m in metas
+                               if m.get("peer") == origin
+                               and m.get("kind")), kind)
+                msg = (f"worker rank {origin} died during op {op!r} "
+                       f"({o_kind} reported by rank"
+                       f"{'s' if len(metas) > 1 else ''} "
+                       f"{sorted(r for r, _ in metas)}); first report:\n"
+                       + tb)
+                kind = o_kind
+            raise WorkerFailure(msg, rank=origin, op=op, kind=kind,
+                                exitcode=code)
         raise WorkerFailure(
             f"worker process exited abnormally (exit code {code}); "
-            "remaining workers were terminated")
+            "remaining workers were terminated", exitcode=code)
 
 
 # ---------------------------------------------------------------------------

@@ -5,11 +5,37 @@ ours keeps that surface and adds an optional structured logger)."""
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 from typing import Any, Dict, Optional
 
 from ..runtime import context
+
+#: Env var: when set, structured EVENTS (worker failures, elastic
+#: relaunches) are appended to this line-JSON file regardless of rank —
+#: the supervisor processes that emit them are not ranks at all.
+METRICS_LOG_ENV = "DPX_METRICS_LOG"
+
+
+def append_event(event: str, path: Optional[str] = None, **fields: Any
+                 ) -> bool:
+    """Append one ``{"event": ..., "time": ...}`` line-JSON record.
+
+    ``path`` defaults to ``$DPX_METRICS_LOG``; silently a no-op when
+    neither is set (callers are supervision hot paths — observability
+    must never take down recovery). Returns whether a line was written.
+    """
+    path = path or os.environ.get(METRICS_LOG_ENV)
+    if not path:
+        return False
+    rec = {"event": event, "time": time.time(), **fields}
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(rec, default=str) + "\n")
+        return True
+    except OSError:
+        return False
 
 
 def is_primary() -> bool:
@@ -44,6 +70,21 @@ class MetricsLogger:
         if self._fh is not None:
             self._fh.write(line + "\n")
             self._fh.flush()
+        if self.echo:
+            print(line, file=sys.stdout)
+
+    def event(self, event: str, **fields: Any) -> None:
+        """Structured non-step event (failure, relaunch, resume) into the
+        same line-JSON stream; written on EVERY rank — failures are
+        precisely the records the primary may not live to write."""
+        rec: Dict[str, Any] = {"event": event, "time": time.time(),
+                               **fields}
+        line = json.dumps(rec, default=str)
+        if self._fh is not None:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        elif self.path is not None:
+            append_event(event, path=self.path, **fields)
         if self.echo:
             print(line, file=sys.stdout)
 

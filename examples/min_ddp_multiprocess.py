@@ -47,6 +47,7 @@ def main_worker(rank, world_size, argv=None):
                                               ShardedSampler)
     from distributed_pytorch_tpu.ops.losses import cross_entropy_per_example
     from distributed_pytorch_tpu.parallel import make_train_step
+    from distributed_pytorch_tpu.runtime import faults
 
     is_distributed = world_size > 1
     if is_distributed:
@@ -92,6 +93,9 @@ def main_worker(rank, world_size, argv=None):
                       else np.arange(len(dataset)))
         n_steps = int(np.ceil(len(idx_stream) / args.batch_size))
         for it in range(n_steps):
+            # fault-injection step hook (DPX_FAULT — no-op when unset):
+            # this loop is the chaos-test target for killed/stalled ranks
+            faults.on_step(epoch * n_steps + it, rank=rank)
             sel = idx_stream[it * args.batch_size:(it + 1) * args.batch_size]
             x = jnp.asarray(dataset.data[sel])
             y = jnp.asarray(dataset.labels[sel])

@@ -38,11 +38,23 @@
 //     Rooted ops stay reference-exact full-width — the quantized format
 //     is never applied to them.
 //
+// Failure semantics (ISSUE 2): every collective observes a per-op
+// deadline (DPX_COMM_TIMEOUT_MS / dpx_set_timeout_ms; poll-based I/O,
+// never an unbounded block) and returns a DISTINCT error code —
+// peer-closed (-2), deadline-exceeded (-3), corrupt quant frame (-4,
+// CRC32-checked). On any local failure the comm tears down all of its
+// links (abort propagation): peers observe POLLHUP/EOF and fail within
+// one deadline tick instead of deadlocking on the dead rank. The blamed
+// peer rank is queryable via dpx_last_error_peer. Python maps the codes
+// onto a typed exception hierarchy (runtime/native.py); docs/failures.md
+// has the full detect -> attribute -> abort -> relaunch -> resume story.
+//
 // C ABI only (ctypes-friendly); no exceptions cross the boundary.
 
 #include <arpa/inet.h>
 #include <cerrno>
 #include <cmath>
+#include <ctime>
 #include <poll.h>
 #if defined(__SSE2__)
 #include <emmintrin.h>
@@ -64,39 +76,95 @@ constexpr uint32_t kMagic = 0xD17C0DE5u;
 constexpr uint32_t kPurposeHub = 1;
 constexpr uint32_t kPurposeRing = 2;
 
+// Error codes crossing the C ABI (runtime/native.py maps these onto the
+// typed CommError hierarchy). Distinct codes because the recovery story
+// differs: a dead peer is attributable and worth an immediate elastic
+// relaunch; a deadline hit may be a wedged-but-alive host; a corrupt
+// frame is a transport/codec bug that must never be silently averaged
+// into gradients.
+constexpr int kOk = 0;
+constexpr int kErr = -1;           // generic local failure / aborted comm
+constexpr int kErrPeerClosed = -2; // orderly or reset close from the peer
+constexpr int kErrTimeout = -3;    // per-op deadline exceeded
+constexpr int kErrCorrupt = -4;    // framed quant payload failed CRC32
+
 struct Handshake {
   uint32_t magic;
   uint32_t purpose;
   uint32_t rank;
 };
 
-int write_all(int fd, const void* buf, size_t n) {
+int64_t now_ms() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+// deadline < 0 means "no deadline"; returns the poll() timeout argument
+// for the remaining budget (0 once expired — poll returns immediately
+// and the caller reports kErrTimeout).
+int poll_budget(int64_t deadline) {
+  if (deadline < 0) return -1;
+  int64_t left = deadline - now_ms();
+  if (left <= 0) return 0;
+  return left > 1000000000 ? 1000000000 : static_cast<int>(left);
+}
+
+// Every blocking primitive below observes an absolute CLOCK_MONOTONIC
+// deadline: the socket stays in blocking mode but all transfers go
+// through poll + MSG_DONTWAIT, so a wedged peer costs at most the
+// remaining budget instead of hanging the collective forever.
+int write_all(int fd, const void* buf, size_t n, int64_t deadline) {
+  if (fd < 0) return kErr;
   const char* p = static_cast<const char*>(buf);
   while (n > 0) {
-    ssize_t w = ::write(fd, p, n);
-    if (w < 0) {
+    // absolute expiry check: a peer trickling a few bytes per wakeup
+    // keeps poll() reporting readiness, which must not extend the op
+    // past its deadline
+    if (deadline >= 0 && now_ms() > deadline) return kErrTimeout;
+    pollfd pfd{fd, POLLOUT, 0};
+    int pr = ::poll(&pfd, 1, poll_budget(deadline));
+    if (pr < 0) {
       if (errno == EINTR) continue;
-      return -1;
+      return kErr;
+    }
+    if (pr == 0) return kErrTimeout;
+    ssize_t w = ::send(fd, p, n, MSG_DONTWAIT | MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK)
+        continue;
+      return (errno == EPIPE || errno == ECONNRESET) ? kErrPeerClosed
+                                                     : kErr;
     }
     p += w;
     n -= static_cast<size_t>(w);
   }
-  return 0;
+  return kOk;
 }
 
-int read_all(int fd, void* buf, size_t n) {
+int read_all(int fd, void* buf, size_t n, int64_t deadline) {
+  if (fd < 0) return kErr;
   char* p = static_cast<char*>(buf);
   while (n > 0) {
-    ssize_t r = ::read(fd, p, n);
-    if (r < 0) {
+    if (deadline >= 0 && now_ms() > deadline) return kErrTimeout;
+    pollfd pfd{fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, poll_budget(deadline));
+    if (pr < 0) {
       if (errno == EINTR) continue;
-      return -1;
+      return kErr;
     }
-    if (r == 0) return -1;  // peer closed
+    if (pr == 0) return kErrTimeout;
+    ssize_t r = ::recv(fd, p, n, MSG_DONTWAIT);
+    if (r < 0) {
+      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK)
+        continue;
+      return (errno == ECONNRESET) ? kErrPeerClosed : kErr;
+    }
+    if (r == 0) return kErrPeerClosed;
     p += r;
     n -= static_cast<size_t>(r);
   }
-  return 0;
+  return kOk;
 }
 
 int set_nodelay(int fd) {
@@ -133,15 +201,61 @@ struct Comm {
   int hub_fd = -1;           // rank > 0: link to rank 0
   int ring_send_fd = -1;     // to (rank+1) % world
   int ring_recv_fd = -1;     // from (rank-1+world) % world
+  int op_timeout_ms = 0;     // per-collective deadline; <= 0 = no deadline
+  bool aborted = false;      // a failed op tore the links down
+  int err_peer = -1;         // peer rank blamed for the last failure
 };
+
+void close_quiet(int* fd) {
+  if (*fd >= 0) {
+    // shutdown first: a peer BLOCKED in poll/recv on this link sees
+    // POLLHUP/EOF immediately, even if some other handle still holds
+    // the descriptor open
+    ::shutdown(*fd, SHUT_RDWR);
+    ::close(*fd);
+    *fd = -1;
+  }
+}
+
+// Abort propagation: on any local op failure the comm tears down ALL of
+// its links (ring + hub + listener). Every peer blocked on this rank then
+// observes peer-closed within one poll wakeup instead of waiting out its
+// own full deadline — one dead rank fails the world in ~one deadline tick.
+void comm_abort(Comm* c) {
+  c->aborted = true;
+  close_quiet(&c->listen_fd);
+  close_quiet(&c->hub_fd);
+  close_quiet(&c->ring_send_fd);
+  close_quiet(&c->ring_recv_fd);
+  for (int& fd : c->hub_fds) close_quiet(&fd);
+}
+
+int comm_fail(Comm* c, int code, int peer) {
+  c->err_peer = peer;
+  comm_abort(c);
+  return code;
+}
+
+int64_t op_deadline(const Comm* c) {
+  return c->op_timeout_ms > 0 ? now_ms() + c->op_timeout_ms : -1;
+}
 
 // Full-duplex bounded exchange: send `sn` bytes while receiving `rn` bytes,
 // interleaved via poll, so simultaneous ring sends can never deadlock on
-// full kernel buffers.
+// full kernel buffers. Observes `deadline`; on failure returns the error
+// code and sets *blame to the offending ring direction (+1 = the send
+// peer, -1 = the recv peer).
 int send_recv(int send_fd, const char* sbuf, size_t sn, int recv_fd,
-              char* rbuf, size_t rn) {
+              char* rbuf, size_t rn, int64_t deadline, int* blame) {
+  *blame = -1;
+  if (send_fd < 0 || recv_fd < 0) return kErr;
   size_t so = 0, ro = 0;
   while (so < sn || ro < rn) {
+    // absolute expiry: trickling progress must not extend the deadline
+    if (deadline >= 0 && now_ms() > deadline) {
+      *blame = (ro < rn) ? -1 : +1;
+      return kErrTimeout;
+    }
     pollfd fds[2];
     int nf = 0;
     int si = -1, ri = -1;
@@ -153,25 +267,143 @@ int send_recv(int send_fd, const char* sbuf, size_t sn, int recv_fd,
       fds[nf] = {recv_fd, POLLIN, 0};
       ri = nf++;
     }
-    if (::poll(fds, static_cast<nfds_t>(nf), -1) < 0) {
+    int pr = ::poll(fds, static_cast<nfds_t>(nf), poll_budget(deadline));
+    if (pr < 0) {
       if (errno == EINTR) continue;
-      return -1;
+      return kErr;
+    }
+    if (pr == 0) {
+      // deadline: blame whichever direction is still incomplete (the
+      // recv side when both are — the peer we are waiting ON)
+      *blame = (ro < rn) ? -1 : +1;
+      return kErrTimeout;
     }
     if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
-      ssize_t w = ::send(send_fd, sbuf + so, sn - so, MSG_DONTWAIT);
-      if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
-        return -1;
+      ssize_t w = ::send(send_fd, sbuf + so, sn - so,
+                         MSG_DONTWAIT | MSG_NOSIGNAL);
+      if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK
+          && errno != EINTR) {
+        *blame = +1;
+        return (errno == EPIPE || errno == ECONNRESET) ? kErrPeerClosed
+                                                       : kErr;
+      }
       if (w > 0) so += static_cast<size_t>(w);
     }
     if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
       ssize_t r = ::recv(recv_fd, rbuf + ro, rn - ro, MSG_DONTWAIT);
-      if (r == 0) return -1;
-      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
-        return -1;
+      if (r == 0) return kErrPeerClosed;
+      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK
+          && errno != EINTR)
+        return (errno == ECONNRESET) ? kErrPeerClosed : kErr;
       if (r > 0) ro += static_cast<size_t>(r);
     }
   }
-  return 0;
+  return kOk;
+}
+
+// Ring wrapper: translates a send_recv failure into err_peer (the ring
+// neighbors are the only possible culprits) and tears the comm down so
+// the failure propagates.
+int ring_xfer(Comm* c, const char* sbuf, size_t sn, char* rbuf, size_t rn,
+              int64_t deadline) {
+  int blame = -1;
+  int rc = send_recv(c->ring_send_fd, sbuf, sn, c->ring_recv_fd, rbuf, rn,
+                     deadline, &blame);
+  if (rc != kOk) {
+    int peer = (blame > 0) ? (c->rank + 1) % c->world
+                           : (c->rank - 1 + c->world) % c->world;
+    return comm_fail(c, rc, peer);
+  }
+  return kOk;
+}
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli polynomial) — integrity check on framed quant
+// payloads. The exact f32/f64 ring is NOT checksummed (TCP's own check
+// plus bit-parity tests cover it); the quant path gets an end-to-end
+// check because a corrupt scale would silently poison whole blocks.
+// Castagnoli because x86 has a dedicated instruction for it (SSE4.2
+// crc32, ~an order of magnitude faster than table code — the check must
+// cost <1% of the quant ring's step, see the dp8 comm bench); a
+// slice-by-4 table fallback computes the identical value on CPUs
+// without it, so mixed fleets still agree on every frame.
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kCrcPoly = 0x82F63B78u;  // CRC32C, reflected
+
+struct CrcTables {
+  uint32_t t[4][256];
+  CrcTables() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? kCrcPoly ^ (c >> 1) : c >> 1;
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++) {
+      t[1][i] = (t[0][i] >> 8) ^ t[0][t[0][i] & 0xFF];
+      t[2][i] = (t[1][i] >> 8) ^ t[0][t[1][i] & 0xFF];
+      t[3][i] = (t[2][i] >> 8) ^ t[0][t[2][i] & 0xFF];
+    }
+  }
+};
+
+uint32_t crc32_sw(const unsigned char* p, size_t n) {
+  static const CrcTables tbl;
+  uint32_t c = 0xFFFFFFFFu;
+  while (n >= 4) {
+    c ^= static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8)
+         | (static_cast<uint32_t>(p[2]) << 16)
+         | (static_cast<uint32_t>(p[3]) << 24);
+    c = tbl.t[3][c & 0xFF] ^ tbl.t[2][(c >> 8) & 0xFF]
+        ^ tbl.t[1][(c >> 16) & 0xFF] ^ tbl.t[0][c >> 24];
+    p += 4;
+    n -= 4;
+  }
+  while (n--) c = tbl.t[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2")))
+uint32_t crc32_hw(const unsigned char* p, size_t n) {
+  uint64_t c = 0xFFFFFFFFu;
+  while (n >= 8) {
+    uint64_t v;
+    memcpy(&v, p, 8);
+    c = __builtin_ia32_crc32di(c, v);
+    p += 8;
+    n -= 8;
+  }
+  uint32_t c32 = static_cast<uint32_t>(c);
+  while (n--) c32 = __builtin_ia32_crc32qi(c32, *p++);
+  return c32 ^ 0xFFFFFFFFu;
+}
+
+bool crc32_have_hw() {
+  __builtin_cpu_init();
+  return __builtin_cpu_supports("sse4.2");
+}
+#endif
+
+uint32_t crc32_of(const void* data, size_t n) {
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+#if defined(__x86_64__)
+  static const bool hw = crc32_have_hw();
+  if (hw) return crc32_hw(p, n);
+#endif
+  return crc32_sw(p, n);
+}
+
+void crc32_append(char* frame, size_t payload) {
+  uint32_t crc = crc32_of(frame, payload);
+  memcpy(frame + payload, &crc, 4);
+}
+
+bool crc32_check(const char* frame, size_t payload) {
+  uint32_t got;
+  memcpy(&got, frame + payload, 4);
+  return got == crc32_of(frame, payload);
 }
 
 int listen_on(int port) {
@@ -203,6 +435,12 @@ void* dpx_comm_init(const char* master_addr, int base_port, int rank,
   Comm* c = new Comm();
   c->rank = rank;
   c->world = world;
+  // Per-op deadline default: DPX_COMM_TIMEOUT_MS (0 or unset-parse-fail
+  // = no deadline, the pre-robustness behavior). Python callers normally
+  // override via dpx_set_timeout_ms; the env read here keeps raw C users
+  // and mixed-version bindings on the same default.
+  if (const char* env = getenv("DPX_COMM_TIMEOUT_MS"))
+    c->op_timeout_ms = atoi(env);
   if (world == 1) return c;
 
   c->listen_fd = listen_on(base_port + rank);
@@ -211,33 +449,45 @@ void* dpx_comm_init(const char* master_addr, int base_port, int rank,
     return nullptr;
   }
 
-  // Outbound links (retry until peers are listening):
-  if (rank != 0) {
-    c->hub_fd = connect_with_retry(master_addr, base_port, timeout_ms);
-    if (c->hub_fd < 0) goto fail;
-    Handshake h{kMagic, kPurposeHub, static_cast<uint32_t>(rank)};
-    if (write_all(c->hub_fd, &h, sizeof(h)) != 0) goto fail;
-  }
   {
-    int next = (rank + 1) % world;
-    c->ring_send_fd = connect_with_retry(master_addr, base_port + next,
-                                         timeout_ms);
-    if (c->ring_send_fd < 0) goto fail;
-    Handshake h{kMagic, kPurposeRing, static_cast<uint32_t>(rank)};
-    if (write_all(c->ring_send_fd, &h, sizeof(h)) != 0) goto fail;
-  }
+    // rendezvous bookkeeping shares one absolute deadline with the
+    // connect retries: a peer that connects but never completes its
+    // handshake can no longer wedge init forever
+    int64_t dl = now_ms() + (timeout_ms > 0 ? timeout_ms : 30000);
 
-  // Inbound links: rank 0 expects world-1 hub conns; everyone expects one
-  // ring conn from the previous rank.
-  {
+    // Outbound links (retry until peers are listening):
+    if (rank != 0) {
+      c->hub_fd = connect_with_retry(master_addr, base_port, timeout_ms);
+      if (c->hub_fd < 0) goto fail;
+      Handshake h{kMagic, kPurposeHub, static_cast<uint32_t>(rank)};
+      if (write_all(c->hub_fd, &h, sizeof(h), dl) != 0) goto fail;
+    }
+    {
+      int next = (rank + 1) % world;
+      c->ring_send_fd = connect_with_retry(master_addr, base_port + next,
+                                           timeout_ms);
+      if (c->ring_send_fd < 0) goto fail;
+      Handshake h{kMagic, kPurposeRing, static_cast<uint32_t>(rank)};
+      if (write_all(c->ring_send_fd, &h, sizeof(h), dl) != 0) goto fail;
+    }
+
+    // Inbound links: rank 0 expects world-1 hub conns; everyone expects
+    // one ring conn from the previous rank.
     int expect = (rank == 0) ? world - 1 + 1 : 1;
     c->hub_fds.assign(static_cast<size_t>(world), -1);
     for (int i = 0; i < expect; i++) {
+      pollfd pfd{c->listen_fd, POLLIN, 0};
+      int pr = ::poll(&pfd, 1, poll_budget(dl));
+      if (pr < 0 && errno == EINTR) {
+        i--;
+        continue;
+      }
+      if (pr <= 0) goto fail;  // error, or rendezvous deadline expired
       int fd = ::accept(c->listen_fd, nullptr, nullptr);
       if (fd < 0) goto fail;
       set_nodelay(fd);
       Handshake h{};
-      if (read_all(fd, &h, sizeof(h)) != 0 || h.magic != kMagic) {
+      if (read_all(fd, &h, sizeof(h), dl) != 0 || h.magic != kMagic) {
         ::close(fd);
         goto fail;
       }
@@ -277,6 +527,28 @@ void dpx_comm_destroy(void* handle) {
 int dpx_rank(void* handle) { return static_cast<Comm*>(handle)->rank; }
 int dpx_world(void* handle) { return static_cast<Comm*>(handle)->world; }
 
+// Per-op deadline (ms) for every collective on this comm; <= 0 disables.
+void dpx_set_timeout_ms(void* handle, int ms) {
+  static_cast<Comm*>(handle)->op_timeout_ms = ms;
+}
+int dpx_get_timeout_ms(void* handle) {
+  return static_cast<Comm*>(handle)->op_timeout_ms;
+}
+
+// Peer rank blamed for the most recent failed op (-1 when unknown —
+// e.g. a local error or no failure yet).
+int dpx_last_error_peer(void* handle) {
+  return static_cast<Comm*>(handle)->err_peer;
+}
+
+// Deliberately tear the comm's links down (fault injection's drop_conn,
+// and the bindings' explicit abort on local failure): peers observe
+// peer-closed within one deadline tick; every later op on THIS handle
+// fails fast with kErr.
+void dpx_comm_abort(void* handle) {
+  comm_abort(static_cast<Comm*>(handle));
+}
+
 // Elementwise reduce ops for the full-width ring (kOpSum matches the
 // original sum-only ring bit-for-bit).
 enum { kOpSum = 0, kOpMax = 1, kOpMin = 2 };
@@ -304,7 +576,9 @@ DPX_REDUCE_INTO(reduce_into_f64, double)
 static int ring_allreduce(Comm* c, char* data, int64_t n, int elem_size,
                           int op) {
   if (c->world == 1) return 0;
+  if (c->aborted) return kErr;
   const int w = c->world;
+  const int64_t deadline = op_deadline(c);
   const int64_t chunk = (n + w - 1) / w;  // elements per segment (last ragged)
   std::vector<char> recv_buf(static_cast<size_t>(chunk) * elem_size);
 
@@ -322,10 +596,11 @@ static int ring_allreduce(Comm* c, char* data, int64_t n, int elem_size,
     int send_seg = (c->rank - step + w) % w;
     int recv_seg = (c->rank - step - 1 + w) % w;
     int64_t slen = seg_len(send_seg), rlen = seg_len(recv_seg);
-    if (send_recv(c->ring_send_fd, seg_ptr(send_seg),
-                  static_cast<size_t>(slen) * elem_size, c->ring_recv_fd,
-                  recv_buf.data(), static_cast<size_t>(rlen) * elem_size) != 0)
-      return -1;
+    int rc = ring_xfer(c, seg_ptr(send_seg),
+                       static_cast<size_t>(slen) * elem_size,
+                       recv_buf.data(),
+                       static_cast<size_t>(rlen) * elem_size, deadline);
+    if (rc != kOk) return rc;
     if (elem_size == 4) {
       reduce_into_f32(reinterpret_cast<float*>(seg_ptr(recv_seg)),
                       reinterpret_cast<const float*>(recv_buf.data()), rlen,
@@ -341,13 +616,13 @@ static int ring_allreduce(Comm* c, char* data, int64_t n, int elem_size,
     int send_seg = (c->rank + 1 - step + w) % w;
     int recv_seg = (c->rank - step + w) % w;
     int64_t slen = seg_len(send_seg), rlen = seg_len(recv_seg);
-    if (send_recv(c->ring_send_fd, seg_ptr(send_seg),
-                  static_cast<size_t>(slen) * elem_size, c->ring_recv_fd,
-                  seg_ptr(recv_seg),
-                  static_cast<size_t>(rlen) * elem_size) != 0)
-      return -1;
+    int rc = ring_xfer(c, seg_ptr(send_seg),
+                       static_cast<size_t>(slen) * elem_size,
+                       seg_ptr(recv_seg),
+                       static_cast<size_t>(rlen) * elem_size, deadline);
+    if (rc != kOk) return rc;
   }
-  return 0;
+  return kOk;
 }
 
 int dpx_allreduce_f32(void* handle, float* data, int64_t n) {
@@ -505,12 +780,15 @@ struct QGrid {
 // One pipelined hop: stream `send` (blocks [sb0, sb0+snb) quantized from
 // `data`, or pre-encoded bytes from `fwd`) while receiving the peer's
 // framed chunks into `acc`/`keep`, chunk_blocks blocks at a time.
-// Receiving side dequantizes into data (accumulate or assign); when
-// `keep` != null the raw received bytes are also stored for forwarding
-// next hop (all-gather leg).
+// Receiving side CRC-verifies then dequantizes into data (accumulate or
+// assign); when `keep` != null the raw received bytes (frame + CRC) are
+// also stored for forwarding next hop (all-gather leg). Every chunk
+// frame is [scales][int8 payload][CRC32 of the preceding bytes]; the
+// all-gather leg forwards frames byte-for-byte, so the owner's CRC
+// travels the whole ring and every hop re-verifies end to end.
 int q8_hop(Comm* c, const QGrid& g, float* data, int chunk_blocks,
            int send_seg, const char* fwd, int recv_seg, bool assign,
-           char* sbuf, char* rbuf, char* keep) {
+           char* sbuf, char* rbuf, char* keep, int64_t deadline) {
   int64_t snb_total = g.seg_nblocks(send_seg);
   int64_t rnb_total = g.seg_nblocks(recv_seg);
   int64_t sb0 = g.seg_start_block(send_seg);
@@ -527,7 +805,8 @@ int q8_hop(Comm* c, const QGrid& g, float* data, int chunk_blocks,
       int64_t cb0 = sb0 + k * chunk_blocks;
       int64_t cnb = (k == nchunks_s - 1) ? snb_total - k * chunk_blocks
                                          : chunk_blocks;
-      sn = g.wire_bytes(cb0, cnb);
+      int64_t payload = g.wire_bytes(cb0, cnb);
+      sn = payload + 4;  // + CRC32 trailer
       if (fwd) {
         sptr = fwd + fwd_off;  // forward pre-encoded bytes unchanged
         fwd_off += sn;
@@ -535,6 +814,7 @@ int q8_hop(Comm* c, const QGrid& g, float* data, int chunk_blocks,
         quantize_span(data + cb0 * g.block, g.span_elems(cb0, cnb), g.block,
                       reinterpret_cast<float*>(sbuf),
                       reinterpret_cast<int8_t*>(sbuf + 4 * cnb));
+        crc32_append(sbuf, static_cast<size_t>(payload));
         sptr = sbuf;
       }
     }
@@ -545,12 +825,15 @@ int q8_hop(Comm* c, const QGrid& g, float* data, int chunk_blocks,
     if (k < nchunks_r) {
       cnbr = (k == nchunks_r - 1) ? rnb_total - k * chunk_blocks
                                   : chunk_blocks;
-      rn = g.wire_bytes(cb0r, cnbr);
+      rn = g.wire_bytes(cb0r, cnbr) + 4;
     }
-    if (send_recv(c->ring_send_fd, sptr, static_cast<size_t>(sn),
-                  c->ring_recv_fd, rbuf, static_cast<size_t>(rn)) != 0)
-      return -1;
+    int rc = ring_xfer(c, sptr, static_cast<size_t>(sn), rbuf,
+                       static_cast<size_t>(rn), deadline);
+    if (rc != kOk) return rc;
     if (rn > 0) {
+      if (!crc32_check(rbuf, static_cast<size_t>(rn - 4)))
+        return comm_fail(c, kErrCorrupt,
+                         (c->rank - 1 + c->world) % c->world);
       dequant_span(reinterpret_cast<const float*>(rbuf),
                    reinterpret_cast<const int8_t*>(rbuf + 4 * cnbr),
                    g.span_elems(cb0r, cnbr), g.block,
@@ -561,7 +844,7 @@ int q8_hop(Comm* c, const QGrid& g, float* data, int chunk_blocks,
       }
     }
   }
-  return 0;
+  return kOk;
 }
 
 }  // namespace
@@ -575,12 +858,15 @@ int dpx_allreduce_q8(void* handle, float* data, int64_t n, int block,
                      int chunk_blocks) {
   Comm* c = static_cast<Comm*>(handle);
   if (c->world == 1 || n == 0) return 0;
-  if (block <= 0 || chunk_blocks <= 0) return -1;
+  if (block <= 0 || chunk_blocks <= 0) return kErr;
+  if (c->aborted) return kErr;
   const int w = c->world;
+  const int64_t deadline = op_deadline(c);
   QGrid g(n, block, w);
 
   // scratch: one chunk each way + two full-segment wire buffers for the
-  // byte-forwarding all-gather leg
+  // byte-forwarding all-gather leg (each chunk frame carries a 4-byte
+  // CRC32 trailer on the wire)
   int64_t max_seg_wire = 0, max_seg_nb = 0;
   for (int s = 0; s < w; s++) {
     int64_t wb = g.wire_bytes(g.seg_start_block(s), g.seg_nblocks(s));
@@ -589,7 +875,8 @@ int dpx_allreduce_q8(void* handle, float* data, int64_t n, int block,
   }
   int64_t cb = (chunk_blocks < max_seg_nb) ? chunk_blocks : max_seg_nb;
   if (cb < 1) cb = 1;
-  int64_t max_chunk_wire = 4 * cb + cb * static_cast<int64_t>(block);
+  int64_t max_frames = (max_seg_nb + cb - 1) / cb;
+  int64_t max_chunk_wire = 4 * cb + cb * static_cast<int64_t>(block) + 4;
   std::vector<char> sbuf(static_cast<size_t>(max_chunk_wire));
   std::vector<char> rbuf(static_cast<size_t>(max_chunk_wire));
 
@@ -599,17 +886,18 @@ int dpx_allreduce_q8(void* handle, float* data, int64_t n, int block,
   for (int step = 0; step < w - 1; step++) {
     int send_seg = (c->rank - step + w) % w;
     int recv_seg = (c->rank - step - 1 + w) % w;
-    if (q8_hop(c, g, data, static_cast<int>(cb), send_seg, nullptr,
-               recv_seg, /*assign=*/false, sbuf.data(), rbuf.data(),
-               nullptr) != 0)
-      return -1;
+    int rc = q8_hop(c, g, data, static_cast<int>(cb), send_seg, nullptr,
+                    recv_seg, /*assign=*/false, sbuf.data(), rbuf.data(),
+                    nullptr, deadline);
+    if (rc != kOk) return rc;
   }
 
   // all-gather: owner quantizes its reduced segment ONCE, replaces its
   // own f32 copy with the dequantized value, and the bytes are forwarded
   // unchanged — every rank decodes identical bytes.
-  std::vector<char> fwd(static_cast<size_t>(max_seg_wire));
-  std::vector<char> keep(static_cast<size_t>(max_seg_wire));
+  size_t fwd_cap = static_cast<size_t>(max_seg_wire + 4 * max_frames);
+  std::vector<char> fwd(fwd_cap);
+  std::vector<char> keep(fwd_cap);
   {
     int own = (c->rank + 1) % w;
     int64_t b0 = g.seg_start_block(own), nb = g.seg_nblocks(own);
@@ -621,35 +909,38 @@ int dpx_allreduce_q8(void* handle, float* data, int64_t n, int block,
                  reinterpret_cast<const int8_t*>(fwd.data() + 4 * nb),
                  elems, g.block, data + b0 * g.block, /*assign=*/true);
     // repack to chunk framing: fwd currently holds [all scales][all q];
-    // hops send per-chunk frames, so re-encode into chunk order
-    if (nb > cb) {
-      std::vector<char> frames(static_cast<size_t>(max_seg_wire));
-      int64_t off = 0;
-      for (int64_t k = 0; k * cb < nb; k++) {
-        int64_t cb0 = b0 + k * cb;
-        int64_t cnb = ((k + 1) * cb > nb) ? nb - k * cb : cb;
-        memcpy(frames.data() + off, fwd.data() + 4 * (k * cb),
-               static_cast<size_t>(4 * cnb));
-        off += 4 * cnb;
-        int64_t qoff = g.span_elems(b0, k * cb);
-        memcpy(frames.data() + off, fwd.data() + 4 * nb + qoff,
-               static_cast<size_t>(g.span_elems(cb0, cnb)));
-        off += g.span_elems(cb0, cnb);
-      }
-      fwd.swap(frames);
+    // hops send per-chunk [scales][q][CRC32] frames, so re-encode into
+    // chunk order and stamp each frame's CRC
+    std::vector<char> frames(fwd_cap);
+    int64_t off = 0;
+    for (int64_t k = 0; k * cb < nb; k++) {
+      int64_t cb0 = b0 + k * cb;
+      int64_t cnb = ((k + 1) * cb > nb) ? nb - k * cb : cb;
+      int64_t frame0 = off;
+      memcpy(frames.data() + off, fwd.data() + 4 * (k * cb),
+             static_cast<size_t>(4 * cnb));
+      off += 4 * cnb;
+      int64_t qoff = g.span_elems(b0, k * cb);
+      memcpy(frames.data() + off, fwd.data() + 4 * nb + qoff,
+             static_cast<size_t>(g.span_elems(cb0, cnb)));
+      off += g.span_elems(cb0, cnb);
+      crc32_append(frames.data() + frame0,
+                   static_cast<size_t>(off - frame0));
+      off += 4;
     }
+    fwd.swap(frames);
   }
   for (int step = 0; step < w - 1; step++) {
     int send_seg = (c->rank + 1 - step + w) % w;
     int recv_seg = (c->rank - step + w) % w;
     bool last = (step == w - 2);
-    if (q8_hop(c, g, data, static_cast<int>(cb), send_seg, fwd.data(),
-               recv_seg, /*assign=*/true, sbuf.data(), rbuf.data(),
-               last ? nullptr : keep.data()) != 0)
-      return -1;
+    int rc = q8_hop(c, g, data, static_cast<int>(cb), send_seg, fwd.data(),
+                    recv_seg, /*assign=*/true, sbuf.data(), rbuf.data(),
+                    last ? nullptr : keep.data(), deadline);
+    if (rc != kOk) return rc;
     fwd.swap(keep);
   }
-  return 0;
+  return kOk;
 }
 
 // Rooted reduce (sum) to rank 0 via the hub. Non-root buffers unchanged
@@ -658,16 +949,19 @@ int dpx_allreduce_q8(void* handle, float* data, int64_t n, int block,
 int dpx_reduce_f32(void* handle, float* data, int64_t n) {
   Comm* c = static_cast<Comm*>(handle);
   if (c->world == 1) return 0;
+  if (c->aborted) return kErr;
+  int64_t dl = op_deadline(c);
   if (c->rank == 0) {
     std::vector<float> buf(static_cast<size_t>(n));
     for (int r = 1; r < c->world; r++) {
-      if (read_all(c->hub_fds[r], buf.data(), sizeof(float) * n) != 0)
-        return -1;
+      int rc = read_all(c->hub_fds[r], buf.data(), sizeof(float) * n, dl);
+      if (rc != kOk) return comm_fail(c, rc, r);
       for (int64_t i = 0; i < n; i++) data[i] += buf[i];
     }
-    return 0;
+    return kOk;
   }
-  return write_all(c->hub_fd, data, sizeof(float) * n);
+  int rc = write_all(c->hub_fd, data, sizeof(float) * n, dl);
+  return rc != kOk ? comm_fail(c, rc, 0) : kOk;
 }
 
 // Rooted gather to rank 0: recv must hold world*nbytes on rank 0 (its own
@@ -678,58 +972,74 @@ int dpx_gather(void* handle, const char* send, int64_t nbytes, char* recv) {
     if (recv && recv != send) memcpy(recv, send, static_cast<size_t>(nbytes));
     return 0;
   }
+  if (c->aborted) return kErr;
+  int64_t dl = op_deadline(c);
   if (c->rank == 0) {
     memcpy(recv, send, static_cast<size_t>(nbytes));
     for (int r = 1; r < c->world; r++) {
-      if (read_all(c->hub_fds[r], recv + nbytes * r,
-                   static_cast<size_t>(nbytes)) != 0)
-        return -1;
+      int rc = read_all(c->hub_fds[r], recv + nbytes * r,
+                        static_cast<size_t>(nbytes), dl);
+      if (rc != kOk) return comm_fail(c, rc, r);
     }
-    return 0;
+    return kOk;
   }
-  return write_all(c->hub_fd, send, static_cast<size_t>(nbytes));
+  int rc = write_all(c->hub_fd, send, static_cast<size_t>(nbytes), dl);
+  return rc != kOk ? comm_fail(c, rc, 0) : kOk;
 }
 
 // Broadcast from src: relayed through rank 0 when src != 0.
 int dpx_broadcast(void* handle, char* data, int64_t nbytes, int src) {
   Comm* c = static_cast<Comm*>(handle);
   if (c->world == 1) return 0;
+  if (c->aborted) return kErr;
+  int64_t dl = op_deadline(c);
+  int rc;
   if (src != 0) {
     if (c->rank == src) {
-      if (write_all(c->hub_fd, data, static_cast<size_t>(nbytes)) != 0)
-        return -1;
+      rc = write_all(c->hub_fd, data, static_cast<size_t>(nbytes), dl);
+      if (rc != kOk) return comm_fail(c, rc, 0);
     }
     if (c->rank == 0) {
-      if (read_all(c->hub_fds[src], data, static_cast<size_t>(nbytes)) != 0)
-        return -1;
+      rc = read_all(c->hub_fds[src], data, static_cast<size_t>(nbytes), dl);
+      if (rc != kOk) return comm_fail(c, rc, src);
     }
   }
   if (c->rank == 0) {
     for (int r = 1; r < c->world; r++) {
       if (r == src) continue;  // src already has the data
-      if (write_all(c->hub_fds[r], data, static_cast<size_t>(nbytes)) != 0)
-        return -1;
+      rc = write_all(c->hub_fds[r], data, static_cast<size_t>(nbytes), dl);
+      if (rc != kOk) return comm_fail(c, rc, r);
     }
-    return 0;
+    return kOk;
   }
-  if (c->rank == src) return 0;
-  return read_all(c->hub_fd, data, static_cast<size_t>(nbytes));
+  if (c->rank == src) return kOk;
+  rc = read_all(c->hub_fd, data, static_cast<size_t>(nbytes), dl);
+  return rc != kOk ? comm_fail(c, rc, 0) : kOk;
 }
 
 // Barrier: hub collects a token from every rank, then releases them.
 int dpx_barrier(void* handle) {
   Comm* c = static_cast<Comm*>(handle);
   if (c->world == 1) return 0;
+  if (c->aborted) return kErr;
+  int64_t dl = op_deadline(c);
   uint32_t tok = kMagic;
+  int rc;
   if (c->rank == 0) {
-    for (int r = 1; r < c->world; r++)
-      if (read_all(c->hub_fds[r], &tok, sizeof(tok)) != 0) return -1;
-    for (int r = 1; r < c->world; r++)
-      if (write_all(c->hub_fds[r], &tok, sizeof(tok)) != 0) return -1;
-    return 0;
+    for (int r = 1; r < c->world; r++) {
+      rc = read_all(c->hub_fds[r], &tok, sizeof(tok), dl);
+      if (rc != kOk) return comm_fail(c, rc, r);
+    }
+    for (int r = 1; r < c->world; r++) {
+      rc = write_all(c->hub_fds[r], &tok, sizeof(tok), dl);
+      if (rc != kOk) return comm_fail(c, rc, r);
+    }
+    return kOk;
   }
-  if (write_all(c->hub_fd, &tok, sizeof(tok)) != 0) return -1;
-  return read_all(c->hub_fd, &tok, sizeof(tok));
+  rc = write_all(c->hub_fd, &tok, sizeof(tok), dl);
+  if (rc != kOk) return comm_fail(c, rc, 0);
+  rc = read_all(c->hub_fd, &tok, sizeof(tok), dl);
+  return rc != kOk ? comm_fail(c, rc, 0) : kOk;
 }
 
 }  // extern "C"

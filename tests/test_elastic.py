@@ -116,8 +116,58 @@ def _always_dies():
 
 
 def test_gives_up_after_max_restarts():
-    with pytest.raises(WorkerFailure, match="failed 3 times"):
+    with pytest.raises(WorkerFailure, match="failed 3 times") as ei:
         elastic.elastic_run(_always_dies, max_restarts=2, backoff_s=0.0)
+    assert ei.value.exitcode == 1  # structured attribution for tooling
+
+
+def _sleeps_long():
+    import time
+    time.sleep(120)
+
+
+import multiprocessing as _mp  # noqa: E402
+
+
+class _InterruptOnJoinProcess(_mp.get_context("spawn").Process):
+    """First blocking join() raises KeyboardInterrupt (the operator's ^C
+    landing in the supervisor); later joins behave normally. Module-level
+    so the spawn pickling of the process object still works."""
+
+    def join(self, timeout=None):
+        if timeout is None and not getattr(self, "_interrupted", False):
+            self._interrupted = True
+            raise KeyboardInterrupt
+        return super().join(timeout)
+
+
+def test_supervisor_interrupt_does_not_leak_child(monkeypatch):
+    """A KeyboardInterrupt (or any supervisor-side exception) during the
+    join must terminate + reap the child instead of orphaning it with
+    its ports/checkpoint dir (regression: elastic.py:87-91 had no
+    try/finally around p.join())."""
+    spawned = []
+
+    class InterruptingCtx:
+        def Process(self, *a, **k):
+            p = _InterruptOnJoinProcess(*a, **k)
+            spawned.append(p)
+            return p
+
+    monkeypatch.setattr(elastic.mp, "get_context",
+                        lambda m: InterruptingCtx())
+    with pytest.raises(KeyboardInterrupt):
+        elastic.elastic_run(_sleeps_long, max_restarts=0, backoff_s=0.0)
+    assert len(spawned) == 1
+    p = spawned[0]
+    try:
+        # reaped on the way out: dead, with an exitcode collected
+        assert not p.is_alive()
+        assert p.exitcode is not None
+    finally:
+        if p.is_alive():
+            p.kill()
+            super(_InterruptOnJoinProcess, p).join()
 
 
 def test_attempt_helpers_default_outside_elastic(monkeypatch):

@@ -1,0 +1,485 @@
+"""Chaos tests: deterministic fault injection (runtime/faults.py) driving
+the typed comm-failure story end to end (ISSUE 2) — a rank hard-dying
+mid-allreduce becomes a typed ``CommError`` on every survivor within 2x
+the per-op deadline (never a hang), the supervisor reaps the world and
+names the dead rank + op, and an elastic relaunch resumes bit-exact."""
+
+import multiprocessing as mp
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_pytorch_tpu.runtime import elastic, faults
+from distributed_pytorch_tpu.runtime.multiprocess import launch_multiprocess
+from distributed_pytorch_tpu.runtime.native import (CommError, CommPeerDied,
+                                                    CommTimeout, HostComm)
+from distributed_pytorch_tpu.runtime.watchdog import (HeartbeatMonitor,
+                                                      StalledWorker,
+                                                      WorkerFailure)
+
+TIMEOUT_MS = 2000  # per-op deadline for the chaos runs
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Every test starts with no faults installed and fresh counters."""
+    monkeypatch.delenv(faults.FAULT_ENV, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# spec grammar
+# ---------------------------------------------------------------------------
+
+
+class TestSpecGrammar:
+    def test_parses_the_documented_specs(self):
+        specs = faults.parse_fault_spec(
+            "kill@step=3,rank=1;delay@op=allreduce,ms=500;drop_conn@step=2")
+        assert [s.action for s in specs] == ["kill", "delay", "drop_conn"]
+        assert specs[0].step == 3 and specs[0].rank == 1
+        assert specs[1].op == "allreduce" and specs[1].ms == 500
+        assert specs[2].step == 2
+
+    def test_attempt_and_call_keys(self):
+        (s,) = faults.parse_fault_spec("kill@op=allreduce,call=2,attempt=0")
+        assert s.op == "allreduce" and s.call == 2 and s.attempt == 0
+
+    @pytest.mark.parametrize("bad", [
+        "explode@step=1",          # unknown action
+        "kill@when=3",             # unknown key
+        "kill@step",               # missing '='
+        "delay@op=allreduce",      # delay without ms
+    ])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            faults.parse_fault_spec(bad)
+
+
+# ---------------------------------------------------------------------------
+# hook semantics (in-process; `kill` is only exercised in subprocesses)
+# ---------------------------------------------------------------------------
+
+
+class _FakeComm:
+    rank = 0
+
+    def __init__(self):
+        self.aborted = False
+
+    def abort(self):
+        self.aborted = True
+
+
+class TestHooks:
+    def test_delay_fires_on_matching_op(self):
+        faults.install("delay@op=allreduce,ms=60")
+        t0 = time.monotonic()
+        faults.on_comm_op("allreduce", rank=0)
+        assert time.monotonic() - t0 >= 0.05
+        assert faults.fired() == ["delay@op=allreduce,call=1"]
+
+    def test_op_and_rank_filters(self):
+        faults.install("delay@op=allreduce,rank=1,ms=5000")
+        t0 = time.monotonic()
+        faults.on_comm_op("barrier", rank=1)   # wrong op
+        faults.on_comm_op("allreduce", rank=0)  # wrong rank
+        assert time.monotonic() - t0 < 1.0
+        assert faults.fired() == []
+
+    def test_call_filter_counts_per_op(self):
+        faults.install("delay@op=reduce,call=2,ms=10")
+        faults.on_comm_op("reduce", rank=0)
+        assert faults.fired() == []
+        faults.on_comm_op("reduce", rank=0)
+        assert faults.fired() == ["delay@op=reduce,call=2"]
+
+    def test_drop_conn_step_scoped_aborts_registered_comms(self):
+        fake = _FakeComm()
+        faults.register_comm(fake)
+        faults.install("drop_conn@step=2")
+        faults.on_step(1, rank=0)
+        assert not fake.aborted
+        faults.on_step(2, rank=0)
+        assert fake.aborted
+        # one-shot: a later step must not re-fire
+        fake.aborted = False
+        faults.on_step(2, rank=0)
+        assert not fake.aborted
+
+    def test_attempt_filter_respects_elastic_attempt(self, monkeypatch):
+        fake = _FakeComm()
+        faults.register_comm(fake)
+        faults.install("drop_conn@step=1,attempt=0")
+        monkeypatch.setenv(elastic.ATTEMPT_ENV, "1")
+        faults.on_step(1, rank=0)
+        assert not fake.aborted  # attempt 1 != 0: the relaunch runs clean
+        monkeypatch.setenv(elastic.ATTEMPT_ENV, "0")
+        faults.install("drop_conn@step=1,attempt=0")  # fresh (unfired) spec
+        faults.on_step(1, rank=0)
+        assert fake.aborted
+
+    def test_rank_scoped_spec_never_fires_without_a_rank(self):
+        """A hook that cannot say which rank it is must not fire a
+        rank-scoped fault — 'just in case' would turn a one-rank kill
+        into a whole-world kill."""
+        faults.install("delay@op=allreduce,rank=1,ms=5000")
+        t0 = time.monotonic()
+        faults.on_comm_op("allreduce")  # rank unknown at this site
+        faults.on_step(0)
+        assert time.monotonic() - t0 < 1.0
+        assert faults.fired() == []
+
+    def test_step_scoped_kill_does_not_fire_on_other_ranks(self):
+        # would os._exit the test process if the rank filter failed
+        faults.install("kill@step=3,rank=1")
+        faults.on_step(3, rank=0)
+        faults.on_step(2, rank=1)
+        assert faults.fired() == []
+
+
+# ---------------------------------------------------------------------------
+# native failure paths: typed errors instead of hangs
+# ---------------------------------------------------------------------------
+
+
+def test_rendezvous_timeout_exhaustion():
+    """connect_with_retry gives up after timeout_ms: a missing master is a
+    prompt typed error, not an infinite connect loop."""
+    from distributed_pytorch_tpu.runtime.launcher import find_free_port
+
+    port = find_free_port()  # nobody listens here
+    t0 = time.monotonic()
+    with pytest.raises(CommError, match="rendezvous failed"):
+        HostComm("127.0.0.1", port, rank=1, world=2, timeout_ms=300)
+    assert time.monotonic() - t0 < 10.0
+
+
+def _report_and_reraise(q, rank, fn):
+    """Run fn(); report (rank, error type, op, peer, elapsed) then re-raise
+    so the supervisor sees the failure too. The queue is flushed before
+    re-raising — the supervisor's teardown must not race the report."""
+    t0 = time.monotonic()
+    try:
+        fn()
+    except CommError as e:
+        q.put((rank, type(e).__name__, e.op, e.peer,
+               time.monotonic() - t0))
+        q.close()
+        q.join_thread()
+        raise
+    q.put((rank, None, None, None, time.monotonic() - t0))
+
+
+def _peer_close_worker(rank, world, q):
+    """Rank 1 is killed entering its first allreduce (DPX_FAULT, set by
+    the parent); rank 0 must get CommPeerDied from the recv-0 path."""
+    import numpy as np
+    import distributed_pytorch_tpu as dist
+
+    dist.init_process_group(rank, world)
+    _report_and_reraise(
+        q, rank, lambda: dist.all_reduce(np.ones(1024, np.float32)))
+
+
+def test_send_recv_peer_close_raises_typed(monkeypatch):
+    monkeypatch.setenv(faults.FAULT_ENV, "kill@op=allreduce,call=1,rank=1")
+    monkeypatch.setenv("DPX_COMM_TIMEOUT_MS", str(TIMEOUT_MS))
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    with pytest.raises(WorkerFailure):
+        launch_multiprocess(_peer_close_worker, 2, q)
+    rank, kind, op, peer, elapsed = q.get(timeout=10)
+    assert rank == 0
+    assert kind == "CommPeerDied"
+    assert op == "allreduce" and peer == 1
+    assert elapsed < 2 * TIMEOUT_MS / 1000.0
+
+
+def _delay_worker(rank, world, q):
+    """Rank 1 stalls 30s entering its second allreduce; rank 0's deadline
+    must fire as CommTimeout within the budget."""
+    import numpy as np
+    import distributed_pytorch_tpu as dist
+
+    dist.init_process_group(rank, world)
+    dist.all_reduce(np.ones(8, np.float32))  # call 1: clean
+    _report_and_reraise(
+        q, rank, lambda: dist.all_reduce(np.ones(8, np.float32)))
+
+
+def test_wedged_peer_raises_comm_timeout(monkeypatch):
+    monkeypatch.setenv(faults.FAULT_ENV,
+                       "delay@op=allreduce,call=2,rank=1,ms=30000")
+    monkeypatch.setenv("DPX_COMM_TIMEOUT_MS", "1000")
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    t0 = time.monotonic()
+    with pytest.raises(WorkerFailure) as ei:
+        launch_multiprocess(_delay_worker, 2, q)
+    # the launch itself must not have waited out the 30s stall
+    assert time.monotonic() - t0 < 25.0
+    rank, kind, op, peer, elapsed = q.get(timeout=10)
+    assert rank == 0 and kind == "CommTimeout"
+    assert op == "allreduce" and peer == 1
+    assert elapsed < 2 * 1.0  # within 2x the 1000ms deadline
+    assert ei.value.op == "allreduce" and ei.value.kind == "CommTimeout"
+
+
+def _drop_conn_worker(rank, world, q):
+    """Rank 1 severs its own links entering allreduce call 2: rank 1 gets
+    a local CommError, rank 0 observes peer-closed."""
+    import numpy as np
+    import distributed_pytorch_tpu as dist
+
+    dist.init_process_group(rank, world)
+    dist.all_reduce(np.ones(8, np.float32))
+    _report_and_reraise(
+        q, rank, lambda: dist.all_reduce(np.ones(8, np.float32)))
+
+
+def test_drop_conn_propagates_to_both_sides(monkeypatch):
+    monkeypatch.setenv(faults.FAULT_ENV,
+                       "drop_conn@op=allreduce,call=2,rank=1")
+    monkeypatch.setenv("DPX_COMM_TIMEOUT_MS", str(TIMEOUT_MS))
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    with pytest.raises(WorkerFailure):
+        launch_multiprocess(_drop_conn_worker, 2, q)
+    reports = {}
+    for _ in range(2):
+        rank, kind, op, peer, elapsed = q.get(timeout=10)
+        reports[rank] = (kind, elapsed)
+    assert reports[0][0] in ("CommPeerDied", "CommTimeout")
+    assert reports[1][0] in ("CommError", "CommPeerDied")
+    assert all(el < 2 * TIMEOUT_MS / 1000.0 for _, el in reports.values())
+
+
+# ---------------------------------------------------------------------------
+# THE chaos acceptance test: world 4, one rank killed mid-allreduce
+# ---------------------------------------------------------------------------
+
+
+def _chaos_worker(rank, world, q):
+    """Two clean allreduces, then rank 2 is killed entering the third
+    (mid-collective for everyone else: their deadline-guarded ring I/O is
+    already in flight). Rank 2 on purpose — abort propagation cascades
+    around the ring, so every survivor blames its own upstream neighbor
+    and the supervisor must identify the dead rank as the blamed rank
+    that never reported (min-of-blamed would wrongly pick rank 0 here)."""
+    import numpy as np
+    import distributed_pytorch_tpu as dist
+
+    dist.init_process_group(rank, world)
+    for _ in range(2):
+        dist.all_reduce(np.ones(4096, np.float32))
+    _report_and_reraise(
+        q, rank, lambda: dist.all_reduce(np.ones(4096, np.float32)))
+
+
+def test_chaos_kill_mid_allreduce_world4(monkeypatch):
+    """Acceptance (ISSUE 2): DPX_FAULT kills rank 2 mid-allreduce in a
+    world of 4. Every survivor raises a typed CommError subclass within
+    2x DPX_COMM_TIMEOUT_MS (verified against a hard wall-clock bound —
+    no hang), and WorkerFailure names the dead rank and the op."""
+    monkeypatch.setenv(faults.FAULT_ENV, "kill@op=allreduce,call=3,rank=2")
+    monkeypatch.setenv("DPX_COMM_TIMEOUT_MS", str(TIMEOUT_MS))
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+
+    result = {}
+
+    def run():
+        try:
+            launch_multiprocess(_chaos_worker, 4, q)
+        except BaseException as e:  # noqa: BLE001
+            result["exc"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=120)  # the hard no-hang bound for the whole world
+    assert not t.is_alive(), "chaos run hung: deadline guard failed"
+    assert isinstance(result.get("exc"), WorkerFailure)
+    failure = result["exc"]
+    # attribution: the DEAD rank and the op, not just "something exited"
+    assert failure.rank == 2
+    assert failure.op == "allreduce"
+    assert "rank 2" in str(failure) and "allreduce" in str(failure)
+    assert failure.exitcode == faults.KILL_EXIT_CODE
+
+    reports = {}
+    while len(reports) < 3:
+        rank, kind, op, peer, elapsed = q.get(timeout=10)
+        reports[rank] = (kind, op, peer, elapsed)
+    assert set(reports) == {0, 1, 3}  # every survivor reported
+    for rank, (kind, op, peer, elapsed) in reports.items():
+        assert kind in ("CommPeerDied", "CommTimeout"), (rank, kind)
+        assert op == "allreduce"
+        assert elapsed < 2 * TIMEOUT_MS / 1000.0, (rank, elapsed)
+    # rank 3 receives directly from rank 2 on the ring: it must blame it
+    assert reports[3][2] == 2
+
+
+# ---------------------------------------------------------------------------
+# heartbeat monitor vs a deliberately stalled (injected) rank
+# ---------------------------------------------------------------------------
+
+
+def _beating_worker(rank, hb_dir, steps):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from distributed_pytorch_tpu.runtime import faults as child_faults
+    from distributed_pytorch_tpu.runtime.watchdog import Heartbeat
+
+    hb = Heartbeat(hb_dir, rank)
+    for s in range(steps):
+        child_faults.on_step(s, rank=rank)  # rank 1 stalls at step 2
+        hb.beat(s)
+        time.sleep(0.05)
+
+
+def test_heartbeat_monitor_flags_stalled_injected_rank(tmp_path,
+                                                       monkeypatch):
+    """A rank stalled by an injected delay stops beating; the monitor's
+    staleness check must name exactly that rank and assert_alive must
+    raise StalledWorker (liveness alone cannot see a wedged-alive rank)."""
+    monkeypatch.setenv(faults.FAULT_ENV, "delay@step=2,rank=1,ms=60000")
+    d = str(tmp_path)
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(target=_beating_worker, args=(r, d, 1200),
+                         daemon=True) for r in range(2)]
+    mon = HeartbeatMonitor(d, world_size=2)
+    for p in procs:
+        p.start()
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if mon.stalled(timeout_s=1.0) == [1]:
+                break
+            time.sleep(0.1)
+        assert mon.stalled(timeout_s=1.0) == [1]
+        with pytest.raises(StalledWorker, match=r"\[1\]"):
+            mon.assert_alive(1.0)
+        assert procs[1].is_alive()  # wedged, not dead: the point
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+            p.join()
+
+
+# ---------------------------------------------------------------------------
+# elastic relaunch after an injected mid-collective kill: bit-exact resume
+# ---------------------------------------------------------------------------
+
+_STEPS = 6
+
+
+def _ckpt_train_worker(rank, world, workdir, steps):
+    """Tiny deterministic 'training': params evolve by an all-reduced
+    per-rank gradient each step; every rank checkpoints after every step.
+    One allreduce per step => op call N belongs to step N-1."""
+    import json
+
+    import numpy as np
+    import distributed_pytorch_tpu as dist
+    from distributed_pytorch_tpu.runtime import faults as child_faults
+
+    dist.init_process_group(rank, world)
+    try:
+        ck = os.path.join(workdir, f"rank{rank}.npz")
+        if os.path.exists(ck):
+            z = np.load(ck)
+            params, start = z["params"], int(z["step"])
+        else:
+            params, start = np.full(64, 10.0, np.float32), 0
+        for s in range(start, steps):
+            child_faults.on_step(s, rank=rank)
+            g = (params * 0.1 + rank + s).astype(np.float32)
+            g = dist.all_reduce(g, op="avg")
+            params = params - 0.1 * g
+            loss = float(np.abs(params).mean())
+            tmp = ck + ".tmp.npz"  # .npz suffix: savez must not append
+            np.savez(tmp, params=params, step=s + 1)
+            os.replace(tmp, ck)
+            if rank == 0:
+                with open(os.path.join(workdir, "losses.jsonl"), "a") as f:
+                    f.write(json.dumps({"step": s, "loss": loss}) + "\n")
+        if rank == 0:
+            np.save(os.path.join(workdir, "final.npy"), params)
+    finally:
+        dist.cleanup()
+
+
+def _elastic_target(workdir, steps):
+    """The elastically supervised unit: a 2-rank native-DDP-style run."""
+    launch_multiprocess(_ckpt_train_worker, 2, workdir, steps)
+
+
+def _losses(workdir):
+    import json
+    with open(os.path.join(workdir, "losses.jsonl")) as f:
+        return [(json.loads(l)["step"], json.loads(l)["loss"])
+                for l in f if l.strip()]
+
+
+@pytest.mark.slow
+def test_chaos_elastic_relaunch_resumes_bit_exact(tmp_path, monkeypatch):
+    """Acceptance (ISSUE 2), recovery half: after the injected
+    mid-allreduce kill the supervisor reaps the world, elastic_run
+    relaunches, and the relaunch resumes from checkpoint with a loss
+    trajectory bit-exact to an uninterrupted run."""
+    monkeypatch.setenv("DPX_COMM_TIMEOUT_MS", str(TIMEOUT_MS))
+    crashed = str(tmp_path / "crashed")
+    straight = str(tmp_path / "straight")
+    os.makedirs(crashed), os.makedirs(straight)
+
+    # one allreduce per step: call=4 kills rank 1 entering step 3's
+    # collective, on elastic attempt 0 only — the relaunch runs clean
+    res = elastic.elastic_run(
+        _elastic_target, (crashed, _STEPS), max_restarts=2, backoff_s=0.05,
+        env={faults.FAULT_ENV: "kill@op=allreduce,call=4,rank=1,attempt=0"})
+    assert res.restarts == 1            # died once, recovered once
+    assert res.exitcodes[0] != 0 and res.exitcodes[-1] == 0
+
+    monkeypatch.delenv(faults.FAULT_ENV, raising=False)
+    elastic.elastic_run(_elastic_target, (straight, _STEPS),
+                        max_restarts=0, backoff_s=0.05)
+
+    # bit-exact final params and a resumed (no step repeated, none
+    # skipped) loss trajectory equal to the uninterrupted run's
+    a = np.load(os.path.join(crashed, "final.npy"))
+    b = np.load(os.path.join(straight, "final.npy"))
+    np.testing.assert_array_equal(a, b)
+    lc, ls = _losses(crashed), _losses(straight)
+    assert [s for s, _ in lc] == [0, 1, 2, 3, 4, 5]
+    assert lc == ls  # bit-exact losses, including the resumed tail
+
+
+# ---------------------------------------------------------------------------
+# failure events land in the line-JSON metrics log
+# ---------------------------------------------------------------------------
+
+
+def test_worker_failure_event_in_metrics_log(tmp_path, monkeypatch):
+    import json
+
+    log = tmp_path / "metrics.jsonl"
+    monkeypatch.setenv("DPX_METRICS_LOG", str(log))
+    monkeypatch.setenv(faults.FAULT_ENV, "kill@op=allreduce,call=1,rank=1")
+    monkeypatch.setenv("DPX_COMM_TIMEOUT_MS", str(TIMEOUT_MS))
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    with pytest.raises(WorkerFailure):
+        launch_multiprocess(_peer_close_worker, 2, q)
+    rows = [json.loads(l) for l in log.read_text().splitlines()]
+    ev = [r for r in rows if r["event"] == "worker_failure"]
+    assert ev and ev[0]["rank"] == 1 and ev[0]["op"] == "allreduce"
+    assert ev[0]["kind"] == "CommPeerDied"

@@ -138,13 +138,56 @@ def test_full_queue_priority_and_headline_last(monkeypatch, tmp_path):
 def test_all_ok_single_pass(monkeypatch, tmp_path):
     calls, out = _wire(monkeypatch, tmp_path, probe_script=[],
                        stage_fails={})
-    rc = run_all_tpu._run(["--quick", "--out", str(out)])
+    rc = run_all_tpu._run(["--quick", "--out", str(out),
+                           "--write-baseline"])
     assert rc == 0
     assert calls["stages"] == ["mfu_smoke", "bench_mfu", "mfu_mid",
                                "flash_attention", "bench_headline"]
     assert all(r["ok"] for r in _rows(out))
-    # evidence landed -> BASELINE.md regeneration ran for the pass
+    # evidence landed + regen requested -> BASELINE.md regeneration ran
     assert calls.get("regen", 0) == 1
+
+
+def test_scratch_out_does_not_touch_baseline(monkeypatch, tmp_path):
+    """A trial run with a non-default --out must NOT regenerate the
+    repo's BASELINE.md measured section from its scratch rows (ADVICE
+    round 5); --write-baseline is the explicit override (covered above),
+    and the default out path regenerates as before."""
+    calls, out = _wire(monkeypatch, tmp_path, probe_script=[],
+                       stage_fails={})
+    rc = run_all_tpu._run(["--quick", "--out", str(out)])
+    assert rc == 0
+    assert calls.get("regen", 0) == 0
+
+
+def test_sweep_arm_error_rows_get_footnote_marker(tmp_path):
+    """Arms that exited nonzero after printing a record (arm_error/
+    arm_rc) must be visibly annotated in the rendered sweep table, not
+    indistinguishable from clean measurements (ADVICE round 5)."""
+    from benchmarks import report
+
+    log = tmp_path / "log.jsonl"
+    row = {"stage": "mfu_sweep", "ok": True, "ts": "T1", "result": {
+        "sweep": [
+            {"arm": {"batch": 8}, "mfu": 0.4, "tokens_per_sec": 2.0,
+             "step_ms_median": 1.0},
+            {"arm": {"batch": 16}, "mfu": 0.5, "tokens_per_sec": 3.0,
+             "step_ms_median": 1.0, "arm_error": "rc 1", "arm_rc": 1},
+            {"arm": {"batch": 64}, "error": "OOM"},
+        ]}}
+    log.write_text(json.dumps(row) + "\n")
+    md = report.render(report.load_rows(str(log)))
+    clean = next(l for l in md.splitlines() if '"batch": 8' in l
+                 and l.startswith("|"))
+    suspect = next(l for l in md.splitlines() if '"batch": 16' in l
+                   and l.startswith("|"))
+    assert "†" not in clean
+    assert "†" in suspect
+    # the footnote explains the marker and carries the rc + error
+    assert "exited nonzero after printing its record" in md
+    assert "rc 1" in md
+    # genuinely failed arms keep their separate failure list
+    assert "OOM" in md
 
 
 def test_write_baseline_splices_between_markers(tmp_path):

@@ -53,6 +53,20 @@ if _REPO_ROOT not in sys.path:
 
 _COMM = None  # the native HostComm for this rank process, set by init
 
+_COMM_ERRORS = ("CommError", "CommPeerDied", "CommTimeout", "CommCorrupt")
+
+
+def __getattr__(name):
+    """Re-export the typed comm-failure hierarchy (PEP 562, lazily — the
+    framework package pulls in jax, which the literal torch workload must
+    not pay for at import time). A collective on a dead/wedged peer
+    raises these instead of hanging; ``DPX_COMM_TIMEOUT_MS`` bounds every
+    collective (see docs/failures.md)."""
+    if name in _COMM_ERRORS:
+        from distributed_pytorch_tpu.runtime import native as _native
+        return getattr(_native, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 def _device_count() -> int:
     """World size: ``DPX_VISIBLE_DEVICES`` count when set (the framework's
