@@ -49,6 +49,17 @@ def latest_per_stage(rows):
     return out
 
 
+def _truncate_words(s: str, cap: int = 200) -> str:
+    """Cap a free-text reason at a WORD boundary with an ellipsis —
+    the retraction reasons run ~120 chars and the old hard [:100] cut
+    them mid-word in the regenerated BASELINE.md (ADVICE round 5)."""
+    s = str(s)
+    if len(s) <= cap:
+        return s
+    cut = s[:cap].rsplit(None, 1)[0] if " " in s[:cap] else s[:cap]
+    return cut + "…"
+
+
 def _fmt(v, nd=3):
     if isinstance(v, float):
         s = f"{v:.{nd}f}"
@@ -217,7 +228,7 @@ def render(rows) -> str:
         lines += ["", "Retracted rows (kept for the audit trail):"]
         for r in retracted:
             lines.append(f"- {r.get('stage')} ({r.get('ts', '?')}): "
-                         f"{r.get('reason', 'retracted')[:100]}")
+                         f"{_truncate_words(r.get('reason', 'retracted'))}")
     return "\n".join(lines)
 
 

@@ -38,14 +38,23 @@ from benchmarks.mfu_transformer import (  # noqa: E402
     FLAGSHIP, LONGCTX, MEDIUM, MID, PEAK_BF16, model_flops_per_token)
 
 # Public per-chip HBM specs (same sourcing rule as PEAK_BF16: only the
-# generation we can run on is judged; others best-effort).
+# generation we can run on is judged; others best-effort). Key set
+# MIRRORS PEAK_BF16 exactly — analyze() indexes both with one
+# device_kind, so a key present in one but not the other turned into a
+# bare KeyError for v2/v3/v5 (ADVICE round 5).
 HBM_GBPS = {
+    "TPU v2": 700e9,
+    "TPU v3": 900e9,
     "TPU v4": 1228e9,
     "TPU v5 lite": 819e9,
     "TPU v5e": 819e9,
+    "TPU v5": 2765e9,           # v5p, mirroring PEAK_BF16's aliasing
     "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,      # Trillium / v6e
     "TPU v6e": 1640e9,
 }
+assert set(HBM_GBPS) == set(PEAK_BF16), \
+    "HBM_GBPS and PEAK_BF16 must stay key-identical (analyze() indexes both)"
 # Activation tensors written in forward and re-read in backward, per
 # layer, in units of (batch*seq*dim) elements. Transformer block with
 # flash attention (no S^2 materialization): ln1 out, qkv out (3x), attn
@@ -117,6 +126,10 @@ def analyze(cfg, *, device_kind: str = "TPU v5 lite",
     remat = cfg.get("remat", False) if remat is None else remat
     master_f32 = (cfg.get("master_f32", False) if master_f32 is None
                   else master_f32)
+    if device_kind not in PEAK_BF16 or device_kind not in HBM_GBPS:
+        raise ValueError(
+            f"unsupported device_kind {device_kind!r}: roofline specs "
+            f"exist for {sorted(PEAK_BF16)}")
     peak = PEAK_BF16[device_kind]
     bw = HBM_GBPS[device_kind]
     tok = cfg["batch"] * cfg["seq"]

@@ -57,7 +57,19 @@ import bench  # noqa: E402  (the shared subprocess/JSON plumbing)
 # failures with a healthy backend it is skipped for the rest of the run (a
 # poison stage that wedges the tunnel must not starve the rest of the
 # queue, and a genuinely broken stage would otherwise retry forever).
+# Failures observed with the backend ALREADY wedged do not count: a stage
+# whose attempts were all eaten by someone else's wedge is a victim, not a
+# poison stage, and must keep its retry budget (ADVICE round 5 — the
+# flagship was permanently skipped because the tunnel wedged during its
+# window three times).
 MAX_ATTEMPTS = 3
+
+# ... but a stage that WEDGES THE TUNNEL ITSELF also looks like a victim
+# (the post-failure probe sees the wedge it caused), so uncapped exemption
+# would let it starve the queue forever. After this many wedge-coincident
+# failures the stage is skipped like a poison stage — deliberately more
+# lenient than MAX_ATTEMPTS so genuine victims keep extra retries.
+MAX_WEDGE_VICTIMS = 6
 
 
 def regenerate_baseline(py: str, out_path: str) -> None:
@@ -271,7 +283,11 @@ def _run(argv):
     # the queue still deserves its shot), or the time budget ran out.
     # Round-5 lesson: the first heal lasted 30 min, the flagship wedged
     # it, and the old abort-on-wedge path threw away the whole round.
-    done, attempts = set(), {}
+    done, attempts, wedges = set(), {}, {}
+
+    def skipped(name):
+        return (attempts.get(name, 0) >= MAX_ATTEMPTS
+                or wedges.get(name, 0) >= MAX_WEDGE_VICTIMS)
 
     while True:
         if watching:
@@ -302,7 +318,7 @@ def _run(argv):
         n_done_before = len(done)
         with open(out_path, "a") as f:
             for name, cmd, timeout_s, env in stages:
-                if name in done or attempts.get(name, 0) >= MAX_ATTEMPTS:
+                if name in done or skipped(name):
                     continue
                 if ran_this_pass and not bench.probe_backend():
                     # the tunnel wedged mid-collection: stop this pass
@@ -329,17 +345,43 @@ def _run(argv):
                 if rec["ok"]:
                     done.add(name)
                 else:
-                    attempts[name] = attempts.get(name, 0) + 1
-                    rec["attempt"] = attempts[name]
+                    # before charging the failure against the stage's
+                    # retry budget, ask whether the backend is even
+                    # alive: a stage that failed because the tunnel
+                    # wedged UNDER it is a wedge victim — recording the
+                    # attempt would let one bad evening permanently
+                    # skip a flagship stage (ADVICE round 5)
+                    if bench.probe_backend():
+                        attempts[name] = attempts.get(name, 0) + 1
+                        rec["attempt"] = attempts[name]
+                    else:
+                        rec["wedge_victim"] = True
+                        wedges[name] = wedges.get(name, 0) + 1
+                        rec["wedge_count"] = wedges[name]
                 f.write(json.dumps(rec) + "\n")
                 f.flush()
                 print(json.dumps({k: rec[k]
                                   for k in ("stage", "ok", "wall_s",
-                                            "attempt") if k in rec}),
+                                            "attempt", "wedge_victim")
+                                  if k in rec}),
                       flush=True)
+                if rec.get("wedge_victim"):
+                    # the backend is down: stop this pass now instead of
+                    # feeding the remaining stages to the same wedge
+                    # (watch mode re-enters the watch; one-shot aborts)
+                    gate = {"stage": f"health_gate_after_{name}",
+                            "ok": False,
+                            "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                            "result": {"error": "backend unhealthy after "
+                                       "stage failure; failure not "
+                                       "counted against MAX_ATTEMPTS"}}
+                    f.write(json.dumps(gate) + "\n")
+                    f.flush()
+                    print(json.dumps(gate), flush=True)
+                    break
 
         pending = [n for n, _, _, _ in stages
-                   if n not in done and attempts.get(n, 0) < MAX_ATTEMPTS]
+                   if n not in done and not skipped(n)]
         print(f"\n{len(done)}/{len(stages)} stages ok, "
               f"{len(pending)} pending -> {out_path}", flush=True)
         if len(done) > n_done_before:  # only passes that landed a stage

@@ -1,0 +1,199 @@
+"""Serving benchmark: continuous batching vs static batching.
+
+Two load shapes over the same request population:
+
+- **closed loop**: every request submitted at t=0 (the floodgates
+  case) — measures peak engine throughput and the TTFT spread induced
+  by queueing behind the slot pool;
+- **open loop**: Poisson arrivals at ``--rate`` req/s (seeded, so a
+  run is reproducible) — the serving-paper methodology (the TTFT/TPOT
+  numbers that matter under load are open-loop ones; arxiv 2605.25645
+  makes the same point for TPU serving).
+
+The baseline arm is **static batching**: the same requests grouped
+FCFS into fixed batches of ``n_slots``, each batch served by ONE
+compiled ``generate()`` call (everyone in the batch waits for the
+whole batch's decode — the pre-Orca serving shape). Uniform prompt
+length/max-new in that arm, since ``generate`` has no per-row
+lengths; the engine arms use the mixed population.
+
+Per-arm output: tokens/s, p50/p99 TTFT and TPOT (serve.metrics
+definitions). ``--smoke`` shrinks everything to a seconds-scale CPU
+run AND asserts engine streams equal standalone ``generate()`` — the
+CI job that keeps the engine loop from rotting (tier1.yml).
+
+Usage: python benchmarks/serve_bench.py [--smoke] [--slots N]
+           [--requests N] [--rate R] [--max-new N] [--seed S]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build_model(smoke: bool):
+    import jax
+    from distributed_pytorch_tpu import models
+    if smoke:
+        model = models.TransformerLM(vocab=61, dim=32, n_layers=2,
+                                     n_heads=4, n_kv_heads=2, pos="rope",
+                                     max_seq=256)
+    else:
+        model = models.TransformerLM(vocab=512, dim=256, n_layers=4,
+                                     n_heads=8, n_kv_heads=4, pos="rope",
+                                     max_seq=1024)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def make_requests(n, vocab, max_new, seed, uniform=False):
+    """(prompt, SamplingParams, key) population; ``uniform`` pins one
+    shape for the static-batching arm."""
+    import jax
+    from distributed_pytorch_tpu.serve import SamplingParams
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        s = 16 if uniform else int(rng.integers(4, 24))
+        mn = max_new if uniform else int(rng.integers(max(2, max_new // 2),
+                                                      max_new + 1))
+        prompt = rng.integers(0, vocab, (s,)).astype(np.int32)
+        out.append((prompt, SamplingParams(max_new_tokens=mn),
+                    jax.random.PRNGKey(1000 + i)))
+    return out
+
+
+def run_engine(model, params, reqs, n_slots, max_len, rate=None, seed=0):
+    """Submit ``reqs`` (closed loop, or Poisson open loop at ``rate``)
+    and aggregate per-request SLO records."""
+    from distributed_pytorch_tpu.serve import (EngineConfig,
+                                               InferenceEngine, aggregate)
+    eng = InferenceEngine(model, params,
+                          EngineConfig(n_slots=n_slots, max_len=max_len))
+    rng = np.random.default_rng(seed)
+    handles = []
+    t0 = time.monotonic()
+    with eng:
+        for prompt, sp, key in reqs:
+            if rate is not None:
+                time.sleep(rng.exponential(1.0 / rate))
+            handles.append(eng.submit(prompt, sp, rng=key))
+        outs = [h.result(timeout=600) for h in handles]
+    wall = time.monotonic() - t0
+    rep = aggregate([h.metrics for h in handles], wall_s=wall)
+    rep["stats"] = {k: v for k, v in eng.stats().items()
+                    if k in ("iterations", "decode_compiles",
+                             "prefill_compiles", "sample_compiles")}
+    return rep, outs
+
+
+def run_static(model, params, reqs, n_slots, max_len):
+    """Static batching: FCFS groups of ``n_slots`` through one compiled
+    generate() each; every request's TTFT is its group's full wall time
+    (tokens only exist when the whole batch finishes)."""
+    import jax
+    import jax.numpy as jnp
+    from distributed_pytorch_tpu.models.generate import make_generate_fn
+    from distributed_pytorch_tpu.serve import aggregate
+    sp = reqs[0][1]
+    fn = jax.jit(make_generate_fn(model, sp.max_new_tokens,
+                                  max_len=max_len))
+    # compile lands inside the wall, same as the engine arm (both pay
+    # their first-call compiles in the measured region)
+    records, t0 = [], time.monotonic()
+    for g0 in range(0, len(reqs), n_slots):
+        group = reqs[g0:g0 + n_slots]
+        prompts = jnp.asarray(np.stack([p for p, _, _ in group]))
+        gt0 = time.monotonic()
+        toks = fn(params, prompts, group[0][2])
+        jax.block_until_ready(toks)
+        gt1 = time.monotonic()
+        for i in range(len(group)):
+            n = sp.max_new_tokens
+            records.append({
+                "request_id": g0 + i, "outcome": "ok",
+                "prompt_len": int(prompts.shape[1]), "n_tokens": n,
+                # all tokens arrive at batch completion: TTFT is the
+                # group wall from t=0 (closed loop), TPOT the amortized
+                # per-token group time
+                "ttft_ms": (gt1 - t0) * 1e3,
+                "tpot_ms": (gt1 - gt0) * 1e3 / n,
+                "queue_ms": (gt0 - t0) * 1e3,
+            })
+    return aggregate(records, wall_s=time.monotonic() - t0)
+
+
+def main(argv):
+    smoke = "--smoke" in argv
+
+    def flag(name, default):
+        if name in argv:
+            return type(default)(argv[argv.index(name) + 1])
+        return default
+
+    n_slots = flag("--slots", 4)
+    n_req = flag("--requests", 12 if smoke else 64)
+    max_new = flag("--max-new", 8 if smoke else 64)
+    rate = flag("--rate", 0.0) or (50.0 if smoke else 8.0)
+    seed = flag("--seed", 0)
+    max_len = 64 if smoke else 512
+
+    model, params = build_model(smoke)
+    rec = {"bench": "serve", "smoke": smoke,
+           "config": {"n_slots": n_slots, "n_requests": n_req,
+                      "max_new": max_new, "rate_rps": rate,
+                      "max_len": max_len, "vocab": model.vocab,
+                      "dim": model.dim, "n_layers": model.n_layers},
+           "arms": {}}
+
+    # closed loop (mixed population)
+    mixed = make_requests(n_req, model.vocab, max_new, seed)
+    closed, outs = run_engine(model, params, mixed, n_slots, max_len)
+    rec["arms"]["engine_closed"] = closed
+
+    if smoke:
+        # correctness gate: engine streams == standalone generate()
+        import jax
+        import jax.numpy as jnp
+        from distributed_pytorch_tpu.models.generate import make_generate_fn
+        for i in (0, n_req // 2, n_req - 1):
+            prompt, sp, key = mixed[i]
+            ref = np.asarray(jax.jit(make_generate_fn(
+                model, sp.max_new_tokens, max_len=max_len))(
+                params, jnp.asarray(prompt[None]), key))[0]
+            if not np.array_equal(outs[i], ref):
+                print(json.dumps({"bench": "serve", "error":
+                                  f"request {i} diverged from "
+                                  f"standalone generate()"}))
+                return 1
+        rec["engine_matches_generate"] = True
+
+    # open loop (Poisson arrivals, mixed population)
+    open_rep, _ = run_engine(model, params, mixed, n_slots, max_len,
+                             rate=rate, seed=seed + 1)
+    rec["arms"]["engine_open_poisson"] = open_rep
+
+    # static-batching baseline (uniform shapes; generate has no per-row
+    # lengths)
+    uni = make_requests(n_req, model.vocab, max_new, seed, uniform=True)
+    rec["arms"]["static_batch"] = run_static(model, params, uni, n_slots,
+                                             max_len)
+    eng_uni, _ = run_engine(model, params, uni, n_slots, max_len)
+    rec["arms"]["engine_closed_uniform"] = eng_uni
+    st, en = rec["arms"]["static_batch"], eng_uni
+    if st.get("ttft_ms_p50") and en.get("ttft_ms_p50"):
+        rec["engine_vs_static_ttft_p50_x"] = round(
+            st["ttft_ms_p50"] / en["ttft_ms_p50"], 2)
+
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

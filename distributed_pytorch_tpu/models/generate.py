@@ -182,6 +182,127 @@ def decode_step(model: TransformerLM, params: Params, cache: KVCache,
     return logits, KVCache(k=new_k, v=new_v, length=idx + 1)
 
 
+def prefill_partial(model: TransformerLM, params: Params, tokens,
+                    true_len,
+                    window: Optional[int] = None
+                    ) -> Tuple[jnp.ndarray, list, list]:
+    """Prefill over a RIGHT-PADDED prompt — the slot-writable half of
+    :func:`prefill` for the serving engine (``serve/``).
+
+    tokens: (B, S) int32 where only the first ``true_len`` positions are
+    real (``true_len`` may be traced — one compile per padded length
+    bucket, not per prompt length). Causality makes the pad tail inert:
+    real query positions never attend a later pad key, so the logits at
+    position ``true_len - 1`` are bit-identical to an exact-length
+    :func:`prefill` (the pad keys only ever contribute exact zeros to
+    masked-softmax sums).
+
+    Returns ``(logits (B, vocab) at the last real position, ks, vs)``
+    where ks/vs are per-layer (B, Hkv, S, Dh) — or, with ``window``, the
+    (B, Hkv, W, Dh) ROLLING layout of :func:`prefill` (position p at
+    slot ``p % W``, unreached slots zeroed) built by gather so
+    ``true_len`` can stay traced. The caller owns writing these rows
+    into a cache pool (``serve/cache.py``)."""
+    b, s = tokens.shape
+    true_len = jnp.asarray(true_len, jnp.int32)
+    x = model.tok.apply(params["tok"], tokens)
+    positions = jnp.arange(s)
+    if getattr(model, "pos", None) is not None:
+        x = x + model.pos.apply(params["pos"], positions)
+    ks, vs = [], []
+    for i, blk in enumerate(model.blocks):
+        p = params["blocks"][i]
+        hq, hk, hv = blk.attn.project_qkv(p["attn"],
+                                          blk.ln1.apply(p["ln1"], x))
+        hq, hk = blk.attn.maybe_rope(hq, hk, positions)
+        o = blk.attn.attn_fn(hq, hk, hv, causal=True)
+        x = x + blk.attn.project_out(p["attn"], o)
+        x = x + blk.mlp(p, x)
+        hk = hk.astype(model.dtype)
+        hv = hv.astype(model.dtype)
+        if window is not None:
+            # rolling layout with a TRACED true_len: slot j holds the
+            # largest real position ≡ j (mod W) — a gather, so no
+            # dynamic shapes (prefill's roll trick needs static lengths)
+            j = jnp.arange(window)
+            p_j = true_len - 1 - ((true_len - 1 - j) % window)
+            valid = (p_j >= 0)[None, None, :, None]
+            take = jnp.take(hk, jnp.clip(p_j, 0, s - 1), axis=2)
+            ks.append(jnp.where(valid, take, 0))
+            take = jnp.take(hv, jnp.clip(p_j, 0, s - 1), axis=2)
+            vs.append(jnp.where(valid, take, 0))
+        else:
+            ks.append(hk)
+            vs.append(hv)
+    x_last = jax.lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)
+    x_last = model.ln_f.apply(params["ln_f"], x_last)
+    return model.project_vocab(params, x_last)[:, 0], ks, vs
+
+
+def decode_step_slots(model: TransformerLM, params: Params, ks, vs,
+                      lengths, tokens,
+                      window: Optional[int] = None
+                      ) -> Tuple[jnp.ndarray, list, list]:
+    """One decode step over a SLOT POOL: per-row cache lengths.
+
+    The continuous-batching generalization of :func:`decode_step` — the
+    pool rows are independent requests at different depths, so the
+    scalar ``cache.length`` becomes ``lengths`` (B,) int32 and every
+    row writes/masks at its own position (the write is a where-mask
+    select, value-identical to ``dynamic_update_slice``). ks/vs:
+    per-layer (B, Hkv, max_len, Dh); tokens (B,) int32.
+
+    Per-row math is exactly :func:`decode_step`'s; XLA's fusion choices
+    are batch-shape-dependent, so across DIFFERENT batch shapes logits
+    agree to ~1 ulp rather than bitwise — sampled token streams are
+    what the serving engine guarantees identical (tests/test_serve.py).
+
+    Returns ``(logits (B, vocab), new_ks, new_vs)``; advancing
+    ``lengths`` (and masking dead slots) is the caller's business."""
+    idx = lengths
+    x = model.tok.apply(params["tok"], tokens[:, None])       # (B,1,D)
+    if getattr(model, "pos", None) is not None:
+        x = x + model.pos.apply(params["pos"], idx[:, None])
+    scale = 1.0 / math.sqrt(model.dim // model.n_heads)
+    max_len = ks[0].shape[2]
+    if window is not None:
+        slots = jnp.arange(max_len)[None, :]
+        slot_pos = idx[:, None] - ((idx[:, None] - slots) % window)
+        pos_mask = slot_pos >= 0                           # (B, W)
+        write_at = idx % window
+    else:
+        pos_mask = jnp.arange(max_len)[None, :] <= idx[:, None]
+        write_at = idx
+    write_mask = (jnp.arange(max_len)[None, :]
+                  == write_at[:, None])[:, None, :, None]  # (B,1,L,1)
+
+    new_k, new_v = [], []
+    for i, blk in enumerate(model.blocks):
+        p = params["blocks"][i]
+        hq, hk, hv = blk.attn.project_qkv(p["attn"],
+                                          blk.ln1.apply(p["ln1"], x))
+        hq, hk = blk.attn.maybe_rope(hq, hk, idx[:, None, None])
+        k = jnp.where(write_mask, hk.astype(ks[i].dtype), ks[i])
+        v = jnp.where(write_mask, hv.astype(vs[i].dtype), vs[i])
+        new_k.append(k)
+        new_v.append(v)
+        bq, hh, _, dd = hq.shape
+        hkv = k.shape[1]
+        hq_g = hq.reshape(bq, hkv, hh // hkv, 1, dd)
+        logits = jnp.einsum("bngqd,bnkd->bngqk", hq_g, k).astype(
+            jnp.float32) * scale                        # (B,Hkv,g,1,max)
+        logits = jnp.where(pos_mask[:, None, None, None, :], logits,
+                           -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        o = jnp.einsum("bngqk,bnkd->bngqd", probs, v) \
+            .reshape(bq, hh, 1, dd)
+        x = x + blk.attn.project_out(p["attn"], o)
+        x = x + blk.mlp(p, x)
+
+    x = model.ln_f.apply(params["ln_f"], x)
+    return model.project_vocab(params, x)[:, 0], new_k, new_v
+
+
 def _sample(logits, rng, temperature: float, top_k: Optional[int],
             top_p: Optional[float] = None):
     if temperature == 0.0:

@@ -37,6 +37,11 @@ everything):
 - ``step``    — the training step; specs *without* ``op`` fire from
   :func:`on_step` (train loops call it once per step); specs *with*
   ``op`` use it as an additional filter against the latest step seen.
+  The serving engine (``serve/``) reports its engine ITERATION as the
+  step via :func:`on_serve_iteration`, and additionally fires op-scoped
+  specs under ``op=serve_step`` — so ``delay@op=serve_step,call=5,ms=400``
+  stalls exactly the 5th engine iteration (the chaos-test grammar for
+  serving; docs/serving.md).
 - ``attempt`` — the elastic restart attempt (``DPX_ELASTIC_ATTEMPT``),
   so a fault can be scoped to the first launch only.
 - ``ms``      — the stall duration for ``delay``.
@@ -233,6 +238,24 @@ def on_comm_op(op: str, rank: Optional[int] = None, comm=None) -> None:
         if not spec.matches_rank_attempt(rank):
             continue
         _fire(spec, f"op={op},call={n}", rank, comm)
+
+
+#: The op name under which the serving engine's iteration hook fires
+#: op-scoped specs (``serve/engine.py`` calls once per engine iteration).
+SERVE_OP = "serve_step"
+
+
+def on_serve_iteration(iteration: int, rank: Optional[int] = None) -> None:
+    """Hook: the serving engine calls this once per engine iteration.
+
+    Fires both vocabularies: step-scoped specs with the iteration as
+    the step (``kill@step=7`` hard-kills the serving process at
+    iteration 7 — subprocess chaos tests only), and op-scoped specs
+    under ``op=serve_step`` with per-process call counting
+    (``delay@op=serve_step,call=5,ms=400`` stalls iteration 5 — the
+    in-process deadline chaos case in tests/test_serve.py)."""
+    on_step(iteration, rank=rank)
+    on_comm_op(SERVE_OP, rank=rank)
 
 
 def on_step(step: int, rank: Optional[int] = None) -> None:

@@ -1,0 +1,25 @@
+"""serve/ — continuous-batching LM inference on the training stack.
+
+The ROADMAP's "serves heavy traffic" leg: an Orca-style engine that
+runs many concurrent, independently-arriving requests through ONE
+accelerator with iteration-level scheduling — a slot-pooled, fixed-
+shape KV cache (``cache``), an admission scheduler with bounded queue +
+priorities + per-request deadlines (``scheduler``), the engine loop and
+threaded front door (``engine``), and per-request SLO metrics
+(``metrics``). Architecture and failure grammar: docs/serving.md.
+"""
+
+from .cache import CompileCounts, SlotPool  # noqa: F401
+from .engine import EngineConfig, InferenceEngine  # noqa: F401
+from .metrics import aggregate, percentile, request_record  # noqa: F401
+from .scheduler import AdmissionScheduler  # noqa: F401
+from .types import (AdmissionRejected, EngineStopped, Request,  # noqa: F401
+                    RequestDeadlineExceeded, RequestHandle, SamplingParams,
+                    ServeError)
+
+__all__ = [
+    "AdmissionRejected", "AdmissionScheduler", "CompileCounts",
+    "EngineConfig", "EngineStopped", "InferenceEngine", "Request",
+    "RequestDeadlineExceeded", "RequestHandle", "SamplingParams",
+    "ServeError", "SlotPool", "aggregate", "percentile", "request_record",
+]

@@ -1,0 +1,136 @@
+"""Slot-pooled KV cache: fixed shapes, one jitted decode for any mix.
+
+The pool is the continuous-batching counterpart of
+``models.generate.KVCache``: per layer one (n_slots, Hkv, width, Dh)
+buffer for K and V (width = ``max_len``, or the model's sliding window
+under the rolling O(window) layout) plus a per-slot ``lengths``
+(n_slots,) int32 vector. All shapes are static, so the whole serving
+life of the engine is exactly
+
+- ONE compiled decode program (all slots advance one token, each at its
+  own position — ``decode_step_slots``), and
+- one compiled admit program PER PREFILL BUCKET (prompts are
+  right-padded to a bounded set of lengths; ``prefill_partial`` keeps
+  the true length traced).
+
+Slot recycling needs no clearing: a freed slot's stale K/V rows are
+never attended, because the per-row position mask only exposes
+positions ≤ the slot's current length and every position ≤ length was
+written by the CURRENT occupant (admission rewrites the prefix, decode
+writes each position as it reaches it; the windowed layout zero-fills
+unreached slots at admission).
+
+Compile counts are observable (``CompileCounts``) so tests can assert
+the bounded-variants contract instead of trusting it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.generate import decode_step_slots, prefill_partial
+
+
+@dataclass
+class CompileCounts:
+    """Trace-time counters — each jitted program bumps its counter when
+    (re)traced, so ``decode == 1`` after a whole serving run IS the
+    zero-recompile claim, asserted."""
+
+    decode: int = 0
+    prefill: Dict[int, int] = field(default_factory=dict)  # bucket -> n
+    sample: int = 0
+
+    def bump_prefill(self, bucket: int) -> None:
+        self.prefill[bucket] = self.prefill.get(bucket, 0) + 1
+
+
+class SlotPool:
+    """Owns the pooled cache arrays and the jitted slot programs."""
+
+    def __init__(self, model, n_slots: int, max_len: int,
+                 window: Optional[int] = None):
+        self.model = model
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.window = window
+        self.width = window if window is not None else max_len
+        dh = model.dim // model.n_heads
+        h_kv = getattr(model, "n_kv_heads", model.n_heads)
+        shape = (n_slots, h_kv, self.width, dh)
+        self.ks: List[jax.Array] = [jnp.zeros(shape, model.dtype)
+                                    for _ in range(model.n_layers)]
+        self.vs: List[jax.Array] = [jnp.zeros(shape, model.dtype)
+                                    for _ in range(model.n_layers)]
+        self.lengths = jnp.zeros((n_slots,), jnp.int32)
+        self.compiles = CompileCounts()
+        self._admit_fns: Dict[int, callable] = {}
+        # donate the pool buffers: the caller always replaces its
+        # references with the returned pools, and without donation the
+        # decode hot loop would copy the WHOLE pool every token (2x
+        # peak KV memory) instead of updating in place
+        self._decode_fn = jax.jit(self._decode, donate_argnums=(1, 2, 3))
+
+    # -- jitted programs ---------------------------------------------------
+
+    def _decode(self, params, ks, vs, lengths, tokens, active):
+        self.compiles.decode += 1          # trace-time only
+        logits, ks, vs = decode_step_slots(self.model, params, ks, vs,
+                                           lengths, tokens,
+                                           window=self.window)
+        lengths = jnp.where(active, lengths + 1, lengths)
+        return logits, ks, vs, lengths
+
+    def _admit(self, params, ks, vs, lengths, tokens, true_len, slot,
+               *, bucket: int):
+        self.compiles.bump_prefill(bucket)  # trace-time only
+        logits, kr, vr = prefill_partial(self.model, params, tokens,
+                                         true_len, window=self.window)
+        if self.window is None:
+            # write the bucket-wide prefix of the slot row; positions
+            # ≥ true_len hold pad/stale K/V the mask never exposes
+            at = (slot, 0, 0, 0)
+            ks = [jax.lax.dynamic_update_slice(k, r.astype(k.dtype), at)
+                  for k, r in zip(ks, kr)]
+            vs = [jax.lax.dynamic_update_slice(v, r.astype(v.dtype), at)
+                  for v, r in zip(vs, vr)]
+        else:
+            # rolling layout is already width-W (zero-filled where
+            # unreached): replace the whole row, clearing stale state
+            ks = [k.at[slot].set(r[0].astype(k.dtype))
+                  for k, r in zip(ks, kr)]
+            vs = [v.at[slot].set(r[0].astype(v.dtype))
+                  for v, r in zip(vs, vr)]
+        lengths = lengths.at[slot].set(true_len)
+        return logits, ks, vs, lengths
+
+    # -- host front ends ---------------------------------------------------
+
+    def admit(self, params, tokens_padded, true_len: int, slot: int):
+        """Prefill ``tokens_padded`` (1, bucket) into ``slot``; returns
+        the last-real-position logits (1, vocab). One compile per
+        distinct bucket width."""
+        bucket = tokens_padded.shape[1]
+        fn = self._admit_fns.get(bucket)
+        if fn is None:
+            fn = jax.jit(partial(self._admit, bucket=bucket),
+                         donate_argnums=(1, 2, 3))
+            self._admit_fns[bucket] = fn
+        logits, self.ks, self.vs, self.lengths = fn(
+            params, self.ks, self.vs, self.lengths, tokens_padded,
+            jnp.asarray(true_len, jnp.int32), jnp.asarray(slot, jnp.int32))
+        return logits
+
+    def decode(self, params, tokens, active):
+        """Advance every slot one position (dead slots masked: their
+        lengths freeze and their outputs are discarded by the caller).
+        tokens/active: (n_slots,) int32 / bool. Returns (n_slots, vocab)
+        logits."""
+        logits, self.ks, self.vs, self.lengths = self._decode_fn(
+            params, self.ks, self.vs, self.lengths, tokens, active)
+        return logits

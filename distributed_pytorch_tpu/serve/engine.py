@@ -1,0 +1,392 @@
+"""The continuous-batching inference engine (Orca-style iteration-level
+scheduling over a shared decode batch).
+
+One engine thread runs the iteration loop; each iteration
+
+1. fires the fault-injection hooks (``DPX_FAULT`` — docs/serving.md),
+2. sweeps deadlines (queued AND running requests; a miss surfaces as a
+   typed ``RequestDeadlineExceeded`` on that request's future, other
+   slots untouched),
+3. admits queued requests into free slots (prefill, right-padded to a
+   length bucket — one compile per bucket), and
+4. advances EVERY active slot one token through the single jitted
+   decode program (``serve.cache.SlotPool``), retiring slots that hit
+   ``max_new_tokens`` / ``eos_token`` so the next iteration can refill
+   them.
+
+Determinism contract: each request's token stream is identical to a
+standalone ``models.generate.generate`` call with the same params/rng
+(same per-request ``jax.random.split`` schedule, same ``_sample``;
+asserted in tests/test_serve.py). Logits agree with the standalone
+pipeline to ~1 ulp — XLA fuses differently at different batch shapes —
+which is why the contract is over token streams, not logit bits.
+
+SLO metrics (TTFT/TPOT/queue depth/slot occupancy, defined in
+``serve.metrics``) flow into the line-JSON ``MetricsLogger`` stream.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generate import (_check_attn_compatible, _model_window,
+                               _sample)
+from ..runtime import faults
+from ..utils.logging import MetricsLogger
+from .cache import SlotPool
+from .metrics import request_record
+from .scheduler import AdmissionScheduler
+from .types import (FAILED, FINISHED, RUNNING, AdmissionRejected,
+                    EngineStopped, Request, RequestDeadlineExceeded,
+                    RequestHandle, SamplingParams)
+
+
+def _default_buckets(cap: int) -> Tuple[int, ...]:
+    """Power-of-two prefill buckets up to ``cap`` (inclusive) — a
+    bounded set of compile variants covering every admissible prompt."""
+    out, b = [], 8
+    while b < cap:
+        out.append(b)
+        b *= 2
+    out.append(cap)
+    return tuple(sorted(set(out)))
+
+
+@dataclass
+class EngineConfig:
+    """Engine shape and policy. ``n_slots`` × ``max_len`` is the whole
+    KV memory budget (fixed at startup — serving never reallocates);
+    ``buckets`` are the padded prefill lengths (None = powers of two up
+    to ``max_len``); ``max_queue`` bounds admission; ``metrics`` is an
+    optional line-JSON ``MetricsLogger`` receiving per-request SLO
+    events and periodic occupancy records."""
+
+    n_slots: int = 4
+    max_len: int = 256
+    buckets: Optional[Tuple[int, ...]] = None
+    max_queue: int = 64
+    metrics: Optional[MetricsLogger] = None
+    log_every: int = 16
+    allow_custom_attn: bool = False
+
+
+class InferenceEngine:
+    """Threaded serving front door over ``TransformerLM`` params.
+
+    >>> eng = InferenceEngine(model, params, EngineConfig(n_slots=4))
+    >>> eng.start()
+    >>> h = eng.submit(prompt_ids, SamplingParams(max_new_tokens=32))
+    >>> tokens = h.result(timeout=60)   # np (n,) int32
+    >>> eng.shutdown()
+    """
+
+    def __init__(self, model, params, config: Optional[EngineConfig] = None):
+        self.config = cfg = config or EngineConfig()
+        if cfg.n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {cfg.n_slots}")
+        _check_attn_compatible(model, cfg.allow_custom_attn)
+        self.model = model
+        self.params = params
+        self.window = _model_window(model)
+        if (self.window is None and getattr(model, "pos", None) is not None
+                and cfg.max_len > model.max_seq):
+            raise ValueError(
+                f"max_len {cfg.max_len} exceeds the model's max_seq "
+                f"({model.max_seq}): learned position embeddings cannot "
+                "address slots past their table")
+        self.buckets = tuple(sorted(cfg.buckets)) if cfg.buckets \
+            else _default_buckets(cfg.max_len)
+        if self.window is None and max(self.buckets) > cfg.max_len:
+            raise ValueError(
+                f"largest prefill bucket ({max(self.buckets)}) exceeds "
+                f"max_len ({cfg.max_len}) — the slot row cannot hold it")
+        self.pool = SlotPool(model, cfg.n_slots, cfg.max_len,
+                             window=self.window)
+        self.metrics = cfg.metrics
+        self._scheduler = AdmissionScheduler(cfg.max_queue)
+        self._samplers: Dict[tuple, callable] = {}
+        self._running: Dict[int, Request] = {}     # slot -> request
+        self._free: List[int] = list(range(cfg.n_slots))[::-1]
+        self._cur_tokens = np.zeros(cfg.n_slots, np.int32)
+        self._iteration = 0
+        self._tokens_emitted = 0
+        self._completed = 0
+        self._failed = 0
+        self._next_id = 0
+        self._cond = threading.Condition()
+        self._stop = False
+        self._crash: Optional[Exception] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- front door --------------------------------------------------------
+
+    def submit(self, prompt, params: Optional[SamplingParams] = None, *,
+               rng=None, on_token=None) -> RequestHandle:
+        """Enqueue one request; returns immediately with a handle.
+
+        ``prompt``: (S,) int token ids. ``rng``: the request's PRNG key
+        (defaults to ``PRNGKey(request_id)``) — the engine consumes it
+        with exactly ``generate()``'s split schedule, so the same key
+        reproduces the same stream standalone. Raises a typed
+        :class:`AdmissionRejected` synchronously when the request can
+        never be served (or the bounded queue is full)."""
+        sp = params or SamplingParams()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with self._cond:
+            if self._stop:
+                raise EngineStopped("engine is shut down")
+            rid = self._next_id
+            self._next_id += 1
+        self._validate(prompt, sp, rid)
+        if rng is None:
+            rng = jax.random.PRNGKey(rid)
+        rngs = np.asarray(jax.random.split(rng, sp.max_new_tokens))
+        now = time.monotonic()
+        req = Request(request_id=rid, prompt=prompt, params=sp, rngs=rngs,
+                      submit_t=now,
+                      deadline_t=(now + sp.deadline_ms / 1e3
+                                  if sp.deadline_ms is not None else None),
+                      on_token=on_token)
+        req.handle = RequestHandle(req)
+        # enqueue under the same lock the stop flag lives behind: a
+        # submit that races shutdown either sees _stop and raises, or
+        # lands the request BEFORE the engine thread's final drain —
+        # never in a dead scheduler with a forever-pending future
+        with self._cond:
+            if self._stop:
+                raise EngineStopped("engine is shut down")
+            self._scheduler.submit(req)   # may raise AdmissionRejected
+            self._cond.notify_all()
+        return req.handle
+
+    def _validate(self, prompt, sp: SamplingParams, rid: int) -> None:
+        s = int(prompt.shape[0])
+        if s < 1 or sp.max_new_tokens < 1:
+            raise AdmissionRejected(
+                f"request {rid}: empty prompt or max_new_tokens < 1",
+                reason="invalid", request_id=rid)
+        if s > max(self.buckets):
+            raise AdmissionRejected(
+                f"request {rid}: prompt length {s} exceeds the largest "
+                f"prefill bucket ({max(self.buckets)})",
+                reason="prompt_too_long", request_id=rid)
+        if self.window is None and s + sp.max_new_tokens > self.config.max_len:
+            raise AdmissionRejected(
+                f"request {rid}: prompt ({s}) + max_new_tokens "
+                f"({sp.max_new_tokens}) exceeds the slot cache "
+                f"({self.config.max_len})",
+                reason="too_long", request_id=rid)
+        if (self.window is not None
+                and getattr(self.model, "pos", None) is not None
+                and s + sp.max_new_tokens > self.model.max_seq):
+            raise AdmissionRejected(
+                f"request {rid}: learned position embeddings cannot "
+                f"extrapolate past max_seq ({self.model.max_seq})",
+                reason="too_long", request_id=rid)
+
+    def start(self) -> "InferenceEngine":
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        self._thread = threading.Thread(target=self._loop,
+                                        name="dpx-serve-engine", daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self, wait: bool = True) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if wait and self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def __enter__(self) -> "InferenceEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    def stats(self) -> Dict:
+        c = self.pool.compiles
+        return {"iterations": self._iteration,
+                "completed": self._completed, "failed": self._failed,
+                "tokens_emitted": self._tokens_emitted,
+                "queue_depth": len(self._scheduler),
+                "active_slots": len(self._running),
+                "n_slots": self.config.n_slots,
+                "decode_compiles": c.decode,
+                "prefill_compiles": dict(c.prefill),
+                "sample_compiles": c.sample,
+                "buckets": self.buckets}
+
+    # -- engine loop -------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                # untimed wait is safe: both transitions out of idle
+                # (submit enqueue, shutdown stop flag) notify under
+                # this lock, and no deadline can be pending while the
+                # queue AND the running set are empty
+                while (not self._stop and not self._running
+                       and not len(self._scheduler)):
+                    self._cond.wait()
+                if self._stop:
+                    break
+            self._iteration += 1
+            try:
+                faults.on_serve_iteration(self._iteration)
+                now = time.monotonic()
+                self._sweep_deadlines(now)
+                self._admit_from_queue()
+                if self._running:
+                    self._decode_all()
+            except Exception as e:  # noqa: BLE001
+                # an engine-loop crash (XLA error, bad params) must not
+                # strand every future unresolved: fail them typed, with
+                # the cause chained, then stop serving
+                with self._cond:
+                    self._stop = True
+                self._crash = e
+                break
+            if (self.metrics is not None
+                    and self._iteration % self.config.log_every == 0):
+                self.metrics.log(
+                    step=self._iteration, kind="serve_engine",
+                    queue_depth=len(self._scheduler),
+                    active_slots=len(self._running),
+                    slot_occupancy=len(self._running) / self.config.n_slots,
+                    tokens_emitted=self._tokens_emitted)
+        self._drain_on_stop()
+
+    def _sweep_deadlines(self, now: float) -> None:
+        for req in self._scheduler.expired(now):
+            self._fail(req, RequestDeadlineExceeded(
+                f"request {req.request_id} missed its deadline "
+                f"({req.params.deadline_ms} ms) while queued",
+                deadline_ms=req.params.deadline_ms, stage="queued",
+                request_id=req.request_id, iteration=self._iteration),
+                outcome="deadline_queued")
+        for slot, req in list(self._running.items()):
+            if req.deadline_t is not None and now >= req.deadline_t:
+                self._fail(req, RequestDeadlineExceeded(
+                    f"request {req.request_id} missed its deadline "
+                    f"({req.params.deadline_ms} ms) mid-decode after "
+                    f"{len(req.out_tokens)} tokens",
+                    deadline_ms=req.params.deadline_ms, stage="running",
+                    request_id=req.request_id, iteration=self._iteration),
+                    outcome="deadline_running")
+
+    def _admit_from_queue(self) -> None:
+        while self._free:
+            req = self._scheduler.pop()
+            if req is None:
+                return
+            slot = self._free.pop()
+            # claim the slot BEFORE the prefill call: if it raises, the
+            # crash drain finds the request in _running and fails its
+            # future instead of stranding it half-admitted
+            req.state = RUNNING
+            req.slot = slot
+            self._running[slot] = req
+            s = int(req.prompt.shape[0])
+            bucket = next(b for b in self.buckets if b >= s)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :s] = req.prompt
+            logits = self.pool.admit(self.params, jnp.asarray(padded), s,
+                                     slot)
+            req.admit_t = time.monotonic()
+            req.admit_iteration = self._iteration
+            tok = self._sample_for(req, logits)
+            self._emit(req, tok)
+
+    def _decode_all(self) -> None:
+        active = np.zeros(self.config.n_slots, bool)
+        active[list(self._running)] = True
+        logits = self.pool.decode(self.params,
+                                  jnp.asarray(self._cur_tokens),
+                                  jnp.asarray(active))
+        for slot in sorted(self._running):
+            req = self._running[slot]
+            tok = self._sample_for(req, logits[slot:slot + 1])
+            self._emit(req, tok)
+
+    def _sample_for(self, req: Request, logits) -> int:
+        fn = self._samplers.get(req.params.sampler_key)
+        if fn is None:
+            t, k, p = req.params.sampler_key
+            pool = self.pool
+
+            def sample(lg, rng, t=t, k=k, p=p):
+                pool.compiles.sample += 1          # trace-time only
+                return _sample(lg, rng, t, k, p)
+            fn = jax.jit(sample)
+            self._samplers[req.params.sampler_key] = fn
+        key = jnp.asarray(req.rngs[len(req.out_tokens)])
+        return int(np.asarray(fn(logits, key))[0])
+
+    def _emit(self, req: Request, tok: int) -> None:
+        now = time.monotonic()
+        i = len(req.out_tokens)
+        req.out_tokens.append(tok)    # handle.tokens aliases this list
+        if req.first_token_t is None:
+            req.first_token_t = now
+        req.last_token_t = now
+        self._cur_tokens[req.slot] = tok
+        self._tokens_emitted += 1
+        if req.on_token is not None:
+            try:
+                req.on_token(tok, i)
+            except Exception:  # noqa: BLE001 — a user callback must
+                pass           # never take down the engine loop
+        sp = req.params
+        if (len(req.out_tokens) >= sp.max_new_tokens
+                or (sp.eos_token is not None and tok == sp.eos_token)):
+            self._retire(req)
+
+    def _free_slot(self, req: Request) -> None:
+        if req.slot is not None:
+            self._running.pop(req.slot, None)
+            self._free.append(req.slot)
+            req.slot = None
+
+    def _retire(self, req: Request) -> None:
+        req.state = FINISHED
+        req.retire_iteration = self._iteration
+        self._free_slot(req)
+        self._completed += 1
+        rec = request_record(req, "ok")
+        req.handle.metrics = rec
+        if self.metrics is not None:
+            self.metrics.event("serve_request", **rec)
+        req.handle.future.set_result(
+            np.asarray(req.out_tokens, np.int32))
+
+    def _fail(self, req: Request, exc: Exception, outcome: str) -> None:
+        req.state = FAILED
+        req.retire_iteration = self._iteration
+        self._free_slot(req)
+        self._failed += 1
+        rec = request_record(req, outcome)
+        req.handle.metrics = rec
+        if self.metrics is not None:
+            self.metrics.event("serve_request", **rec)
+        req.handle.future.set_exception(exc)
+
+    def _drain_on_stop(self) -> None:
+        cause = f" (engine loop crashed: {self._crash!r})" \
+            if self._crash is not None else ""
+        for req in self._scheduler.drain() + list(self._running.values()):
+            exc = EngineStopped(
+                f"engine stopped with request {req.request_id} "
+                f"{req.state}{cause}", request_id=req.request_id,
+                iteration=self._iteration)
+            exc.__cause__ = self._crash
+            self._fail(req, exc, outcome="engine_stopped")

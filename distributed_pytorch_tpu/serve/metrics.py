@@ -1,0 +1,73 @@
+"""Per-request SLO metrics: TTFT, TPOT, and their fleet aggregates.
+
+Definitions (docs/serving.md):
+
+- **TTFT** (time to first token): submit → first emitted token,
+  including queue wait — the user-visible latency of "it started".
+- **TPOT** (time per output token): mean inter-token gap AFTER the
+  first token, ``(last_token_t - first_token_t) / (n_tokens - 1)`` —
+  the streaming cadence. Undefined (None) for 1-token outputs.
+
+Records flow into the existing line-JSON ``utils.logging.MetricsLogger``
+(one ``serve_request`` event per completed/failed request, one periodic
+``step`` record with queue depth / slot occupancy), so serving SLOs
+land in the same stream as training metrics and failure events.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .types import Request
+
+
+def request_record(req: Request, outcome: str) -> Dict:
+    """The per-request SLO record (goes into the metrics log and the
+    request handle's ``.metrics``)."""
+    n = len(req.out_tokens)
+    ttft_ms = tpot_ms = None
+    if req.first_token_t is not None:
+        ttft_ms = (req.first_token_t - req.submit_t) * 1e3
+        if n > 1 and req.last_token_t is not None:
+            tpot_ms = (req.last_token_t - req.first_token_t) * 1e3 / (n - 1)
+    rec = {"request_id": req.request_id, "outcome": outcome,
+           "prompt_len": int(len(req.prompt)), "n_tokens": n,
+           "ttft_ms": ttft_ms, "tpot_ms": tpot_ms,
+           "queue_ms": ((req.admit_t - req.submit_t) * 1e3
+                        if req.admit_t is not None else None),
+           "admit_iteration": req.admit_iteration,
+           "retire_iteration": req.retire_iteration}
+    return rec
+
+
+def percentile(xs: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile without numpy (bench/report helper)."""
+    xs = sorted(x for x in xs if x is not None)
+    if not xs:
+        return None
+    i = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[i]
+
+
+def aggregate(records: List[Dict], wall_s: Optional[float] = None) -> Dict:
+    """Fleet summary over per-request records: p50/p99 TTFT & TPOT,
+    tokens/s, outcome counts."""
+    ok = [r for r in records if r["outcome"] == "ok"]
+    ttft = [r["ttft_ms"] for r in ok if r["ttft_ms"] is not None]
+    tpot = [r["tpot_ms"] for r in ok if r["tpot_ms"] is not None]
+    toks = sum(r["n_tokens"] for r in ok)
+    out = {
+        "n_requests": len(records),
+        "n_ok": len(ok),
+        "outcomes": {o: sum(1 for r in records if r["outcome"] == o)
+                     for o in sorted({r["outcome"] for r in records})},
+        "total_tokens": toks,
+        "ttft_ms_p50": percentile(ttft, 50),
+        "ttft_ms_p99": percentile(ttft, 99),
+        "tpot_ms_p50": percentile(tpot, 50),
+        "tpot_ms_p99": percentile(tpot, 99),
+    }
+    if wall_s:
+        out["wall_s"] = round(wall_s, 3)
+        out["tokens_per_sec"] = round(toks / wall_s, 2)
+    return out

@@ -1,0 +1,142 @@
+"""Request-level types of the serving engine: sampling parameters, the
+request lifecycle, and the typed failure vocabulary.
+
+The error hierarchy follows the PR-2 comm design
+(``runtime.native.CommError``): every failure is a TYPED exception that
+carries enough to *attribute* it — which request, which engine
+iteration, which stage of the lifecycle — so callers never parse
+message strings, and the same names flow into the line-JSON metrics
+log. ``RequestDeadlineExceeded`` mirrors ``CommTimeout``'s
+``deadline_ms`` field on purpose: a per-request SLO miss and a
+per-collective deadline miss are the same failure shape at two layers.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request generation knobs.
+
+    ``temperature``/``top_k``/``top_p`` have exactly the semantics of
+    ``models.generate.make_generate_fn`` (temperature 0 is greedy) —
+    the engine compiles one tiny sampler per DISTINCT (temperature,
+    top_k, top_p) triple, so a serving mix should draw from a bounded
+    set of configs. ``eos_token`` stops generation early (the token is
+    included in the output); ``deadline_ms`` is a wall-clock SLO from
+    submit time, enforced while queued AND while decoding; lower
+    ``priority`` runs sooner (FCFS within a priority class)."""
+
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token: Optional[int] = None
+    deadline_ms: Optional[float] = None
+    priority: int = 0
+
+    @property
+    def sampler_key(self):
+        return (self.temperature, self.top_k, self.top_p)
+
+
+class ServeError(RuntimeError):
+    """A serving-engine failure. Base of the typed hierarchy (mirrors
+    ``runtime.native.CommError``): carries the request id and the
+    engine iteration at which the failure was observed."""
+
+    def __init__(self, msg: str, *, request_id: Optional[int] = None,
+                 iteration: Optional[int] = None):
+        super().__init__(msg)
+        self.request_id = request_id
+        self.iteration = iteration
+
+
+class AdmissionRejected(ServeError):
+    """The front door refused the request outright — bounded queue
+    full, prompt longer than the largest prefill bucket, or a
+    prompt+max_new that cannot fit the slot cache. Raised
+    synchronously from ``submit`` with ``reason`` set."""
+
+    def __init__(self, msg: str, *, reason: str = "rejected", **kw):
+        super().__init__(msg, **kw)
+        self.reason = reason
+
+
+class RequestDeadlineExceeded(ServeError):
+    """The request's ``deadline_ms`` SLO elapsed before completion —
+    while still queued (``stage='queued'``) or mid-decode
+    (``stage='running'``). Field names mirror
+    ``runtime.native.CommTimeout`` (PR 2's typed-failure vocabulary)."""
+
+    def __init__(self, msg: str, *, deadline_ms: float = 0.0,
+                 stage: str = "running", **kw):
+        super().__init__(msg, **kw)
+        self.deadline_ms = deadline_ms
+        self.stage = stage
+
+
+class EngineStopped(ServeError):
+    """The engine shut down while the request was still in flight."""
+
+
+#: Request lifecycle states (host-side bookkeeping only).
+QUEUED, RUNNING, FINISHED, FAILED = "queued", "running", "finished", "failed"
+
+
+@dataclass
+class Request:
+    """One in-flight generation request (engine-internal)."""
+
+    request_id: int
+    prompt: Any                      # np.ndarray (S,) int32
+    params: SamplingParams
+    rngs: Any                        # (max_new, 2) uint32 split keys
+    submit_t: float                  # monotonic
+    deadline_t: Optional[float]      # monotonic, or None
+    on_token: Optional[Callable[[int, int], None]] = None
+    handle: Any = None               # RequestHandle (set by the engine)
+    state: str = QUEUED
+    slot: Optional[int] = None
+    out_tokens: List[int] = field(default_factory=list)
+    admit_t: Optional[float] = None
+    admit_iteration: Optional[int] = None
+    retire_iteration: Optional[int] = None
+    first_token_t: Optional[float] = None
+    last_token_t: Optional[float] = None
+
+    @property
+    def done(self) -> bool:
+        return self.state in (FINISHED, FAILED)
+
+
+class RequestHandle:
+    """The caller's view of a submitted request: a future for the final
+    token array, the streamed tokens so far, and (after completion)
+    the per-request SLO metrics."""
+
+    def __init__(self, request: Request):
+        self._request = request
+        self.future: Future = Future()
+        # the ONE token list, shared with the engine-side Request —
+        # appends are GIL-atomic, so mid-stream reads see a consistent
+        # prefix of the stream
+        self.tokens: List[int] = request.out_tokens
+        self.metrics: dict = {}       # filled at completion
+
+    @property
+    def request_id(self) -> int:
+        return self._request.request_id
+
+    @property
+    def state(self) -> str:
+        return self._request.state
+
+    def result(self, timeout: Optional[float] = None):
+        """Block for the final (n_tokens,) int32 array; raises the
+        request's typed ``ServeError`` on failure."""
+        return self.future.result(timeout)

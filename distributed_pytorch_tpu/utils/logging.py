@@ -7,6 +7,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -59,6 +60,10 @@ class MetricsLogger:
         self.path = path
         self.echo = echo
         self._fh = None
+        # the serving engine logs from its engine thread while the
+        # submitting thread may log/close concurrently — one lock keeps
+        # every line intact (line-JSON has no recovery from interleaves)
+        self._lock = threading.Lock()
         if path is not None and is_primary():
             self._fh = open(path, "a")
 
@@ -67,11 +72,15 @@ class MetricsLogger:
             return
         rec: Dict[str, Any] = {"step": step, "time": time.time(), **metrics}
         line = json.dumps(rec, default=float)
-        if self._fh is not None:
-            self._fh.write(line + "\n")
-            self._fh.flush()
-        if self.echo:
-            print(line, file=sys.stdout)
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            if self.echo:
+                # one atomic write under the lock: print() issues two
+                # writes (payload, newline) that concurrent loggers can
+                # interleave on stdout
+                sys.stdout.write(line + "\n")
 
     def event(self, event: str, **fields: Any) -> None:
         """Structured non-step event (failure, relaunch, resume) into the
@@ -80,15 +89,17 @@ class MetricsLogger:
         rec: Dict[str, Any] = {"event": event, "time": time.time(),
                                **fields}
         line = json.dumps(rec, default=str)
-        if self._fh is not None:
-            self._fh.write(line + "\n")
-            self._fh.flush()
-        elif self.path is not None:
-            append_event(event, path=self.path, **fields)
-        if self.echo:
-            print(line, file=sys.stdout)
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            elif self.path is not None:
+                append_event(event, path=self.path, **fields)
+            if self.echo:
+                sys.stdout.write(line + "\n")
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

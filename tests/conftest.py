@@ -22,6 +22,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# NOTE: do NOT enable jax_compilation_cache_dir here. On this jax
+# (0.4.37 CPU) a deserialized cached executable loses input-output
+# donation aliasing: the donated-buffer train step reads clobbered
+# memory and training silently diverges (reproduced via
+# test_transformer_lm_checkpoint_resume_exact going to 1e15 loss).
 
 from distributed_pytorch_tpu.runtime.jax_compat import ensure_cpu_devices  # noqa: E402
 

@@ -279,6 +279,22 @@ def test_roofline_floors_and_measured_wiring():
     assert roofline.measured_step_ms(rows, "bench_mfu") is None
 
 
+def test_roofline_device_kinds_mirror_peak_table():
+    """Every device kind PEAK_BF16 knows must analyze cleanly (v2/v3/v5
+    used to raise a bare KeyError on the HBM lookup — ADVICE round 5),
+    and an unknown kind gets an EXPLICIT unsupported error."""
+    import pytest
+    from benchmarks import roofline
+    from benchmarks.mfu_transformer import FLAGSHIP, PEAK_BF16
+
+    assert set(roofline.HBM_GBPS) == set(PEAK_BF16)
+    for kind in PEAK_BF16:
+        a = roofline.analyze(FLAGSHIP, device_kind=kind)
+        assert a["hbm_floor_ms"] > 0 and a["compute_floor_ms"] > 0
+    with pytest.raises(ValueError, match="unsupported device_kind"):
+        roofline.analyze(FLAGSHIP, device_kind="TPU v99")
+
+
 def test_mfu_record_schema_contract():
     """The keys every consumer joins on (collector ok-gate, report
     tables, roofline measured-join, sweep best-arm pick) — a tiny

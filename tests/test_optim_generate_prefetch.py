@@ -356,6 +356,61 @@ class TestGenerate:
         _, cache = prefill(model, params, prompt, 64, window=W)
         assert cache.k[0].shape[2] == W
 
+    def test_prefill_rolling_layout_prompt_exceeds_window(self):
+        """A prompt LONGER than the rolling cache keeps exactly the
+        last W positions, each at slot p % W — checked value-by-value
+        against the unwindowed cache (same model, same keys), which is
+        the layout contract the serving engine's slot pool reuses.
+        Dense windowed core (flash-equivalence is proven elsewhere;
+        interpret-mode pallas would only slow the layout check)."""
+        from distributed_pytorch_tpu.nn.attention import dense_attention
+        W, S = 8, 20
+
+        def win_fn(q, k, v, *, causal=False, scale=None):
+            return dense_attention(q, k, v, causal=causal, scale=scale,
+                                   window=W)
+        win_fn.window = W
+        model = models.TransformerLM(
+            vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            pos="rope", max_seq=64, attn_fn=win_fn)
+        params = model.init(jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, S), 0, 64)
+        _, rolling = prefill(model, params, prompt, 32, window=W)
+        _, full = prefill(model, params, prompt, 32)
+        assert rolling.k[0].shape[2] == W
+        for i in range(model.n_layers):
+            for j in range(W):
+                p = S - 1 - ((S - 1 - j) % W)      # last W: p % W == j
+                assert p >= S - W
+                np.testing.assert_array_equal(
+                    np.asarray(rolling.k[i][:, :, j]),
+                    np.asarray(full.k[i][:, :, p]))
+
+    def test_sample_deterministic_across_batch_positions(self):
+        """_sample slot-independence (the serving-engine precondition):
+        greedy is exactly row-wise, and for a fixed rng a (1, V) row
+        samples the same token no matter which batch position it was
+        sliced from — so per-request keys reproduce the standalone
+        stream from any slot."""
+        from distributed_pytorch_tpu.models.generate import _sample
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.standard_normal((4, 61)), jnp.float32)
+        # greedy: batched argmax == every row alone
+        batched = _sample(logits, jax.random.PRNGKey(0), 0.0, None)
+        for i in range(4):
+            row = _sample(logits[i:i + 1], jax.random.PRNGKey(0), 0.0,
+                          None)
+            assert int(batched[i]) == int(row[0])
+        # keyed sampling on a (1, V) slice: deterministic across calls
+        # and across the row's original batch position
+        key = jax.random.PRNGKey(7)
+        f = jax.jit(lambda lg: _sample(lg, key, 0.8, 8, 0.9))
+        want = int(f(logits[2:3])[0])
+        for _ in range(3):
+            assert int(f(logits[2:3])[0]) == want
+        moved = jnp.concatenate([logits[2:3], logits[:2]])  # row 2 -> 0
+        assert int(f(moved[0:1])[0]) == want
+
     def test_mixed_window_widths_rejected(self):
         from distributed_pytorch_tpu.ops import make_flash_attn_fn
         model = models.TransformerLM(

@@ -72,10 +72,12 @@ def test_priority_order_smoke_then_flagship():
 
 
 def test_watch_resumes_after_midcollection_wedge(monkeypatch, tmp_path):
-    # pass 1: smoke ok; flagship fails; gate before flash sees a wedge.
-    # pass 2 (after re-watch): flagship retried ok, flash + headline ok.
+    # pass 1: smoke ok; flagship fails with the backend HEALTHY (the
+    # post-failure probe says so, so the attempt is charged); the gate
+    # before the next stage sees a wedge. pass 2 (after re-watch):
+    # flagship retried ok, the rest collects.
     calls, out = _wire(monkeypatch, tmp_path,
-                       probe_script=[True, False],
+                       probe_script=[True, True, False],
                        stage_fails={"bench_mfu": 1})
     rc = run_all_tpu._run(["--watch", "--interval", "0",
                            "--max-hours", "1", "--quick",
@@ -109,6 +111,60 @@ def test_poison_stage_skipped_after_max_attempts(monkeypatch, tmp_path):
     attempts = [r["attempt"] for r in _rows(out)
                 if r["stage"] == "bench_mfu"]
     assert attempts == [1, 2, 3]
+
+
+def test_wedge_victim_failures_keep_retry_budget(monkeypatch, tmp_path):
+    """A stage whose failures happen with the backend DOWN is a wedge
+    victim: the failures must not count against MAX_ATTEMPTS, so the
+    stage is still retried on later heals — even past the budget that
+    would have skipped a genuine poison stage (ADVICE round 5: the
+    flagship was permanently skipped because the tunnel wedged during
+    its attempts)."""
+    # 4 failures (> MAX_ATTEMPTS), each with the post-failure probe
+    # reporting the backend dead; the 5th try succeeds.
+    calls, out = _wire(monkeypatch, tmp_path,
+                       probe_script=[True, False, False, False, False],
+                       stage_fails={"bench_mfu": 4})
+    rc = run_all_tpu._run(["--watch", "--interval", "0",
+                           "--max-hours", "1", "--quick",
+                           "--out", str(out)])
+    assert rc == 0
+    assert calls["stages"].count("bench_mfu") == 5  # > MAX_ATTEMPTS
+    rows = _rows(out)
+    failed = [r for r in rows if r["stage"] == "bench_mfu"
+              and not r["ok"]]
+    assert len(failed) == 4
+    assert all(r.get("wedge_victim") for r in failed)
+    assert all("attempt" not in r for r in failed)
+    # each victim failure pauses the pass (the backend is down — the
+    # remaining stages must not burn their timeouts against it)
+    gates = [r for r in rows
+             if r["stage"].startswith("health_gate_after_bench_mfu")]
+    assert len(gates) == 4
+    assert any(r["stage"] == "bench_mfu" and r["ok"] for r in rows)
+
+
+def test_self_wedging_stage_skipped_at_wedge_cap(monkeypatch, tmp_path):
+    """The converse guard: a stage that wedges the tunnel ITSELF also
+    looks like a wedge victim (the post-failure probe sees the wedge it
+    caused), so the exemption is capped — after MAX_WEDGE_VICTIMS such
+    failures the stage is skipped and the rest of the queue collects."""
+    calls, out = _wire(monkeypatch, tmp_path,
+                       probe_script=[True] + [False] * 99,
+                       stage_fails={"bench_mfu": 99})
+    rc = run_all_tpu._run(["--watch", "--interval", "0",
+                           "--max-hours", "1", "--quick",
+                           "--out", str(out)])
+    assert rc == 1  # bench_mfu never landed — the record says so
+    assert calls["stages"].count("bench_mfu") \
+        == run_all_tpu.MAX_WEDGE_VICTIMS
+    # every other stage still got its shot after the cap
+    for name in ("mfu_smoke", "mfu_mid", "flash_attention",
+                 "bench_headline"):
+        assert calls["stages"].count(name) == 1
+    counts = [r["wedge_count"] for r in _rows(out)
+              if r["stage"] == "bench_mfu" and not r["ok"]]
+    assert counts == list(range(1, run_all_tpu.MAX_WEDGE_VICTIMS + 1))
 
 
 def test_oneshot_aborts_on_wedge_without_retry(monkeypatch, tmp_path):
@@ -188,6 +244,31 @@ def test_sweep_arm_error_rows_get_footnote_marker(tmp_path):
     assert "rc 1" in md
     # genuinely failed arms keep their separate failure list
     assert "OOM" in md
+
+
+def test_retraction_reasons_not_cut_mid_word(tmp_path):
+    """Retraction reasons around ~120 chars must render IN FULL (the
+    old [:100] cap cut them mid-word — ADVICE round 5); reasons past
+    the new cap truncate at a word boundary with an ellipsis."""
+    from benchmarks import report
+
+    medium = ("retracted: the measured step time was collected against a "
+              "wedged tunnel and understates throughput by roughly 40%")
+    assert 100 < len(medium) <= 200
+    long = "word " * 60  # 300 chars, > cap
+    log = tmp_path / "log.jsonl"
+    log.write_text("\n".join(json.dumps(r) for r in [
+        {"stage": "bench_mfu", "ok": True, "retracted": True, "ts": "T1",
+         "reason": medium},
+        {"stage": "mfu_long", "ok": True, "retracted": True, "ts": "T2",
+         "reason": long.strip()},
+    ]) + "\n")
+    md = report.render(report.load_rows(str(log)))
+    assert medium in md                      # no truncation at ~120
+    cut = next(l for l in md.splitlines() if "mfu_long" in l)
+    assert cut.endswith("…")
+    body = cut.split("): ", 1)[1][:-1]       # drop the ellipsis
+    assert long.startswith(body + " ")       # word-boundary cut
 
 
 def test_write_baseline_splices_between_markers(tmp_path):

@@ -1,0 +1,492 @@
+"""Continuous-batching inference engine (serve/) — the acceptance suite.
+
+The headline contract: with requests arriving at STAGGERED iterations
+(mixed prompt lengths, mixed max-tokens, mid-stream slot retirement +
+admission), every request's token sequence is bit-identical to a
+standalone ``generate()`` call with the same params/rng, the jitted
+decode step compiles exactly once, prefill compiles at most once per
+length bucket — and an injected ``DPX_FAULT`` delay surfaces a typed
+per-request deadline error without corrupting the other in-flight
+requests.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu import models, serve
+from distributed_pytorch_tpu.models.generate import (decode_step,
+                                                     decode_step_slots,
+                                                     make_generate_fn,
+                                                     prefill,
+                                                     prefill_partial)
+from distributed_pytorch_tpu.runtime import faults
+from distributed_pytorch_tpu.serve import (AdmissionRejected, EngineConfig,
+                                           EngineStopped, InferenceEngine,
+                                           RequestDeadlineExceeded,
+                                           SamplingParams)
+from distributed_pytorch_tpu.utils.logging import MetricsLogger
+
+MAX_LEN = 64
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _lm(**kw):
+    kw.setdefault("vocab", 61)
+    kw.setdefault("dim", 32)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("n_kv_heads", 2)
+    kw.setdefault("pos", "rope")
+    kw.setdefault("max_seq", 128)
+    return models.TransformerLM(**kw)
+
+
+def _dense_window_fn(w):
+    """A sliding-window attention core on the DENSE path (exact same
+    function the flash kernel computes — tests/test_flash_attention.py
+    proves that equivalence) advertising ``window`` the way
+    make_flash_attn_fn does, so _model_window detects it. Used here
+    because interpret-mode pallas on CPU is ~10x slower per compile
+    and the serving engine only cares about the window ATTRIBUTE."""
+    from distributed_pytorch_tpu.nn.attention import dense_attention
+
+    def fn(q, k, v, *, causal=False, scale=None):
+        return dense_attention(q, k, v, causal=causal, scale=scale,
+                               window=w)
+    fn.window = w
+    return fn
+
+
+def _windowed_lm(w=8):
+    return _lm(vocab=64, attn_fn=_dense_window_fn(w))
+
+
+def _lm1(**kw):
+    """1-layer variant for engine-BEHAVIOR tests (queue, deadlines,
+    shutdown, callbacks): depth adds only compile seconds there —
+    the numeric/bit-identity contracts all run on 2-layer models."""
+    kw.setdefault("n_layers", 1)
+    return _lm(**kw)
+
+
+def _standalone(model, params, prompt, sp, key, max_len=MAX_LEN):
+    """The reference: one-request models.generate with the same
+    params/rng (and the same cache width as the engine's slot rows)."""
+    fn = make_generate_fn(model, sp.max_new_tokens,
+                          temperature=sp.temperature, top_k=sp.top_k,
+                          top_p=sp.top_p, max_len=max_len)
+    return np.asarray(jax.jit(fn)(params, jnp.asarray(prompt[None]),
+                                  key))[0]
+
+
+# ---------------------------------------------------------------------------
+# slot-level cache ops (models/generate.py)
+# ---------------------------------------------------------------------------
+
+
+class TestSlotCacheOps:
+    def test_prefill_partial_matches_prefill_bitwise(self):
+        """Right-padding is inert under causality: logits at the last
+        real position and the cached K/V prefix are bit-identical to an
+        exact-length prefill."""
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        prompt = jnp.asarray(rng.integers(0, 61, (1, 7)), jnp.int32)
+        logits, cache = jax.jit(
+            lambda p, t: prefill(model, p, t, MAX_LEN))(params, prompt)
+        padded = jnp.zeros((1, 16), jnp.int32).at[:, :7].set(prompt)
+        logits_p, ks, vs = jax.jit(
+            lambda p, t, n: prefill_partial(model, p, t, n))(
+            params, padded, 7)
+        np.testing.assert_array_equal(np.asarray(logits),
+                                      np.asarray(logits_p))
+        for i in range(model.n_layers):
+            np.testing.assert_array_equal(
+                np.asarray(cache.k[i])[:, :, :7],
+                np.asarray(ks[i])[:, :, :7])
+            np.testing.assert_array_equal(
+                np.asarray(cache.v[i])[:, :, :7],
+                np.asarray(vs[i])[:, :, :7])
+
+    def test_prefill_partial_window_layout(self):
+        """The gather-built rolling layout (traced true_len) equals
+        prefill's roll-built layout, for prompts shorter AND longer
+        than the window (one compile serves both: true_len is traced)."""
+        W = 8
+        model = _windowed_lm(W)
+        params = model.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(1)
+        partial_fn = jax.jit(
+            lambda p, t, n: prefill_partial(model, p, t, n, window=W))
+        for s in (5, 20):
+            prompt = jnp.asarray(rng.integers(0, 64, (1, s)), jnp.int32)
+            _, cache = prefill(model, params, prompt, MAX_LEN, window=W)
+            padded = jnp.zeros((1, 32), jnp.int32).at[:, :s].set(prompt)
+            _, ks, vs = partial_fn(params, padded, s)
+            for i in range(model.n_layers):
+                np.testing.assert_allclose(np.asarray(cache.k[i]),
+                                           np.asarray(ks[i]), atol=1e-6)
+                np.testing.assert_allclose(np.asarray(cache.v[i]),
+                                           np.asarray(vs[i]), atol=1e-6)
+
+    def test_decode_step_slots_b1_bitwise(self):
+        """At the same batch shape the per-row formulation IS
+        decode_step: logits and cache writes bit-identical."""
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(2)
+        prompt = jnp.asarray(rng.integers(0, 61, (1, 9)), jnp.int32)
+        logits, cache = jax.jit(
+            lambda p, t: prefill(model, p, t, MAX_LEN))(params, prompt)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        ref_l, ref_c = jax.jit(
+            lambda p, c, t: decode_step(model, p, c, t))(params, cache, tok)
+        got_l, ks, vs = jax.jit(
+            lambda p, k, v, ln, t: decode_step_slots(model, p, k, v, ln, t))(
+            params, list(cache.k), list(cache.v),
+            jnp.asarray([9], jnp.int32), tok)
+        np.testing.assert_array_equal(np.asarray(ref_l), np.asarray(got_l))
+        for i in range(model.n_layers):
+            np.testing.assert_array_equal(np.asarray(ref_c.k[i]),
+                                          np.asarray(ks[i]))
+
+    def test_decode_step_slots_row_isolation(self):
+        """Changing ANOTHER row's cache/token/length leaves a row's
+        logits bitwise unchanged — the slot-independence precondition
+        of continuous batching."""
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(3)
+        prompt = jnp.asarray(rng.integers(0, 61, (1, 6)), jnp.int32)
+        _, cache = jax.jit(
+            lambda p, t: prefill(model, p, t, MAX_LEN))(params, prompt)
+        f = jax.jit(lambda p, k, v, ln, t:
+                    decode_step_slots(model, p, k, v, ln, t))
+
+        def pool(rows):          # garbage pool with the real row at 0
+            return [jnp.asarray(
+                rng.standard_normal((3,) + r.shape[1:]),
+                jnp.float32).at[0:1].set(r) for r in rows]
+
+        k_a, v_a = pool(cache.k), pool(cache.v)
+        k_b = [c.at[1:].add(1.5) for c in k_a]
+        v_b = [c.at[1:].add(-0.5) for c in v_a]
+        la = f(params, k_a, v_a, jnp.asarray([6, 3, 11], jnp.int32),
+               jnp.asarray([7, 1, 2], jnp.int32))[0]
+        lb = f(params, k_b, v_b, jnp.asarray([6, 9, 0], jnp.int32),
+               jnp.asarray([7, 5, 60], jnp.int32))[0]
+        np.testing.assert_array_equal(np.asarray(la)[0], np.asarray(lb)[0])
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+
+class TestEngine:
+    def test_staggered_mix_bit_identical(self):
+        """THE acceptance case: staggered arrivals, mixed prompt
+        lengths / max-tokens / sampling configs, mid-stream retirement
+        + admission — every stream equals standalone generate(), with
+        one decode compile and ≤ one prefill compile per bucket."""
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        eng = InferenceEngine(model, params,
+                              EngineConfig(n_slots=3, max_len=MAX_LEN))
+        # (prompt_len, max_new, sampling): three sampler configs, two
+        # prefill buckets, short + long requests
+        mix = [
+            (5, 30, SamplingParams(max_new_tokens=30)),
+            (9, 3, SamplingParams(max_new_tokens=3, temperature=0.7,
+                                  top_k=8)),
+            (3, 12, SamplingParams(max_new_tokens=12, temperature=0.9,
+                                   top_p=0.9)),
+            (12, 6, SamplingParams(max_new_tokens=6)),          # queued
+            (7, 8, SamplingParams(max_new_tokens=8, temperature=0.7,
+                                  top_k=8)),
+        ]
+        prompts = [rng.integers(0, 61, (s,)).astype(np.int32)
+                   for s, _, _ in mix]
+        keys = [jax.random.PRNGKey(100 + i) for i in range(len(mix))]
+        with eng:
+            handles = [eng.submit(prompts[i], mix[i][2], rng=keys[i])
+                       for i in range(4)]
+            # stagger: the second wave arrives only after an early
+            # retirement freed a slot mid-run
+            handles[1].result(timeout=60)
+            handles += [eng.submit(prompts[i], mix[i][2], rng=keys[i])
+                        for i in (4,)]
+            outs = [h.result(timeout=60) for h in handles]
+        for i, ((s, n, sp), out) in enumerate(zip(mix, outs)):
+            ref = _standalone(model, params, prompts[i], sp, keys[i])
+            np.testing.assert_array_equal(out, ref, err_msg=f"request {i}")
+        st = eng.stats()
+        assert st["decode_compiles"] == 1, st
+        assert all(v == 1 for v in st["prefill_compiles"].values()), st
+        assert st["sample_compiles"] == 3, st
+        # continuous batching really happened: request 3 (queued beyond
+        # the 3 slots) was admitted only after request 1's mid-stream
+        # retirement freed one — while request 0 (30 tokens) was STILL
+        # in flight
+        admits = [h.metrics["admit_iteration"] for h in handles]
+        retires = [h.metrics["retire_iteration"] for h in handles]
+        assert admits[3] > retires[1], (admits, retires)  # slot reuse
+        assert admits[3] < retires[0], (admits, retires)  # overlap
+
+    def test_windowed_model_rolling_pool(self):
+        """Sliding-window model: slot rows are W wide, generation runs
+        past the window, streams equal standalone generate()."""
+        model = _windowed_lm(8)
+        params = model.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(1)
+        eng = InferenceEngine(model, params,
+                              EngineConfig(n_slots=2, max_len=32))
+        assert eng.pool.width == 8                # O(window) memory
+        cases = [(4, 20), (20, 16)]
+        with eng:
+            hs, refs = [], []
+            for i, (s, n) in enumerate(cases):
+                prompt = rng.integers(0, 64, (s,)).astype(np.int32)
+                key = jax.random.PRNGKey(i)
+                sp = SamplingParams(max_new_tokens=n)
+                hs.append(eng.submit(prompt, sp, rng=key))
+                refs.append(np.asarray(jax.jit(make_generate_fn(model, n))(
+                    params, jnp.asarray(prompt[None]), key))[0])
+            for h, ref in zip(hs, refs):
+                np.testing.assert_array_equal(h.result(timeout=60), ref)
+        assert eng.stats()["decode_compiles"] == 1
+
+    def test_eos_truncates_stream(self):
+        """eos_token stops the request early (eos included); the
+        truncated stream is a prefix of the standalone stream."""
+        model = _lm1()
+        params = model.init(jax.random.PRNGKey(0))
+        prompt = np.arange(5, dtype=np.int32)
+        key = jax.random.PRNGKey(42)
+        sp = SamplingParams(max_new_tokens=10)
+        ref = _standalone(model, params, prompt, sp, key)
+        eos = int(ref[4])                         # stop mid-stream
+        with InferenceEngine(model, params,
+                             EngineConfig(n_slots=1,
+                                          max_len=MAX_LEN)) as eng:
+            out = eng.submit(prompt,
+                             SamplingParams(max_new_tokens=10,
+                                            eos_token=eos),
+                             rng=key).result(timeout=60)
+        k = int(np.argmax(ref == eos)) + 1
+        np.testing.assert_array_equal(out, ref[:k])
+        assert out[-1] == eos
+
+    def test_bounded_queue_typed_rejection(self):
+        model = _lm1()
+        params = model.init(jax.random.PRNGKey(0))
+        eng = InferenceEngine(model, params,
+                              EngineConfig(n_slots=1, max_len=MAX_LEN,
+                                           max_queue=2))
+        # engine NOT started: the queue only fills
+        eng.submit(np.arange(4, dtype=np.int32), SamplingParams())
+        eng.submit(np.arange(4, dtype=np.int32), SamplingParams())
+        with pytest.raises(AdmissionRejected) as ei:
+            eng.submit(np.arange(4, dtype=np.int32), SamplingParams())
+        assert ei.value.reason == "queue_full"
+        assert ei.value.request_id == 2
+        eng.shutdown(wait=False)
+
+    def test_unservable_requests_rejected(self):
+        model = _lm1()
+        params = model.init(jax.random.PRNGKey(0))
+        eng = InferenceEngine(model, params,
+                              EngineConfig(n_slots=1, max_len=32))
+        with pytest.raises(AdmissionRejected) as ei:
+            eng.submit(np.zeros(40, np.int32), SamplingParams())
+        assert ei.value.reason == "prompt_too_long"
+        with pytest.raises(AdmissionRejected) as ei:
+            eng.submit(np.zeros(20, np.int32),
+                       SamplingParams(max_new_tokens=20))
+        assert ei.value.reason == "too_long"
+
+    def test_priority_over_fcfs(self):
+        """With all three queued up front, the priority-0 request is
+        admitted first even though it arrived LAST; the two priority-5
+        requests then run in arrival order (FCFS within a class)."""
+        model = _lm1()
+        params = model.init(jax.random.PRNGKey(0))
+        eng = InferenceEngine(model, params,
+                              EngineConfig(n_slots=1, max_len=MAX_LEN))
+        p = np.arange(4, dtype=np.int32)
+        ha = eng.submit(p, SamplingParams(max_new_tokens=8, priority=5))
+        hb = eng.submit(p, SamplingParams(max_new_tokens=4, priority=5))
+        hc = eng.submit(p, SamplingParams(max_new_tokens=4, priority=0))
+        with eng:
+            for h in (ha, hb, hc):
+                h.result(timeout=60)
+        assert hc.metrics["admit_iteration"] \
+            < ha.metrics["admit_iteration"] \
+            < hb.metrics["admit_iteration"]
+
+    def test_queued_deadline_typed_error(self):
+        """A request that expires while QUEUED surfaces
+        RequestDeadlineExceeded(stage='queued') without occupying a
+        slot; the running request is unaffected."""
+        model = _lm1()
+        params = model.init(jax.random.PRNGKey(0))
+        key = jax.random.PRNGKey(5)
+        prompt = np.arange(6, dtype=np.int32)
+        sp_long = SamplingParams(max_new_tokens=50)
+        with InferenceEngine(model, params,
+                             EngineConfig(n_slots=1,
+                                          max_len=MAX_LEN)) as eng:
+            ha = eng.submit(prompt, sp_long, rng=key)
+            hb = eng.submit(np.arange(4, dtype=np.int32),
+                            SamplingParams(max_new_tokens=4,
+                                           deadline_ms=40.0))
+            with pytest.raises(RequestDeadlineExceeded) as ei:
+                hb.result(timeout=60)
+            assert len(ha.result(timeout=60)) == 50  # unaffected
+        assert ei.value.stage == "queued"
+        assert ei.value.deadline_ms == 40.0
+        assert ei.value.request_id == hb.request_id
+
+    def test_chaos_delay_surfaces_running_deadline(self):
+        """THE chaos acceptance case: an injected DPX_FAULT delay at a
+        known engine iteration stalls the loop past a running request's
+        deadline — that request fails TYPED (attributed to request and
+        iteration) while the other in-flight request's stream stays
+        bit-identical and the engine keeps serving."""
+        model = _lm1()
+        params = model.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(7)
+        eng = InferenceEngine(model, params,
+                              EngineConfig(n_slots=2, max_len=128))
+        eng.start()
+        try:
+            sp_b = SamplingParams(max_new_tokens=20, temperature=0.7,
+                                  top_k=8)
+            # warm up EVERY compile (bucket-8 prefill, decode, both
+            # sampler configs) so post-install iterations are ms-scale:
+            # compile time must not eat the deadline
+            eng.submit(np.arange(4, dtype=np.int32),
+                       SamplingParams(max_new_tokens=2)).result(timeout=60)
+            eng.submit(np.arange(4, dtype=np.int32),
+                       SamplingParams(max_new_tokens=2, temperature=0.7,
+                                      top_k=8)).result(timeout=60)
+            # the serve_step op-call counter only advances while specs
+            # are installed, so call=3 is the THIRD engine iteration
+            # from now — one after the admissions below
+            faults.install("delay@op=serve_step,call=3,ms=1200")
+            prompt_a = rng.integers(0, 61, (5,)).astype(np.int32)
+            prompt_b = rng.integers(0, 61, (8,)).astype(np.int32)
+            key_b = jax.random.PRNGKey(9)
+            ha = eng.submit(prompt_a,
+                            SamplingParams(max_new_tokens=100,
+                                           deadline_ms=700.0))
+            hb = eng.submit(prompt_b, sp_b, rng=key_b)
+            with pytest.raises(RequestDeadlineExceeded) as ei:
+                ha.result(timeout=60)
+            assert ei.value.stage == "running"
+            assert ei.value.request_id == ha.request_id
+            assert ei.value.iteration is not None
+            assert any(f.startswith("delay@") for f in faults.fired())
+            ref_b = _standalone(model, params, prompt_b, sp_b, key_b,
+                                max_len=128)
+            # the other in-flight request is NOT corrupted
+            np.testing.assert_array_equal(hb.result(timeout=60), ref_b)
+            # and the engine still serves after the failure
+            hc = eng.submit(prompt_b, sp_b, rng=key_b)
+            np.testing.assert_array_equal(hc.result(timeout=60), ref_b)
+        finally:
+            eng.shutdown()
+
+    def test_slo_metrics_flow_to_logger(self, tmp_path):
+        """Per-request TTFT/TPOT events and periodic queue-depth /
+        slot-occupancy records land in the line-JSON metrics stream."""
+        model = _lm1()
+        params = model.init(jax.random.PRNGKey(0))
+        log = tmp_path / "serve_metrics.jsonl"
+        logger = MetricsLogger(path=str(log))
+        cfg = EngineConfig(n_slots=2, max_len=MAX_LEN, metrics=logger,
+                           log_every=2)
+        with InferenceEngine(model, params, cfg) as eng:
+            hs = [eng.submit(np.arange(5, dtype=np.int32),
+                             SamplingParams(max_new_tokens=8))
+                  for _ in range(3)]
+            for h in hs:
+                h.result(timeout=60)
+        logger.close()
+        rows = [json.loads(ln) for ln in log.read_text().splitlines()]
+        reqs = [r for r in rows if r.get("event") == "serve_request"]
+        assert len(reqs) == 3
+        for r in reqs:
+            assert r["outcome"] == "ok" and r["n_tokens"] == 8
+            assert r["ttft_ms"] > 0 and r["tpot_ms"] > 0
+            assert r["queue_ms"] is not None
+        engine_rows = [r for r in rows if r.get("kind") == "serve_engine"]
+        assert engine_rows, rows
+        assert all(0.0 <= r["slot_occupancy"] <= 1.0 for r in engine_rows)
+        assert all("queue_depth" in r for r in engine_rows)
+
+    def test_shutdown_fails_inflight_typed(self):
+        model = _lm1()
+        params = model.init(jax.random.PRNGKey(0))
+        eng = InferenceEngine(model, params,
+                              EngineConfig(n_slots=1, max_len=128))
+        eng.start()
+        h = eng.submit(np.arange(4, dtype=np.int32),
+                       SamplingParams(max_new_tokens=100))
+        h2 = eng.submit(np.arange(4, dtype=np.int32),
+                        SamplingParams(max_new_tokens=4))
+        time.sleep(0.05)
+        eng.shutdown()
+        for handle in (h, h2):
+            with pytest.raises(EngineStopped):
+                handle.result(timeout=10)
+
+    def test_engine_loop_crash_fails_futures_typed(self):
+        """An exception escaping the engine loop must not strand
+        futures: every in-flight request fails as EngineStopped with
+        the crash chained as the cause."""
+        model = _lm1()
+        params = model.init(jax.random.PRNGKey(0))
+        eng = InferenceEngine(model, params,
+                              EngineConfig(n_slots=1, max_len=MAX_LEN))
+
+        def boom(*a, **k):
+            raise RuntimeError("injected engine bug")
+        eng.pool.admit = boom
+        eng.start()
+        h = eng.submit(np.arange(4, dtype=np.int32),
+                       SamplingParams(max_new_tokens=4))
+        with pytest.raises(EngineStopped) as ei:
+            h.result(timeout=30)
+        assert isinstance(ei.value.__cause__, RuntimeError)
+        with pytest.raises(EngineStopped):
+            eng.submit(np.arange(4, dtype=np.int32), SamplingParams())
+        eng.shutdown()
+
+    def test_streaming_callback_order(self):
+        model = _lm1()
+        params = model.init(jax.random.PRNGKey(0))
+        seen = []
+        with InferenceEngine(model, params,
+                             EngineConfig(n_slots=1,
+                                          max_len=MAX_LEN)) as eng:
+            h = eng.submit(np.arange(5, dtype=np.int32),
+                           SamplingParams(max_new_tokens=6),
+                           on_token=lambda t, i: seen.append((i, t)))
+            out = h.result(timeout=60)
+        assert [i for i, _ in seen] == list(range(6))
+        np.testing.assert_array_equal(np.asarray([t for _, t in seen]),
+                                      out)
