@@ -1,0 +1,183 @@
+"""Checkpoint benchmark: sharded (every-host-writes-its-shards) vs
+full-replica (rank-0-writes-everything) save/restore under the host
+front door.
+
+Three arms over the same replicated state on a dp=8 native TCP process
+group (the per-rank-process front door — the execution model where
+"bytes per host" is a real quantity):
+
+- **full-sync**    — the legacy single-writer format-1 path: rank 0
+  serializes the entire state every save, everyone else waits at the
+  barrier.
+- **sharded-sync** — format 2 (ckpt/): each rank writes only the shards
+  it owns per the FSDP specs (1/world of the bytes per host), commit on
+  rank 0.
+- **sharded-async** — same bytes, but serialization/IO on the background
+  thread with the commit barrier deferred: the number that matters is
+  ``save_call_ms`` (how long training is actually blocked), which drops
+  to the D2H-snapshot cost.
+
+Per arm: wall seconds/step (barrier-fenced), median blocking
+``save()`` latency, restore seconds (full reassembly on every rank),
+and measured-from-manifest bytes-per-host. ``--smoke`` shrinks to a
+seconds-scale dp=4 run and ASSERTS restored state equals the source
+bit-for-bit in both formats plus the 1/world write-bytes property —
+the CI gate (tier1.yml) that keeps the sharded path from rotting.
+
+Usage: python benchmarks/ckpt_bench.py [--smoke] [--world N]
+           [--mib M] [--steps K]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+ARMS = ("full-sync", "sharded-sync", "sharded-async")
+
+
+def _make_state(n_elems: int):
+    """A few big leaves + a small one, every big dim divisible by the
+    worlds we bench (8, 4, 2) — replicated DDP-style state."""
+    rng = np.random.default_rng(0)
+    big = n_elems // 2
+    return {
+        "emb": rng.standard_normal((big // 64, 64)).astype(np.float32),
+        "w": rng.standard_normal((n_elems - big) // 32 * 32)
+        .astype(np.float32).reshape(-1, 32),
+        "scale": np.float32(1.0),
+    }
+
+
+def _bytes_per_host(step_dir: str, world: int):
+    """Actual shard bytes each writer landed, from the manifest."""
+    man = json.load(open(os.path.join(step_dir, "manifest.json")))
+    per = [0] * world
+    if man.get("format") != 2:
+        total = sum(
+            os.path.getsize(os.path.join(step_dir, n))
+            for n in os.listdir(step_dir))
+        per[0] = total
+        return per
+    for tree in man["trees"].values():
+        for leaf in tree["leaves"]:
+            for sh in leaf["shards"]:
+                per[sh["writer"]] += sh["nbytes"]
+    return per
+
+
+def _ckpt_worker(rank, world, q, n_elems, steps, base):
+    import distributed_pytorch_tpu as dist
+    from distributed_pytorch_tpu.ckpt import CheckpointManager
+    from distributed_pytorch_tpu.parallel import fsdp_param_specs
+    from distributed_pytorch_tpu.runtime import context
+    from distributed_pytorch_tpu.utils.checkpoint import (
+        latest_step, restore_checkpoint)
+
+    dist.init_process_group(rank, world)
+    comm = context.get_host_comm()
+    params = _make_state(n_elems)
+    specs = fsdp_param_specs(params, world, min_size=1024)
+    results = {}
+    try:
+        for arm in ARMS:
+            workdir = os.path.join(base, arm.replace("-", "_"))
+            if arm == "full-sync":
+                mgr = CheckpointManager(workdir, interval=1, keep=2)
+            else:
+                mgr = CheckpointManager(
+                    workdir, interval=1, keep=2,
+                    async_save=arm.endswith("async"), sharded=True,
+                    param_specs=specs, axis_sizes={"dp": world})
+            comm.barrier()
+            t0 = time.perf_counter()
+            call_ms = []
+            for s in range(1, steps + 1):
+                c0 = time.perf_counter()
+                mgr.save(s, params)
+                call_ms.append((time.perf_counter() - c0) * 1e3)
+            mgr.wait()
+            comm.barrier()
+            wall = time.perf_counter() - t0
+
+            comm.barrier()
+            r0 = time.perf_counter()
+            ck = restore_checkpoint(workdir, like_params=params)
+            comm.barrier()
+            restore_s = time.perf_counter() - r0
+
+            for k in params:  # every arm must round-trip bit-exactly
+                np.testing.assert_array_equal(
+                    np.asarray(ck.params[k]), params[k],
+                    err_msg=f"{arm}: leaf {k} corrupted in round trip")
+            if rank == 0:
+                step_dir = os.path.join(workdir,
+                                        f"step_{latest_step(workdir)}")
+                call_ms.sort()
+                results[arm] = {
+                    "wall_s_per_step": round(wall / steps, 4),
+                    "save_call_ms_p50": round(
+                        call_ms[len(call_ms) // 2], 2),
+                    "restore_s": round(restore_s, 4),
+                    "bytes_per_host": _bytes_per_host(step_dir, world),
+                }
+        if rank == 0:
+            total = sum(v.nbytes for v in params.values())
+            sharded = results["sharded-sync"]["bytes_per_host"]
+            assert all(b <= 2 * total // world + 4096 for b in sharded), \
+                f"sharded mode wrote {sharded}, expected ~{total}/{world}" \
+                " per host"
+            q.put({"world": world, "state_mib": round(total / 2**20, 2),
+                   "steps": steps, "arms": results})
+    finally:
+        dist.cleanup()
+
+
+def main(argv):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-scale dp=4 CPU run with correctness "
+                         "asserts (the CI gate)")
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--mib", type=float, default=64.0,
+                    help="state size in MiB of f32")
+    ap.add_argument("--steps", type=int, default=3)
+    args = ap.parse_args(argv)
+    world = 4 if args.smoke else args.world
+    mib = 2.0 if args.smoke else args.mib
+    steps = 2 if args.smoke else args.steps
+    n_elems = int(mib * 2**20 / 4)
+
+    from distributed_pytorch_tpu.runtime.multiprocess import (
+        launch_multiprocess)
+
+    base = tempfile.mkdtemp(prefix="ckpt_bench_")
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    try:
+        launch_multiprocess(_ckpt_worker, world, q, n_elems, steps, base)
+        rec = q.get(timeout=60)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    print(json.dumps(rec, indent=2))
+    if args.smoke:
+        arms = rec["arms"]
+        full0 = arms["full-sync"]["bytes_per_host"][0]
+        shard = arms["sharded-sync"]["bytes_per_host"]
+        print(f"# smoke OK: full-replica rank0 wrote {full0} B; "
+              f"sharded per-host {shard}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -18,11 +18,11 @@ collectives for the per-rank-process front door) is native C++ under
 from .api import *  # noqa: F401,F403 — the 18-function surface + extensions
 from .api import __all__ as _api_all
 
-from . import comm, data, models, nn, ops, optim, parallel, runtime, serve, utils  # noqa: F401
+from . import ckpt, comm, data, models, nn, ops, optim, parallel, runtime, serve, utils  # noqa: F401
 
 __all__ = list(_api_all) + [
-    "comm", "data", "models", "nn", "ops", "optim", "parallel", "runtime",
-    "serve", "utils",
+    "ckpt", "comm", "data", "models", "nn", "ops", "optim", "parallel",
+    "runtime", "serve", "utils",
 ]
 
 __version__ = "0.1.0"

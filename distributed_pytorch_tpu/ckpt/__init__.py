@@ -1,0 +1,29 @@
+"""Sharded distributed checkpointing with topology-resharding restore.
+
+Every host writes only the shards it owns (driven by the FSDP/ZeRO/TP
+PartitionSpec trees in :mod:`..parallel`), as per-shard npz members with
+per-shard CRC32C (the PR 2 checksum vocabulary) plus one global manifest
+— committed atomically via the two-rename dance, so a crash at any byte
+leaves a complete restorable step. Restore reshards: a checkpoint
+written at mesh ``dp=N`` restores onto ``dp=M`` for any M (including 1),
+each host reading exactly the shard slices it needs. The manager adds a
+true async snapshot path that runs **no collectives off the main
+thread** (see :mod:`.manager`).
+
+:mod:`..utils.checkpoint` remains the single-replica fallback and
+re-exports this API; ``CheckpointManager(sharded=True)`` is the one-flag
+switch.
+"""
+
+from . import errors, integrity, layout, manifest, manager, reader, writer  # noqa: F401
+from .errors import (CkptCorrupt, CkptError, CkptIncomplete,  # noqa: F401
+                     CkptShapeMismatch)
+from .integrity import crc32c  # noqa: F401
+from .manager import CheckpointManager, clear_trace, trace_log  # noqa: F401
+from .reader import ReadStats, Target, restore_sharded  # noqa: F401
+
+__all__ = [
+    "CheckpointManager", "CkptCorrupt", "CkptError", "CkptIncomplete",
+    "CkptShapeMismatch", "ReadStats", "Target", "clear_trace", "crc32c",
+    "restore_sharded", "trace_log",
+]

@@ -1,0 +1,56 @@
+"""Typed checkpoint failure vocabulary — the ckpt mirror of the comm
+hierarchy (:class:`~..runtime.native.CommError` and friends, ISSUE 2).
+
+Every failure a save/restore can observe maps to one of three concrete
+classes, each carrying enough structure to *attribute* the failure —
+which step, which rank observed it, which shard (file + npz member) is to
+blame — so supervisors, retries, and tests act on types and fields
+instead of grepping message strings:
+
+* :class:`CkptCorrupt`      — bytes exist but fail their CRC32C (the PR 2
+  checksum vocabulary): bit-rot, torn write, transport damage.
+* :class:`CkptIncomplete`   — bytes are missing: no/truncated manifest, a
+  shard file or npz member absent, a writer-rank fragment never landed.
+* :class:`CkptShapeMismatch` — bytes are fine but do not fit the request:
+  template leaf-count/shape disagreement, a reshard target outside the
+  saved global shape.
+
+``FileNotFoundError`` stays reserved for "nothing is checkpointed here at
+all" (the resume-or-fresh-start branch of every training script); the
+typed hierarchy covers checkpoints that *exist but cannot be trusted*.
+"""
+
+from __future__ import annotations
+
+
+class CkptError(RuntimeError):
+    """A checkpoint save/restore failed.
+
+    Attributes mirror the comm hierarchy's attribution fields: ``step``
+    (which checkpoint), ``rank`` (which process observed the failure) and
+    ``shard`` (the ``file:member`` of the offending shard, when one is
+    identifiable).
+    """
+
+    def __init__(self, msg: str, *, step: int = -1, rank: int = -1,
+                 shard: str = ""):
+        super().__init__(msg)
+        self.step = step
+        self.rank = rank
+        self.shard = shard
+
+
+class CkptCorrupt(CkptError):
+    """A shard's bytes failed their CRC32C integrity check — the data on
+    disk is not what was written and must never reach training state."""
+
+
+class CkptIncomplete(CkptError):
+    """A required piece of the checkpoint is missing or truncated —
+    manifest, shard file, npz member, or a writer rank's fragment."""
+
+
+class CkptShapeMismatch(CkptError):
+    """The checkpoint is internally consistent but does not fit the
+    request: template structure/shape disagreement, or a reshard target
+    incompatible with the saved global shapes."""

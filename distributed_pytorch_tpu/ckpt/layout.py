@@ -1,0 +1,233 @@
+"""Shard layout: PartitionSpec trees → per-leaf shard grids and slices.
+
+The bridge between the sharding layouts in :mod:`..parallel` (FSDP/ZeRO/
+TP specs — trees of ``jax.sharding.PartitionSpec``) and files on disk.
+A :class:`LeafLayout` records, for one pytree leaf, how its global array
+decomposes into hyperrectangular shards: the per-dimension shard grid
+(derived from the spec's axis names and the mesh axis sizes), each
+shard's ``[start, stop)`` offsets, and which *writer* (host process)
+owns it. Restore onto a different topology is then pure geometry:
+:func:`intersect` maps any requested slice of the global array onto the
+saved shards that overlap it, so a checkpoint written at mesh ``dp=N``
+restores onto ``dp=M`` (any M, including 1) with each reader touching
+exactly the bytes it needs.
+
+Everything here is deterministic from ``(shapes, specs, axis_sizes,
+writer_world)`` — both sides of a save/restore recompute the same layout
+without communicating, which is what lets the async writer run with no
+collectives off the main thread (ckpt/manager.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .errors import CkptShapeMismatch
+
+
+def _spec_entry_axes(entry) -> Tuple[str, ...]:
+    """Axis names a PartitionSpec entry shards one dimension over:
+    None → (), 'dp' → ('dp',), ('dp','tp') → both."""
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+def dim_partitions(spec, shape: Sequence[int],
+                   axis_sizes: Dict[str, int]) -> Tuple[int, ...]:
+    """Number of shards along each dimension of ``shape`` under ``spec``.
+
+    ``spec`` is a PartitionSpec (or None = replicated). Unknown axis
+    names (not in ``axis_sizes``) count as size 1 — a tp-sharded leaf
+    checkpointed on a dp-only topology stays whole along that dim.
+    Dimensions the spec does not mention are unsharded.
+    """
+    entries = list(spec) if spec is not None else []
+    grid = []
+    for d, n in enumerate(shape):
+        parts = 1
+        if d < len(entries):
+            for ax in _spec_entry_axes(entries[d]):
+                parts *= int(axis_sizes.get(ax, 1))
+        if parts > 1 and n % parts != 0:
+            # typed: a reshard target (or save spec) that doesn't fit the
+            # shapes is the CkptShapeMismatch contract, not a bare
+            # ValueError — supervisors catch CkptError to fall back
+            raise CkptShapeMismatch(
+                f"dim {d} of shape {tuple(shape)} not divisible by "
+                f"{parts} (spec {spec!r}, axes {axis_sizes})")
+        grid.append(max(parts, 1))
+    return tuple(grid)
+
+
+@dataclasses.dataclass
+class Shard:
+    """One hyperrectangular piece of a leaf."""
+    index: Tuple[int, ...]              # grid coordinates, one per dim
+    offsets: Tuple[Tuple[int, int], ...]  # [start, stop) per dim
+    writer: int                          # owning writer rank at save time
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(b - a for a, b in self.offsets)
+
+    def slices(self) -> Tuple[slice, ...]:
+        return tuple(slice(a, b) for a, b in self.offsets)
+
+
+@dataclasses.dataclass
+class LeafLayout:
+    """How one leaf decomposes into shards."""
+    key: str                  # escaped '/'-joined key path (checkpoint.py)
+    shape: Tuple[int, ...]
+    dtype: str                # numpy dtype name (incl. extension dtypes)
+    spec: Tuple[Any, ...]     # per-dim axis name(s) or None, JSON-ready
+    grid: Tuple[int, ...]
+    shards: List[Shard]
+
+    @property
+    def nshards(self) -> int:
+        return len(self.shards)
+
+
+def _json_spec(spec, ndim: int) -> Tuple[Any, ...]:
+    entries = list(spec) if spec is not None else []
+    out = []
+    for d in range(ndim):
+        axes = _spec_entry_axes(entries[d]) if d < len(entries) else ()
+        out.append(None if not axes else
+                   (axes[0] if len(axes) == 1 else list(axes)))
+    return tuple(out)
+
+
+def leaf_layout(key: str, shape: Sequence[int], dtype: str, spec,
+                axis_sizes: Dict[str, int], writer_world: int
+                ) -> LeafLayout:
+    """Enumerate the shard grid of one leaf.
+
+    Writer ownership: shards are dealt round-robin over the grid's
+    row-major linear index modulo ``writer_world``. For the canonical
+    FSDP case (one dim sharded ``dp=W`` under W writer processes) this
+    puts shard i on rank i — each host writes exactly the state it
+    already owns; a replicated leaf (grid of 1s) lands on writer 0 (the
+    primary), and the single-controller front door (writer_world=1) owns
+    everything.
+    """
+    shape = tuple(int(n) for n in shape)
+    grid = dim_partitions(spec, shape, axis_sizes)
+    sizes = tuple(n // g for n, g in zip(shape, grid))
+    shards = []
+    for lin, idx in enumerate(itertools.product(*(range(g) for g in grid))):
+        offs = tuple((i * s, (i + 1) * s) for i, s in zip(idx, sizes))
+        shards.append(Shard(index=idx, offsets=offs,
+                            writer=lin % max(writer_world, 1)))
+    return LeafLayout(key=key, shape=shape, dtype=dtype,
+                      spec=_json_spec(spec, len(shape)), grid=grid,
+                      shards=shards)
+
+
+def intersect(shard: Shard, request: Sequence[slice]
+              ) -> Optional[Tuple[Tuple[slice, ...], Tuple[slice, ...]]]:
+    """Overlap of ``shard`` with a requested global hyperrect slice.
+
+    Returns ``(src, dst)`` — ``src`` indexes *within the shard's array*,
+    ``dst`` within the request's array — or None when disjoint. Requests
+    must be plain ``slice(start, stop)`` with no step.
+    """
+    src, dst = [], []
+    for (a, b), r in zip(shard.offsets, request):
+        lo = max(a, r.start if r.start is not None else 0)
+        hi = min(b, r.stop if r.stop is not None else b)
+        if lo >= hi:
+            return None
+        src.append(slice(lo - a, hi - a))
+        dst.append(slice(lo - (r.start or 0), hi - (r.start or 0)))
+    return tuple(src), tuple(dst)
+
+
+def full_request(shape: Sequence[int]) -> Tuple[slice, ...]:
+    return tuple(slice(0, n) for n in shape)
+
+
+def local_slices(shape: Sequence[int], spec, axis_sizes: Dict[str, int],
+                 coords: Dict[str, int]) -> Tuple[slice, ...]:
+    """The global slice a host at mesh coordinates ``coords`` owns.
+
+    ``coords`` maps axis name → this host's index along that axis (axes
+    absent from ``coords`` or ``axis_sizes`` contribute index 0 /
+    replication). This is the restore-side dual of the writer grid: a
+    rank at ``dp=r`` on a ``dp=M`` topology asks for exactly its slice
+    of each leaf, whatever topology wrote the checkpoint.
+    """
+    grid = dim_partitions(spec, shape, axis_sizes)
+    entries = list(spec) if spec is not None else []
+    out = []
+    for d, (n, g) in enumerate(zip(shape, grid)):
+        size = n // g
+        idx = 0
+        if d < len(entries):
+            # row-major over the dim's (possibly multiple) axes
+            for ax in _spec_entry_axes(entries[d]):
+                ax_size = int(axis_sizes.get(ax, 1))
+                coord = int(coords.get(ax, 0))
+                if ax not in axis_sizes:
+                    coord = 0  # axis absent from this topology: replicated
+                elif not 0 <= coord < ax_size:
+                    # a stale rank from the pre-shrink topology must be a
+                    # typed error, never a silent modulo wrap onto some
+                    # other host's shard
+                    raise CkptShapeMismatch(
+                        f"coordinate {coord} out of range for mesh axis "
+                        f"{ax!r} of size {ax_size}")
+                idx = idx * ax_size + coord
+        out.append(slice(idx * size, (idx + 1) * size))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Tree-level layout
+# ---------------------------------------------------------------------------
+
+def _flatten_with_specs(tree, specs):
+    """Aligned (keys, arrays, spec_leaves, seq_prefixes) for a pytree and
+    its spec tree (replicated P() everywhere when ``specs`` is None)."""
+    import jax
+
+    from ..utils import checkpoint as _ck
+
+    keys, arrs, seq_prefixes = _ck._flatten(tree)
+    if specs is None:
+        spec_leaves = [None] * len(arrs)
+    else:
+        from jax.sharding import PartitionSpec
+        spec_leaves = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda s: s is None
+            or isinstance(s, PartitionSpec))
+        if len(spec_leaves) != len(arrs):
+            raise ValueError(
+                f"spec tree has {len(spec_leaves)} leaves but state tree "
+                f"has {len(arrs)}")
+    return keys, arrs, spec_leaves, seq_prefixes
+
+
+def tree_layout(tree, specs, axis_sizes: Dict[str, int],
+                writer_world: int):
+    """Per-leaf layouts for a whole pytree.
+
+    Returns ``(layouts, arrays, seq_prefixes)`` with ``layouts[i]``
+    describing ``arrays[i]`` (host numpy). ``specs=None`` → every leaf
+    replicated (single-shard), the degenerate full-replica layout.
+    """
+    keys, arrs, spec_leaves, seq_prefixes = _flatten_with_specs(tree, specs)
+    layouts = []
+    for key, a, s in zip(keys, arrs, spec_leaves):
+        a = np.asarray(a)
+        layouts.append(leaf_layout(key, a.shape, a.dtype.name, s,
+                                   axis_sizes, writer_world))
+    return layouts, [np.asarray(a) for a in arrs], seq_prefixes

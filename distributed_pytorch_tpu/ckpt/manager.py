@@ -1,0 +1,327 @@
+"""Checkpoint manager: interval + retention + a true async snapshot path.
+
+The staging that makes async correct under BOTH front doors (the old
+manager silently degraded to sync whenever a host process group was
+live, because its save ran barriers on the background thread):
+
+1. **snapshot (main thread, synchronous)** — device state is
+   materialized to host (D2H) and defensively copied, so the caller may
+   donate/overwrite its arrays on the very next step.
+2. **serialize + IO (background thread)** — shard slicing, npz writes,
+   CRC32C stamping, fragment land. *File IO only; provably no
+   collectives*: :meth:`CheckpointManager._barrier` asserts it runs on
+   the manager's control thread and raises :class:`~.errors.CkptError`
+   otherwise, and the trace log (:func:`trace_log`) records which thread
+   executed each phase so tests pin the contract.
+3. **commit (main thread, deferred)** — the barriers and the two-rename
+   dance run at the *next* ``save()``/``wait()`` call on the control
+   thread: barrier (all ranks' fragments durable) → committing rank
+   merges fragments + renames → barrier (commit visible). Until then the
+   step is pending: crash-killing the process loses only the pending
+   step, never a committed one.
+
+``sharded=False`` keeps the single-replica format-1 layout
+(:mod:`..utils.checkpoint`, primary-only write) but gains the same
+staged async path. ``sharded=True`` writes format 2: every host writes
+only the shards it owns, per the FSDP/ZeRO/TP PartitionSpec trees from
+:mod:`..parallel`.
+
+Collective discipline: ``save()``, ``wait()`` and ``restore_latest()``
+are collective calls — every rank of a host process group must make them
+in the same order (the same discipline the legacy barrier-in-save
+already imposed). A rank whose IO failed raises at its next collective
+call; peers observe a typed ``CommTimeout`` within one deadline tick
+(PR 2 failure semantics) instead of hanging.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import writer as _writer
+from .errors import CkptError
+from .reader import ReadStats, Target  # noqa: F401  (re-exported surface)
+
+#: Phase trace for tests: (phase, thread_name) tuples, process-local.
+#: Bounded — a multi-week training run must not fund a test facility
+#: with an unbounded list (256 covers ~40 saves of history).
+_trace: Deque[Tuple[str, str]] = collections.deque(maxlen=256)
+_trace_lock = threading.Lock()
+
+
+def trace_log() -> List[Tuple[str, str]]:
+    """Recent phases executed: ('d2h'|'io'|'barrier'|'commit', thread)."""
+    return list(_trace)
+
+
+def clear_trace() -> None:
+    with _trace_lock:
+        _trace.clear()
+
+
+def _mark(phase: str) -> None:
+    with _trace_lock:
+        _trace.append((phase, threading.current_thread().name))
+
+
+def _snapshot(tree):
+    """Host-materialize + defensively copy a pytree (device arrays D2H,
+    host numpy copied — the caller may overwrite either next step)."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda x: np.array(x) if isinstance(x, np.ndarray)
+        else np.asarray(x), tree)
+
+
+class _Pending:
+    """One staged save awaiting commit."""
+
+    def __init__(self, step: int, tmp: str, plan, extra):
+        self.step = step
+        self.tmp = tmp
+        self.plan = plan          # sharded: writer plan (arrays stripped)
+        self.extra = extra
+        self.io_stats: Dict[str, Any] = {}
+
+
+class CheckpointManager:
+    """Save every ``interval`` steps, keep the newest ``keep``; optional
+    background serialization with main-thread-deferred commit (see module
+    docstring); ``sharded=True`` for the every-host-writes-its-shards
+    format driven by ``param_specs``.
+
+    ``wait()`` (or context-manager exit) joins in-flight IO *and commits
+    the pending step* — call it before reading the checkpoint back or
+    exiting the process.
+    """
+
+    def __init__(self, ckpt_dir: str, interval: int = 1,
+                 keep: Optional[int] = 3, async_save: bool = False,
+                 sharded: bool = False, param_specs: Any = None,
+                 opt_specs: Any = None,
+                 axis_sizes: Optional[Dict[str, int]] = None):
+        if keep is not None and keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        if sharded and param_specs is None:
+            raise ValueError("sharded=True needs param_specs (the "
+                             "PartitionSpec tree from parallel/)")
+        self.ckpt_dir = ckpt_dir
+        self.interval = max(int(interval), 1)
+        self.keep = keep
+        self.async_save = async_save
+        self.sharded = sharded
+        self.param_specs = param_specs
+        self.opt_specs = opt_specs
+        self.axis_sizes = axis_sizes
+        self._ctl_thread = threading.current_thread()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._pending: Optional[_Pending] = None
+        self._save_seq = 0
+
+    # -- topology ----------------------------------------------------------
+
+    def _topo(self) -> Tuple[int, int]:
+        """(rank, writer_world). Host front door: every rank is a writer.
+        Single controller: one process owns all shards."""
+        from ..runtime import context
+        if context.get_host_comm() is not None:
+            return context.get_rank(), context.get_world_size()
+        return context.get_rank(), 1
+
+    def _resolved_axes(self) -> Dict[str, int]:
+        if self.axis_sizes is not None:
+            return dict(self.axis_sizes)
+        from ..runtime import context
+        if context.get_host_comm() is not None:
+            return {"dp": context.get_world_size()}
+        return {k: int(v) for k, v in dict(context.get_mesh().shape).items()
+                if int(v) > 1} or {"dp": 1}
+
+    # -- collective discipline --------------------------------------------
+
+    def _barrier(self) -> None:
+        if threading.current_thread() is not self._ctl_thread:
+            raise CkptError(
+                "checkpoint collective (barrier) attempted off the "
+                "manager's control thread — async IO threads must never "
+                "run collectives")
+        _mark("barrier")
+        from ..comm.collectives import barrier
+        barrier()
+
+    # -- policy ------------------------------------------------------------
+
+    def should_save(self, step: int) -> bool:
+        return step % self.interval == 0
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, step: int, params, opt_state=None,
+             extra: Optional[Dict[str, Any]] = None, force: bool = False
+             ) -> bool:
+        """Stage a save if the policy says so; returns True iff staged.
+
+        Sync mode commits before returning; async mode returns after the
+        D2H snapshot with serialization running in the background and the
+        commit deferred to the next ``save()``/``wait()``.
+        """
+        if not force and not self.should_save(step):
+            return False
+        self._finish_pending()
+        json.dumps(extra or {})  # reject unserializable extras up front
+        rank, world = self._topo()
+        t0 = time.perf_counter()
+        _mark("d2h")
+        from ..runtime import context
+        live_replica = self.sharded and context.get_host_comm() is not None
+        if (self.sharded and not live_replica) or \
+                (not self.sharded and rank == 0):
+            # single-controller D2H (or primary-only full-replica copy);
+            # under the host front door the sharded path skips the full
+            # defensive copy — snapshot_owned cuts private copies of
+            # exactly the 1/world of the state this rank writes
+            params = _snapshot(params)
+            if opt_state is not None:
+                opt_state = _snapshot(opt_state)
+        tmp = self._prepare_tmp(step, rank)
+        if self.sharded:
+            plan = self._plan(params, opt_state, world)
+            _writer.snapshot_owned(plan, rank, force_copy=live_replica)
+            job = lambda: self._io_sharded(tmp, rank, plan)
+        else:
+            plan = None
+            job = (lambda: self._io_full(tmp, step, params, opt_state,
+                                         extra)) if rank == 0 else None
+        pend = _Pending(step, tmp, plan, extra)
+        pend.io_stats["snapshot_s"] = time.perf_counter() - t0
+        self._pending = pend
+        if job is not None:
+            if self.async_save:
+                self._thread = threading.Thread(
+                    target=self._run_io, args=(job, pend),
+                    name="ckpt-io", daemon=True)
+                self._thread.start()
+            else:
+                self._run_io(job, pend)
+        if not self.async_save:
+            self._finish_pending()
+        return True
+
+    def _plan(self, params, opt_state, world):
+        specs: Dict[str, Any] = {"params": self.param_specs}
+        trees: Dict[str, Any] = {"params": params}
+        if opt_state is not None:
+            o_specs = self.opt_specs
+            if o_specs is None:
+                from ..parallel.fsdp import opt_state_specs
+                o_specs = opt_state_specs(opt_state, self.param_specs,
+                                          params=params)
+            specs["opt_state"] = o_specs
+            trees["opt_state"] = opt_state
+        return _writer.plan_trees(trees, specs, self._resolved_axes(),
+                                  world)
+
+    def _prepare_tmp(self, step: int, rank: int) -> str:
+        from ..utils import checkpoint as _ck
+        self._save_seq += 1
+        tmp = _ck._step_dir(self.ckpt_dir, step) + f".tmp.{self._save_seq}"
+        if rank == 0:
+            os.makedirs(self.ckpt_dir, exist_ok=True)
+            _ck._sweep_stale(self.ckpt_dir, keep_old_for=step)
+            os.makedirs(tmp, exist_ok=True)
+        # every writer must see the tmp dir before its IO thread starts
+        self._barrier()
+        return tmp
+
+    def _run_io(self, job, pend: _Pending) -> None:
+        _mark("io")
+        try:
+            pend.io_stats.update(job() or {})
+        except BaseException as e:  # surfaced on the control thread
+            self._error = e
+
+    def _io_sharded(self, tmp: str, rank: int, plan) -> Dict[str, Any]:
+        stats = _writer.write_shards(tmp, rank, plan)
+        for meta in plan.values():
+            meta["pieces"] = None  # commit needs layouts only; free now
+        return stats
+
+    def _io_full(self, tmp: str, step: int, params, opt_state, extra
+                 ) -> Dict[str, Any]:
+        from ..utils import checkpoint as _ck
+        t0 = time.perf_counter()
+        nbytes = _ck._write_full(tmp, step, params, opt_state, extra)
+        return {"bytes": nbytes, "shards": 1,
+                "duration_s": time.perf_counter() - t0}
+
+    # -- commit ------------------------------------------------------------
+
+    def _join_io(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            self._pending = None  # a failed write must never commit
+            raise err
+
+    def _finish_pending(self) -> None:
+        self._join_io()
+        if self._pending is None:
+            return
+        pend, self._pending = self._pending, None
+        rank, world = self._topo()
+        self._barrier()  # every writer's fragment is durable
+        if rank == 0:
+            _mark("commit")
+            from ..utils import checkpoint as _ck
+            from ..utils.logging import append_event
+            if self.sharded:
+                _writer.commit(self.ckpt_dir, pend.step, pend.tmp,
+                               pend.plan, pend.extra,
+                               self._resolved_axes(), world,
+                               keep=self.keep, rank=rank)
+            else:
+                _ck._commit_full(self.ckpt_dir, pend.step, pend.tmp,
+                                 keep=self.keep, rank=rank)
+            append_event(
+                "ckpt_save", step=pend.step, rank=rank, world=world,
+                sharded=self.sharded, async_save=self.async_save,
+                bytes=pend.io_stats.get("bytes"),
+                shards=pend.io_stats.get("shards"),
+                io_s=round(pend.io_stats.get("duration_s", 0.0), 6),
+                snapshot_s=round(pend.io_stats.get("snapshot_s", 0.0), 6))
+        self._barrier()  # commit visible on every rank
+
+    def wait(self) -> None:
+        """Join in-flight IO and commit the pending step (collective)."""
+        self._finish_pending()
+
+    # -- restore -----------------------------------------------------------
+
+    def restore_latest(self, like_params=None, like_opt_state=None,
+                       target: Optional[Target] = None):
+        """Latest checkpoint, or None when the directory is empty."""
+        from ..utils import checkpoint as _ck
+        self.wait()
+        if _ck.latest_step(self.ckpt_dir) is None:
+            return None
+        return _ck.restore_checkpoint(self.ckpt_dir,
+                                      like_params=like_params,
+                                      like_opt_state=like_opt_state,
+                                      target=target)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.wait()
+        return False

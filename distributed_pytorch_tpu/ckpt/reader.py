@@ -1,0 +1,268 @@
+"""Resharding checkpoint reader: restore onto any topology.
+
+A format-2 checkpoint records global shapes plus the shard decomposition
+it was written with; restoring is pure geometry (ckpt/layout.py), so the
+target topology is a free parameter:
+
+* **Full assembly** (default): every leaf is reassembled to its global
+  shape from whatever shards cover it — the M=1 debugging path and the
+  single-controller resume path (the controller re-places full arrays
+  onto its mesh, whatever size that mesh now is).
+* **Slice restore** (``target=``): the caller states its own coordinates
+  on a *new* mesh (e.g. ``dp=r`` of ``M``) and gets, per leaf, only its
+  local shard — each host reads exactly the saved members that overlap
+  its slice, nothing else. A checkpoint written at ``dp=N`` restores at
+  ``dp=M`` for any M; no shard-count equality is ever assumed.
+
+Every member read is CRC32C-verified against the manifest before its
+bytes can reach training state; failures raise the typed
+:mod:`.errors` hierarchy with step + shard attribution. Reads are
+collective-free — callers that need cross-rank ordering (the utils
+front door) add their own barriers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Optional, Set
+
+import numpy as np
+
+from . import manifest as _mf
+from .errors import CkptCorrupt, CkptIncomplete, CkptShapeMismatch
+from .integrity import array_crc32c
+from .layout import full_request, intersect, local_slices
+
+import os
+
+
+@dataclasses.dataclass
+class ReadStats:
+    """What a restore actually touched — the slice-exactness evidence."""
+    files: Set[str] = dataclasses.field(default_factory=set)
+    members: int = 0
+    bytes: int = 0
+
+
+@dataclasses.dataclass
+class Target:
+    """A reader's coordinates on its (new) topology.
+
+    ``specs``: PartitionSpec tree per restored tree name (may be the
+    save-time specs recomputed for the new axis sizes). ``axis_sizes``:
+    the new mesh axes, e.g. ``{"dp": 2}``. ``coords``: this host's index
+    per axis, e.g. ``{"dp": 1}``.
+    """
+    specs: Dict[str, Any]
+    axis_sizes: Dict[str, int]
+    coords: Dict[str, int]
+
+
+class _ShardFiles:
+    """Lazily opened npz handles, one per shard file."""
+
+    def __init__(self, step_dir: str, step: int, rank: int):
+        self.step_dir = step_dir
+        self.step = step
+        self.rank = rank
+        self._open: Dict[str, Any] = {}
+
+    def member(self, fname: str, member: str, crc: int,
+               stats: Optional[ReadStats]) -> np.ndarray:
+        z = self._open.get(fname)
+        if z is None:
+            path = os.path.join(self.step_dir, fname)
+            if not os.path.exists(path):
+                raise CkptIncomplete(
+                    f"step {self.step}: shard file {fname!r} missing",
+                    step=self.step, rank=self.rank, shard=fname)
+            try:
+                z = np.load(path)
+            except Exception as e:
+                raise CkptCorrupt(
+                    f"step {self.step}: shard file {fname!r} unreadable "
+                    f"({e})", step=self.step, rank=self.rank,
+                    shard=fname) from e
+            self._open[fname] = z
+        try:
+            arr = z[member]
+        except KeyError as e:
+            raise CkptIncomplete(
+                f"step {self.step}: member {member!r} missing from "
+                f"{fname!r}", step=self.step, rank=self.rank,
+                shard=f"{fname}:{member}") from e
+        except Exception as e:
+            # zipfile's own CRC / a torn npy header: damaged container
+            raise CkptCorrupt(
+                f"step {self.step}: member {member!r} of {fname!r} "
+                f"unreadable ({e})", step=self.step, rank=self.rank,
+                shard=f"{fname}:{member}") from e
+        if array_crc32c(arr) != crc:
+            raise CkptCorrupt(
+                f"step {self.step}: shard {fname}:{member} failed CRC32C",
+                step=self.step, rank=self.rank,
+                shard=f"{fname}:{member}")
+        if stats is not None:
+            stats.files.add(fname)
+            stats.members += 1
+            stats.bytes += int(arr.nbytes)
+        return arr
+
+    def close(self) -> None:
+        for z in self._open.values():
+            try:
+                z.close()
+            except Exception:
+                pass
+        self._open.clear()
+
+
+def _leaf_spec_from_tree(specs, n_leaves: int):
+    import jax
+    from jax.sharding import PartitionSpec
+    if specs is None:
+        return [None] * n_leaves
+    leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda s: s is None or isinstance(s, PartitionSpec))
+    if len(leaves) != n_leaves:
+        raise CkptShapeMismatch(
+            f"target spec tree has {len(leaves)} leaves, checkpoint tree "
+            f"has {n_leaves}")
+    return leaves
+
+
+def read_tree(step_dir: str, manifest: Dict[str, Any], name: str,
+              template=None, target: Optional[Target] = None,
+              stats: Optional[ReadStats] = None, rank: int = -1):
+    """Restore one tree (``params``/``opt_state``/...) from a format-2
+    checkpoint. Returns None when the tree was never saved."""
+    from ..utils import checkpoint as _ck
+
+    entry = manifest["trees"].get(name)
+    if entry is None:
+        return None
+    step = int(manifest.get("step", -1))
+    leaves_meta = entry["leaves"]
+    spec_leaves = (_leaf_spec_from_tree(target.specs.get(name),
+                                        len(leaves_meta))
+                   if target is not None else [None] * len(leaves_meta))
+    files = _ShardFiles(step_dir, step, rank)
+    out_leaves = []
+    try:
+        for lmeta, tspec in zip(leaves_meta, spec_leaves):
+            shape = tuple(lmeta["shape"])
+            dtype = np.dtype(lmeta["dtype"])
+            if target is None:
+                request = full_request(shape)
+            else:
+                request = local_slices(shape, tspec, target.axis_sizes,
+                                       target.coords)
+            req_shape = tuple(s.stop - s.start for s in request)
+            dst = np.empty(req_shape, dtype)
+            covered = 0
+            for sh, smeta in zip(_mf.leaf_shards(lmeta), lmeta["shards"]):
+                ov = intersect(sh, request)
+                if ov is None:
+                    continue
+                src_sl, dst_sl = ov
+                arr = files.member(smeta["file"], smeta["member"],
+                                   int(smeta["crc32c"]), stats)
+                if lmeta.get("raw"):
+                    arr = np.frombuffer(arr.tobytes(), dtype) \
+                        .reshape(sh.shape)
+                elif arr.shape != sh.shape:
+                    raise CkptShapeMismatch(
+                        f"step {step}: shard {smeta['member']} has shape "
+                        f"{arr.shape}, manifest says {sh.shape}",
+                        step=step, rank=rank,
+                        shard=f"{smeta['file']}:{smeta['member']}")
+                dst[dst_sl] = arr[src_sl]
+                covered += int(np.prod([s.stop - s.start
+                                        for s in dst_sl], dtype=np.int64))
+            if covered != int(np.prod(req_shape, dtype=np.int64)):
+                raise CkptIncomplete(
+                    f"step {step}: leaf {lmeta['key']!r} request "
+                    f"{request} only {covered} of "
+                    f"{int(np.prod(req_shape))} elements covered by "
+                    f"saved shards", step=step, rank=rank)
+            out_leaves.append(dst)
+    finally:
+        files.close()
+    if template is not None:
+        import jax
+        treedef = jax.tree_util.tree_structure(template)
+        if treedef.num_leaves != len(out_leaves):
+            raise CkptShapeMismatch(
+                f"step {step}: checkpoint tree {name!r} has "
+                f"{len(out_leaves)} leaves but template has "
+                f"{treedef.num_leaves}", step=step, rank=rank)
+        return jax.tree_util.tree_unflatten(treedef, out_leaves)
+    return _ck._nest([m["key"] for m in leaves_meta], out_leaves,
+                     entry.get("seq_prefixes") or [])
+
+
+def restore_dir(step_dir: str, manifest: Dict[str, Any], *,
+                like_params=None, like_opt_state=None,
+                target: Optional[Target] = None,
+                stats: Optional[ReadStats] = None, rank: int = -1):
+    """Restore a resolved, loaded format-2 step dir (collective-free);
+    emits the ``ckpt_restore`` event. The shared engine behind
+    :func:`restore_sharded` and the format dispatch in
+    :func:`..utils.checkpoint.restore_checkpoint`."""
+    from ..utils import checkpoint as _ck
+    from ..utils.logging import append_event
+
+    t0 = time.perf_counter()
+    step = int(manifest.get("step", -1))
+    own_stats = stats if stats is not None else ReadStats()
+    params = read_tree(step_dir, manifest, "params", template=like_params,
+                       target=target, stats=own_stats, rank=rank)
+    opt_state = read_tree(step_dir, manifest, "opt_state",
+                          template=like_opt_state, target=target,
+                          stats=own_stats, rank=rank)
+    append_event("ckpt_restore", step=step, rank=rank, sharded=True,
+                 bytes=own_stats.bytes, shards=own_stats.members,
+                 duration_s=round(time.perf_counter() - t0, 6),
+                 resharded=target is not None,
+                 saved_axes=manifest["mesh"]["axes"],
+                 target_axes=(target.axis_sizes if target else None))
+    return _ck.Checkpoint(step=step, params=params, opt_state=opt_state,
+                          extra=manifest.get("extra") or {})
+
+
+def restore_sharded(ckpt_dir: str, step: Optional[int] = None, *,
+                    like_params=None, like_opt_state=None,
+                    target: Optional[Target] = None,
+                    stats: Optional[ReadStats] = None,
+                    rank: int = -1):
+    """Read a format-2 checkpoint back into host pytrees (collective-free).
+
+    Returns a :class:`~..utils.checkpoint.Checkpoint`. ``target`` opts
+    into slice restore (see module docstring); ``stats`` collects read
+    accounting. Raises ``FileNotFoundError`` when nothing is
+    checkpointed, the typed :mod:`.errors` hierarchy when a checkpoint
+    exists but cannot be trusted, and :class:`~.errors.CkptError` for a
+    format-1 directory (restore those through
+    ``utils.checkpoint.restore_checkpoint``, which dispatches).
+    """
+    from ..utils import checkpoint as _ck
+    from .errors import CkptError
+
+    if step is None:
+        step = _ck.latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {ckpt_dir!r}")
+    d = _ck._resolve_step_dir(ckpt_dir, step)
+    if d is None:
+        raise FileNotFoundError(
+            f"no complete checkpoint for step {step} under {ckpt_dir!r}")
+    manifest = _mf.load(d, step=step, rank=rank)
+    if manifest.get("format") != _mf.FORMAT:
+        raise CkptError(
+            f"step {step} is a format-{manifest.get('format')} "
+            "(single-replica) checkpoint; restore it via "
+            "utils.checkpoint.restore_checkpoint", step=step, rank=rank)
+    return restore_dir(d, manifest, like_params=like_params,
+                       like_opt_state=like_opt_state, target=target,
+                       stats=stats, rank=rank)

@@ -1,0 +1,153 @@
+"""Sharded checkpoint writer: each host writes only the shards it owns.
+
+Split into two halves so the manager can stage them on different threads
+(ckpt/manager.py):
+
+* :func:`write_shards` — pure file IO, safe on a background thread: the
+  calling rank slices its owned shards out of the (already host-resident)
+  arrays, writes them as one ``shard_r<rank>.npz``, stamps each member
+  with a CRC32C (native, PR 2 vocabulary), and lands its manifest
+  fragment last as the durability marker. **No collectives.**
+* :func:`commit` — main-thread only, on the committing rank (0), after a
+  barrier has established every rank's fragment is durable: merges
+  fragments into the global manifest and runs the two-rename dance from
+  :mod:`..utils.checkpoint`, so a crash at any byte leaves the previous
+  step complete and discoverable.
+
+Fault-injection hooks (``DPX_FAULT``, runtime/faults.py): the save path
+fires op ``ckpt`` at shard-write entry, ``ckpt_commit`` at commit entry,
+and ``ckpt_commit_window`` between the two commit renames — the exact
+crash window the atomicity tests target.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from . import manifest as _mf
+from .integrity import array_crc32c
+from .layout import tree_layout
+
+
+def plan_trees(trees: Dict[str, Any], specs: Dict[str, Any],
+               axis_sizes: Dict[str, int], writer_world: int
+               ) -> Dict[str, Dict[str, Any]]:
+    """Deterministic save plan: tree name → layouts + host arrays.
+
+    ``specs[name]`` may be None (replicated/full layout for that tree).
+    Every rank computes the identical plan locally — the committer
+    recomputes it to merge fragments without any cross-rank data motion.
+    """
+    meta: Dict[str, Dict[str, Any]] = {}
+    for name, tree in trees.items():
+        if tree is None:
+            continue
+        layouts, arrays, seq = tree_layout(tree, specs.get(name),
+                                           axis_sizes, writer_world)
+        raw = [np.dtype(lay.dtype).kind == "V" for lay in layouts]
+        meta[name] = {"layouts": layouts, "arrays": arrays, "raw": raw,
+                      "seq_prefixes": seq}
+    return meta
+
+
+def snapshot_owned(plan: Dict[str, Dict[str, Any]], rank: int,
+                   force_copy: bool) -> None:
+    """Cut this rank's owned shard pieces out of the plan's arrays
+    (main thread — this IS the synchronous part of an async save) and
+    drop the full-array references.
+
+    Each host materializes only the 1/world of the state it writes —
+    NOT a defensive copy of the whole replica. ``force_copy=True`` when
+    the plan references live training arrays (the host front door's
+    numpy replicas, which the caller may overwrite next step);
+    ``force_copy=False`` when the arrays are already private host
+    copies (the single-controller D2H snapshot), where a full-range
+    slice stays a zero-copy view.
+    """
+    for name, meta in sorted(plan.items()):
+        pieces: Dict[int, list] = {}
+        for l_idx, (lay, arr, raw) in enumerate(
+                zip(meta["layouts"], meta["arrays"], meta["raw"])):
+            for lin, sh in enumerate(lay.shards):
+                if sh.writer != rank:
+                    continue
+                # reshape pins the shard shape: ascontiguousarray
+                # promotes 0-d arrays to (1,), which would disagree
+                # with the manifest on read-back
+                if force_copy:
+                    piece = np.array(arr[sh.slices()]).reshape(sh.shape)
+                else:
+                    piece = np.ascontiguousarray(arr[sh.slices()]) \
+                        .reshape(sh.shape)
+                if raw:
+                    # extension dtypes (bfloat16/fp8) don't survive npy;
+                    # store raw bytes, dtype+shape live in the manifest
+                    piece = np.frombuffer(piece.tobytes(), np.uint8)
+                pieces.setdefault(l_idx, []).append((lin, piece))
+        meta["pieces"] = pieces
+        meta["arrays"] = None  # owned slices only from here on
+
+
+def write_shards(tmp_dir: str, rank: int,
+                 plan: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Write this rank's owned shard pieces + fragment into ``tmp_dir``.
+
+    Requires :func:`snapshot_owned` to have cut the pieces. Returns
+    ``{"bytes": ..., "shards": ..., "duration_s": ...}``. Safe on a
+    background thread: CRC + file IO only.
+    """
+    from ..runtime import faults
+
+    faults.on_comm_op("ckpt", rank=rank)
+    t0 = time.perf_counter()
+    members: Dict[str, np.ndarray] = {}
+    frag: Dict[str, Dict[str, int]] = {}
+    total = 0
+    for t_idx, (name, meta) in enumerate(sorted(plan.items())):
+        for l_idx, shards in meta["pieces"].items():
+            for lin, piece in shards:
+                m = _mf.member_name(t_idx, l_idx, lin)
+                members[m] = piece
+                frag[m] = {"crc32c": array_crc32c(piece),
+                           "nbytes": int(piece.nbytes)}
+                total += piece.nbytes
+    path = os.path.join(tmp_dir, _mf.shard_file(rank))
+    if members:
+        np.savez(path, **members)
+    else:
+        np.savez(path)  # owns nothing this step; fragment still lands
+    _mf.write_fragment(tmp_dir, rank, frag)  # last: durability marker
+    return {"bytes": total, "shards": len(members),
+            "duration_s": time.perf_counter() - t0}
+
+
+def commit(ckpt_dir: str, step: int, tmp_dir: str,
+           plan: Dict[str, Dict[str, Any]],
+           extra: Optional[Dict[str, Any]],
+           axis_sizes: Dict[str, int], writer_world: int,
+           keep: Optional[int] = None, rank: int = 0
+           ) -> Tuple[str, Dict[str, Any]]:
+    """Merge fragments → manifest → two-rename commit (the shared
+    ``_commit_full`` dance + fault hooks). Main thread, one rank, after
+    all fragments are durable (barrier in the manager)."""
+    from ..utils import checkpoint as _ck
+
+    tree_meta = {
+        name: {"layouts": meta["layouts"], "raw": meta["raw"],
+               "seq_prefixes": meta["seq_prefixes"]}
+        for name, meta in plan.items()}
+    man = _mf.merge(tmp_dir, step, extra, axis_sizes, writer_world,
+                    tree_meta)
+    mpath = os.path.join(tmp_dir, _mf.MANIFEST)
+    with open(mpath, "w") as f:
+        json.dump(man, f)
+        f.flush()
+        os.fsync(f.fileno())
+    final = _ck._commit_full(ckpt_dir, step, tmp_dir, keep=keep,
+                             rank=rank)
+    return final, man

@@ -8,7 +8,7 @@ from .data_parallel import (DataParallel, make_eval_step,
                             prepare_ddp_model, stack_state)
 from .fsdp import (fsdp_param_specs, make_fsdp_train_step,
                    make_zero1_train_step, make_zero2_train_step,
-                   shard_model_and_opt)
+                   opt_state_specs, shard_layouts, shard_model_and_opt)
 from .moe import MoELayer, moe_param_specs
 from .pipeline import (make_gspmd_pipeline_fn, make_pipeline_train_fn,
                        pipeline_apply, stack_layer_params)

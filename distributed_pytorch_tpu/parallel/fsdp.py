@@ -131,6 +131,28 @@ def shard_model_and_opt(params, opt_state, mesh: Mesh, param_specs):
             shard_params(opt_state, o_specs, mesh))
 
 
+def shard_layouts(params, opt_state=None, *, n_shards: int,
+                  axis: str = "dp", min_size: int = 1024,
+                  base_specs: Optional[Any] = None
+                  ) -> Tuple[Any, Optional[Any], dict]:
+    """The checkpoint-facing sharding contract:
+    ``(param_specs, opt_specs, axis_sizes)``.
+
+    One call gives the sharded checkpoint subsystem
+    (:mod:`..ckpt`) everything it needs to decompose state into
+    owned shards — the same specs that drive the ZeRO layout drive
+    which bytes each host writes, so checkpoints follow the sharding
+    instead of undoing it. ``opt_specs`` is None when ``opt_state``
+    is; ``axis_sizes`` is the mesh-axis extent the specs refer to
+    (``{axis: n_shards}``), the unit a restore reshards against.
+    """
+    p_specs = fsdp_param_specs(params, n_shards, axis=axis,
+                               min_size=min_size, base_specs=base_specs)
+    o_specs = (opt_state_specs(opt_state, p_specs, params=params)
+               if opt_state is not None else None)
+    return p_specs, o_specs, {axis: int(n_shards)}
+
+
 def make_fsdp_train_step(loss_fn: Callable, optimizer: Optimizer,
                          mesh: Mesh, param_specs,
                          state_specs: Optional[Any] = None,

@@ -23,6 +23,14 @@ so process-level restart is the ONLY restart that works.
 The restart attempt number is exported to the child as
 ``DPX_ELASTIC_ATTEMPT`` (0 on the first launch); ``DPX_ELASTIC=1`` marks
 the child as elastically supervised.
+
+Topology shrink: a relaunch is not forced back onto the dead topology.
+The ``reconfigure`` hook of :func:`elastic_run` rewrites the worker's
+arguments between attempts (e.g. halving the world size after a host
+loss), and the sharded checkpoint subsystem (:mod:`..ckpt`) reshards the
+restore onto whatever mesh the relaunched worker builds — a checkpoint
+written at ``dp=N`` resumes at ``dp=M`` (tests/test_ckpt_sharded.py
+covers kill → shrink → resume end to end).
 """
 
 from __future__ import annotations
@@ -67,7 +75,8 @@ def _child_bootstrap(target, args, child_env):
 def elastic_run(target: Callable, args: Sequence = (), *,
                 max_restarts: int = 3, backoff_s: float = 1.0,
                 ctx_method: str = "spawn",
-                env: Optional[dict] = None) -> ElasticResult:
+                env: Optional[dict] = None,
+                reconfigure: Optional[Callable] = None) -> ElasticResult:
     """Run ``target(*args)`` in a subprocess; relaunch on failure.
 
     ``target`` must be picklable (module-level) and resume-idempotent:
@@ -77,12 +86,29 @@ def elastic_run(target: Callable, args: Sequence = (), *,
     relaunches are exhausted. ``backoff_s`` doubles per restart (a
     crashing-on-start worker must not busy-loop the host). ``env``
     entries are exported to the child (on top of the parent's
-    environment)."""
+    environment).
+
+    ``reconfigure(attempt, exitcode, args) -> args | None`` runs before
+    each relaunch (``attempt`` = the upcoming attempt number, ``exitcode``
+    = the failed attempt's exit code) and may return NEW arguments for the
+    next attempt — the topology-shrink hook: after a host dies, relaunch
+    the worker on a smaller world and let the sharded checkpoint
+    subsystem (:mod:`..ckpt`) reshard the restore onto it, instead of
+    demanding the original world size back (docs/failures.md). Returning
+    None keeps the previous arguments.
+    """
     from ..utils.logging import append_event
 
     ctx = mp.get_context(ctx_method)
     codes = []
+    args = tuple(args)
     for attempt in range(max_restarts + 1):
+        if attempt > 0 and reconfigure is not None:
+            new_args = reconfigure(attempt, codes[-1], args)
+            if new_args is not None and tuple(new_args) != args:
+                args = tuple(new_args)
+                append_event("elastic_reconfigured", attempt=attempt,
+                             args=[str(a) for a in args])
         child_env = {ATTEMPT_ENV: str(attempt), ELASTIC_ENV: "1"}
         if env:
             child_env.update({k: str(v) for k, v in env.items()})

@@ -32,7 +32,13 @@ everything):
 - ``rank``    — the calling rank (passed by the hook call sites).
 - ``op``      — the comm op name; specs carrying ``op`` fire from
   :func:`on_comm_op` (the :class:`~.native.HostComm` methods call it
-  before every native collective).
+  before every native collective). The CHECKPOINT save path fires three
+  ops of its own (``utils/checkpoint.py`` + ``ckpt/writer.py``):
+  ``op=ckpt`` at shard/tree write entry, ``op=ckpt_commit`` at commit
+  entry, and ``op=ckpt_commit_window`` between the two commit renames —
+  so ``kill@op=ckpt_commit_window`` dies at the exact byte where only
+  the renamed-aside ``.old`` copy is complete (the atomicity chaos test
+  in tests/test_ckpt_sharded.py; ``delay@op=ckpt,ms=...`` stalls saves).
 - ``call``    — the Nth (1-based) invocation of that op in this process.
 - ``step``    — the training step; specs *without* ``op`` fire from
   :func:`on_step` (train loops call it once per step); specs *with*

@@ -1,6 +1,8 @@
 """Utilities: primary-only logging, metrics, checkpointing, profiling."""
 from . import checkpoint, logging, profiler
-from .checkpoint import (Checkpoint, CheckpointManager, available_steps,
-                         latest_step, restore_checkpoint, save_checkpoint)
+from .checkpoint import (Checkpoint, CheckpointManager, CkptCorrupt,
+                         CkptError, CkptIncomplete, CkptShapeMismatch,
+                         available_steps, latest_step, restore_checkpoint,
+                         restore_sharded, save_checkpoint)
 from .logging import MetricsLogger, is_primary, print_primary
 from .profiler import StepTimer, annotate, compiled_stats, trace

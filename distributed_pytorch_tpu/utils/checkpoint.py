@@ -23,6 +23,16 @@ so this subsystem provides it TPU-natively:
   structure (NamedTuples, custom nodes) is rebuilt via ``tree_unflatten``;
   without it, nested dict/list structure is reconstructed from the stored
   key paths.
+
+This module is the SINGLE-REPLICA fallback (format 1: rank 0 serializes
+the whole replicated state). The sharded subsystem
+(:mod:`distributed_pytorch_tpu.ckpt`) writes format 2 — every host
+writes only the shards it owns, restores reshard onto any topology, and
+async saves run no collectives off the main thread — and is re-exported
+here (:class:`CheckpointManager` with ``sharded=True``,
+:func:`restore_sharded`, the ``Ckpt*`` error types).
+:func:`restore_checkpoint` dispatches on the manifest format, so callers
+restore either layout through the same door.
 """
 
 from __future__ import annotations
@@ -32,13 +42,13 @@ import json
 import os
 import re
 import shutil
-import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
-from .logging import is_primary
+from .logging import append_event, is_primary
 
 _STEP_DIR_RE = re.compile(r"^step_(\d+)$")
 _OLD_DIR_RE = re.compile(r"^step_(\d+)\.old\.\d+$")
@@ -221,11 +231,20 @@ def _resolve_step_dir(ckpt_dir: str, step: int) -> Optional[str]:
         return _step_dir(ckpt_dir, step)
     if not os.path.isdir(ckpt_dir):
         return None
-    for name in sorted(os.listdir(ckpt_dir)):
+    candidates = []
+    for name in os.listdir(ckpt_dir):
         m = _OLD_DIR_RE.match(name)
         if m and int(m.group(1)) == step and _is_complete(ckpt_dir, name):
-            return os.path.join(ckpt_dir, name)
-    return None
+            candidates.append(name)
+    if not candidates:
+        return None
+    # several .old copies can coexist after repeated crash windows (the
+    # suffix is an arbitrary pid); the NEWEST manifest is the one that was
+    # live most recently — lexicographic pid order used to pick among
+    # them, which could resolve an ancient copy over fresher data
+    best = max(candidates, key=lambda n: os.path.getmtime(
+        os.path.join(ckpt_dir, n, _MANIFEST)))
+    return os.path.join(ckpt_dir, best)
 
 
 def available_steps(ckpt_dir: str) -> List[int]:
@@ -281,6 +300,39 @@ def _remove_step(ckpt_dir: str, step: int) -> None:
             shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
 
 
+def _supersede_old_forms(ckpt_dir: str, step: int) -> None:
+    """After a successful commit of ``step_<step>``, drop every stale
+    ``.old``/``.tmp`` form of the SAME step.
+
+    A crash-window ``.old`` copy that survives a later successful re-save
+    is a landmine: it holds superseded data under an arbitrary pid
+    suffix, and a subsequent crash window for the same step would leave
+    *two* ``.old`` candidates for discovery to choose between. Fresh
+    commit in place ⇒ every other form of the step is garbage.
+    """
+    if not _is_complete(ckpt_dir, f"step_{step}"):
+        return  # no live copy to supersede with — keep the fallbacks
+    for name in os.listdir(ckpt_dir):
+        m = _OLD_DIR_RE.match(name) or _TMP_DIR_RE.match(name)
+        if m and int(m.group(1)) == step:
+            shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
+
+
+def _apply_retention(ckpt_dir: str, step: int, keep: int) -> None:
+    """Evict all but the newest ``keep`` steps — but never ANY on-disk
+    form of ``step``, the copy that was just committed.
+
+    The guard matters precisely for a ``force=True`` re-save of an
+    off-interval step: such a step can sort *below* the newest ``keep``
+    and would land in the eviction prefix of its own save; skipping the
+    whole :func:`_remove_step` call (live + ``.old`` + ``.tmp`` forms)
+    keeps the just-written copy restorable no matter where it sorts.
+    """
+    for old in available_steps(ckpt_dir)[:-keep]:
+        if old != step:
+            _remove_step(ckpt_dir, old)
+
+
 # ---------------------------------------------------------------------------
 # Save / restore
 # ---------------------------------------------------------------------------
@@ -291,6 +343,62 @@ class Checkpoint:
     params: Any
     opt_state: Any = None
     extra: Optional[Dict[str, Any]] = None
+
+
+def _write_full(tmp: str, step: int, params, opt_state,
+                extra: Optional[Dict[str, Any]]) -> int:
+    """Write the full-replica (format 1) payload + manifest into ``tmp``.
+
+    Pure file IO — safe on a background thread (the async manager stages
+    it there). Returns the bytes written.
+    """
+    manifest: Dict[str, Any] = {"step": step, "format": 1,
+                                "extra": extra or {}, "trees": {}}
+    manifest["trees"]["params"] = _save_tree(
+        os.path.join(tmp, "params.npz"), params)
+    if opt_state is not None:
+        manifest["trees"]["opt_state"] = _save_tree(
+            os.path.join(tmp, "opt_state.npz"), opt_state)
+    with open(os.path.join(tmp, _MANIFEST), "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    return sum(os.path.getsize(os.path.join(tmp, n))
+               for n in os.listdir(tmp))
+
+
+def _commit_full(ckpt_dir: str, step: int, tmp: str,
+                 keep: Optional[int] = None, rank: int = 0) -> str:
+    """Atomically promote a fully written ``tmp`` dir to ``step_<step>``.
+
+    The two-rename dance: never rmtree the live checkpoint before the
+    replacement lands — rename it aside first, so a crash between the two
+    renames still leaves one complete copy (discoverable via its ``.old``
+    name). Fires the ``DPX_FAULT`` ops ``ckpt_commit`` (entry) and
+    ``ckpt_commit_window`` (inside the window) so chaos tests can kill
+    the process at the worst byte. Shared by the sharded committer
+    (ckpt/writer.py) and the format-1 path below.
+    """
+    from ..runtime import faults
+
+    faults.on_comm_op("ckpt_commit", rank=rank)
+    final = _step_dir(ckpt_dir, step)
+    if os.path.exists(final):
+        aside = final + f".old.{os.getpid()}"
+        if os.path.exists(aside):
+            shutil.rmtree(aside)
+        os.replace(final, aside)
+        # the crash window: only the .old copy is complete right now
+        faults.on_comm_op("ckpt_commit_window", rank=rank)
+        os.replace(tmp, final)
+        shutil.rmtree(aside, ignore_errors=True)
+    else:
+        faults.on_comm_op("ckpt_commit_window", rank=rank)
+        os.replace(tmp, final)
+    _supersede_old_forms(ckpt_dir, step)
+    if keep is not None:
+        _apply_retention(ckpt_dir, step, keep)
+    return final
 
 
 def save_checkpoint(ckpt_dir: str, step: int, params,
@@ -304,45 +412,29 @@ def save_checkpoint(ckpt_dir: str, step: int, params,
     ``keep``: retain only the newest ``keep`` checkpoints after a save.
     """
     from ..comm.collectives import barrier
+    from ..runtime import context, faults
 
     if keep is not None and keep < 1:
         raise ValueError(f"keep must be >= 1, got {keep}")
     final = _step_dir(ckpt_dir, step)
     try:
         if is_primary():
+            faults.on_comm_op("ckpt", rank=context.get_rank())
             # Reject non-serializable extras before any file is touched.
             json.dumps(extra or {})
+            t0 = time.perf_counter()
             os.makedirs(ckpt_dir, exist_ok=True)
             _sweep_stale(ckpt_dir, keep_old_for=step)
             tmp = final + f".tmp.{os.getpid()}"
             if os.path.exists(tmp):
                 shutil.rmtree(tmp)
             os.makedirs(tmp)
-            manifest: Dict[str, Any] = {"step": step, "format": 1,
-                                        "extra": extra or {}, "trees": {}}
-            manifest["trees"]["params"] = _save_tree(
-                os.path.join(tmp, "params.npz"), params)
-            if opt_state is not None:
-                manifest["trees"]["opt_state"] = _save_tree(
-                    os.path.join(tmp, "opt_state.npz"), opt_state)
-            with open(os.path.join(tmp, _MANIFEST), "w") as f:
-                json.dump(manifest, f)
-            if os.path.exists(final):
-                # Never rmtree the live checkpoint before the replacement
-                # lands: rename it aside first so a crash between the two
-                # renames still leaves one valid copy.
-                aside = final + f".old.{os.getpid()}"
-                if os.path.exists(aside):
-                    shutil.rmtree(aside)
-                os.replace(final, aside)
-                os.replace(tmp, final)
-                shutil.rmtree(aside, ignore_errors=True)
-            else:
-                os.replace(tmp, final)
-            if keep is not None:
-                for old in available_steps(ckpt_dir)[:-keep]:
-                    if old != step:  # never evict what was just written
-                        _remove_step(ckpt_dir, old)
+            nbytes = _write_full(tmp, step, params, opt_state, extra)
+            _commit_full(ckpt_dir, step, tmp, keep=keep)
+            append_event("ckpt_save", step=step, rank=context.get_rank(),
+                         world=context.get_world_size(), sharded=False,
+                         async_save=False, bytes=nbytes, shards=1,
+                         io_s=round(time.perf_counter() - t0, 6))
     finally:
         # Non-primary ranks wait here; the finally keeps them from hanging
         # forever when the primary's write raises (they proceed and the
@@ -352,8 +444,17 @@ def save_checkpoint(ckpt_dir: str, step: int, params,
 
 
 def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
-                       like_params=None, like_opt_state=None) -> Checkpoint:
+                       like_params=None, like_opt_state=None,
+                       target=None) -> Checkpoint:
     """Read ``step_<step>/`` (default: latest) back into host pytrees.
+
+    Dispatches on the stored manifest format: format 1 (single-replica)
+    restores through the legacy path below; format 2 (sharded,
+    :mod:`..ckpt`) restores through the resharding reader — ``target``
+    (a :class:`..ckpt.reader.Target`) then opts into slice restore, each
+    host reading only the shards it needs. A truncated/unparseable
+    manifest raises :class:`..ckpt.errors.CkptIncomplete`; shard CRC
+    failures raise :class:`..ckpt.errors.CkptCorrupt`.
 
     With ``like_*`` templates the restored trees have exactly the template's
     structure (tree_unflatten); otherwise nested dict/list structure is
@@ -373,8 +474,19 @@ def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
         if d is None:
             raise FileNotFoundError(
                 f"no complete checkpoint for step {step} under {ckpt_dir!r}")
-        with open(os.path.join(d, _MANIFEST)) as f:
-            manifest = json.load(f)
+        from ..ckpt import manifest as _mf
+        from ..runtime import context
+        manifest = _mf.load(d, step=step, rank=context.get_rank())
+        if manifest.get("format") == _mf.FORMAT:
+            from ..ckpt import reader as _reader
+            return _reader.restore_dir(
+                d, manifest, like_params=like_params,
+                like_opt_state=like_opt_state, target=target,
+                rank=context.get_rank())
+        if target is not None:
+            raise ValueError(
+                "target= (slice restore) needs a sharded (format 2) "
+                f"checkpoint; step {step} is format 1")
 
         def load(name, like):
             meta = manifest["trees"].get(name)
@@ -390,10 +502,13 @@ def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
                 return jax.tree_util.tree_unflatten(treedef, leaves)
             return _nest(meta["keys"], leaves, meta.get("seq_prefixes") or [])
 
-        return Checkpoint(step=manifest["step"],
-                          params=load("params", like_params),
-                          opt_state=load("opt_state", like_opt_state),
-                          extra=manifest.get("extra") or {})
+        ck = Checkpoint(step=manifest["step"],
+                        params=load("params", like_params),
+                        opt_state=load("opt_state", like_opt_state),
+                        extra=manifest.get("extra") or {})
+        append_event("ckpt_restore", step=ck.step,
+                     rank=context.get_rank(), sharded=False)
+        return ck
     finally:
         # All ranks leave restore together (and together with any rank that
         # raised — the finally runs on every exit path, so no deadlock).
@@ -401,88 +516,18 @@ def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
 
 
 # ---------------------------------------------------------------------------
-# Manager: interval + retention + async save
+# Manager + sharded re-exports (the new front door lives in ckpt/)
 # ---------------------------------------------------------------------------
 
-class CheckpointManager:
-    """Policy wrapper: save every ``interval`` steps, keep the newest
-    ``keep``, optionally in a background thread so the device stays busy
-    (the save cost is host-side serialization; overlap it with compute).
+# The manager (interval + retention + true-async staged saves + the
+# sharded= mode) moved to ckpt/manager.py; re-exported here so existing
+# callers keep their import path. The typed failure vocabulary and the
+# resharding reader ride along: utils.checkpoint is the one checkpoint
+# door an application needs.
+from ..ckpt.errors import (CkptCorrupt, CkptError,  # noqa: E402,F401
+                           CkptIncomplete, CkptShapeMismatch)
+from ..ckpt.manager import CheckpointManager  # noqa: E402,F401
+from ..ckpt.reader import (ReadStats, Target,  # noqa: E402,F401
+                           restore_sharded)
 
-    ``wait()`` (or context-manager exit) joins any in-flight async save —
-    call it before reading the checkpoint back or exiting the process.
-    """
 
-    def __init__(self, ckpt_dir: str, interval: int = 1,
-                 keep: Optional[int] = 3, async_save: bool = False):
-        if keep is not None and keep < 1:
-            raise ValueError(f"keep must be >= 1, got {keep}")
-        self.ckpt_dir = ckpt_dir
-        self.interval = max(int(interval), 1)
-        self.keep = keep
-        self.async_save = async_save
-        self._thread: Optional[threading.Thread] = None
-        self._error: Optional[BaseException] = None
-
-    def should_save(self, step: int) -> bool:
-        return step % self.interval == 0
-
-    def save(self, step: int, params, opt_state=None,
-             extra: Optional[Dict[str, Any]] = None, force: bool = False
-             ) -> bool:
-        """Save if the policy says so. Returns True iff a save happened."""
-        if not force and not self.should_save(step):
-            return False
-        self.wait()
-        # Materialize device values on the host *before* handing off to a
-        # thread: the caller may donate/overwrite the arrays next step.
-        # Primary-only: save_checkpoint discards the trees on other ranks,
-        # so a full D2H copy there would be a pure stall.
-        if is_primary():
-            params = jax.tree_util.tree_map(np.asarray, params)
-            if opt_state is not None:
-                opt_state = jax.tree_util.tree_map(np.asarray, opt_state)
-        # Async save is single-controller-only: under the per-rank-process
-        # front door the save's barrier would run on a background thread
-        # concurrently with training collectives, breaking the cross-rank
-        # collective ordering the native group requires. Degrade to sync.
-        from ..runtime import context
-        use_async = self.async_save and context.get_host_comm() is None
-        if use_async:
-            def run():
-                try:
-                    save_checkpoint(self.ckpt_dir, step, params, opt_state,
-                                    extra, keep=self.keep)
-                except BaseException as e:  # surfaced by wait()
-                    self._error = e
-            self._thread = threading.Thread(target=run, daemon=True)
-            self._thread.start()
-        else:
-            save_checkpoint(self.ckpt_dir, step, params, opt_state, extra,
-                            keep=self.keep)
-        return True
-
-    def wait(self) -> None:
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
-        if self._error is not None:
-            err, self._error = self._error, None
-            raise err
-
-    def restore_latest(self, like_params=None, like_opt_state=None
-                       ) -> Optional[Checkpoint]:
-        """Latest checkpoint, or None when the directory is empty — the
-        resume-or-fresh-start branch every training script wants."""
-        self.wait()
-        if latest_step(self.ckpt_dir) is None:
-            return None
-        return restore_checkpoint(self.ckpt_dir, like_params=like_params,
-                                  like_opt_state=like_opt_state)
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.wait()
-        return False

@@ -19,6 +19,9 @@ from ..runtime import context
 METRICS_LOG_ENV = "DPX_METRICS_LOG"
 
 
+_event_lock = threading.Lock()
+
+
 def append_event(event: str, path: Optional[str] = None, **fields: Any
                  ) -> bool:
     """Append one ``{"event": ..., "time": ...}`` line-JSON record.
@@ -26,14 +29,26 @@ def append_event(event: str, path: Optional[str] = None, **fields: Any
     ``path`` defaults to ``$DPX_METRICS_LOG``; silently a no-op when
     neither is set (callers are supervision hot paths — observability
     must never take down recovery). Returns whether a line was written.
+
+    Multi-writer safe: the checkpoint manager's IO thread, the engine
+    thread, and every rank process of a host group may all append to one
+    stream, so each record is emitted as a single O_APPEND write under a
+    process-local lock (one ``write`` per line keeps lines intact across
+    processes too — POSIX appends of this size don't interleave).
     """
     path = path or os.environ.get(METRICS_LOG_ENV)
     if not path:
         return False
     rec = {"event": event, "time": time.time(), **fields}
+    data = (json.dumps(rec, default=str) + "\n").encode()
     try:
-        with open(path, "a") as f:
-            f.write(json.dumps(rec, default=str) + "\n")
+        with _event_lock:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                         0o644)
+            try:
+                os.write(fd, data)
+            finally:
+                os.close(fd)
         return True
     except OSError:
         return False

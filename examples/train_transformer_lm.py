@@ -128,6 +128,12 @@ def parse_args(argv=None):
                         "utils/checkpoint.py).")
     p.add_argument("--save-every", default=50, type=int,
                    help="Steps between checkpoints when --save is set.")
+    p.add_argument("--sharded-ckpt", action="store_true",
+                   help="Sharded checkpoints (ckpt/): every host writes "
+                        "only the shards it owns per the FSDP specs, "
+                        "restores reshard onto any world size, and async "
+                        "saves defer their commit barrier to the main "
+                        "thread instead of degrading to sync.")
     p.add_argument("--resume", action="store_true",
                    help="Restore the latest checkpoint from --save and "
                         "continue (exact continuation: the data stream "
@@ -280,8 +286,22 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
     if args.save:
         from distributed_pytorch_tpu.utils.checkpoint import (
             CheckpointManager, restore_checkpoint)
-        ckpt_mgr = CheckpointManager(args.save, interval=args.save_every,
-                                     keep=3, async_save=True)
+        if args.sharded_ckpt:
+            # checkpoints follow the sharding: the same spec tree that
+            # would drive the ZeRO layout decomposes the state into
+            # owned shards, and a restore reshards onto whatever world
+            # size the relaunch has (ckpt/, docs/checkpointing.md)
+            from distributed_pytorch_tpu.parallel import shard_layouts
+            p_specs, _, ax = shard_layouts(
+                params, None, n_shards=max(world_size, 1))
+            ckpt_mgr = CheckpointManager(
+                args.save, interval=args.save_every, keep=3,
+                async_save=True, sharded=True, param_specs=p_specs,
+                axis_sizes=ax)
+        else:
+            ckpt_mgr = CheckpointManager(args.save,
+                                         interval=args.save_every,
+                                         keep=3, async_save=True)
         if args.resume:
             ck = restore_checkpoint(args.save, like_params=params,
                                     like_opt_state=opt_state)

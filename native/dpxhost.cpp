@@ -427,6 +427,15 @@ int listen_on(int port) {
 
 extern "C" {
 
+// Standalone CRC32C over an arbitrary buffer — the same Castagnoli
+// implementation (hw sse4.2 / sw slice-by-4) that checksums quant frames,
+// exported so the checkpoint subsystem (distributed_pytorch_tpu/ckpt/)
+// stamps per-shard checksums with the identical vocabulary. No comm
+// handle needed: integrity checking must work before any group exists.
+uint32_t dpx_crc32c(const void* data, int64_t n) {
+  return crc32_of(data, static_cast<size_t>(n));
+}
+
 // Returns an opaque comm handle, or null on failure. All ranks call this
 // concurrently; it blocks until the hub and ring links are up.
 void* dpx_comm_init(const char* master_addr, int base_port, int rank,

@@ -348,6 +348,114 @@ def test_retention_removes_old_and_tmp_forms(tmp_path):
     assert "step_1.old.11111" not in os.listdir(tmp_path)
 
 
+def test_tuple_of_dicts_roundtrips_template_free(tmp_path):
+    """A tuple root is a sequence node: template-free restore rebuilds it
+    as a list of dicts (sequence identity), values intact."""
+    params = ({"a": np.ones((2, 2), np.float32)},
+              {"b": np.zeros((3,), np.float32),
+               "c": [np.full((1,), 4.0, np.float32)]})
+    save_checkpoint(str(tmp_path), 1, params)
+    ck = restore_checkpoint(str(tmp_path))
+    assert isinstance(ck.params, list) and len(ck.params) == 2
+    assert set(ck.params[0]) == {"a"} and set(ck.params[1]) == {"b", "c"}
+    assert isinstance(ck.params[1]["c"], list)
+    _tree_eq(ck.params, params)
+    # with a template the exact tuple structure comes back
+    ck2 = restore_checkpoint(str(tmp_path), like_params=params)
+    assert isinstance(ck2.params, tuple)
+    assert jax.tree_util.tree_structure(ck2.params) == \
+        jax.tree_util.tree_structure(params)
+
+
+def test_empty_subtree_roundtrip(tmp_path):
+    """An empty dict contributes no leaves: template-free restore drops
+    it (nothing was stored), while a template reconstructs the exact
+    structure including the empty node."""
+    params = {"w": np.ones((2,), np.float32), "empty": {}}
+    save_checkpoint(str(tmp_path), 1, params)
+    ck = restore_checkpoint(str(tmp_path))
+    assert set(ck.params) == {"w"}  # leafless subtrees are not stored
+    np.testing.assert_array_equal(ck.params["w"], params["w"])
+    ck2 = restore_checkpoint(str(tmp_path), like_params=params)
+    assert set(ck2.params) == {"w", "empty"}
+    assert ck2.params["empty"] == {}
+
+
+def test_bare_leaf_tree_roundtrips_through_seq_prefixes(tmp_path):
+    """A single bare-array tree (empty key path) and a bare tuple of
+    leaves (every leaf under a sequence root) both survive the
+    seq_prefixes encoding."""
+    bare = np.arange(6, dtype=np.float32).reshape(2, 3)
+    save_checkpoint(str(tmp_path / "bare"), 1, bare)
+    ck = restore_checkpoint(str(tmp_path / "bare"))
+    assert isinstance(ck.params, np.ndarray)
+    np.testing.assert_array_equal(ck.params, bare)
+
+    tup = (np.zeros((2,), np.float32), np.ones((3,), np.float32))
+    save_checkpoint(str(tmp_path / "tup"), 1, tup)
+    ck = restore_checkpoint(str(tmp_path / "tup"))
+    assert isinstance(ck.params, list)  # sequence identity, as a list
+    _tree_eq(ck.params, list(tup))
+
+
+def test_force_resave_off_interval_step_keeps_own_copy(tmp_path):
+    """Retention regression: a force=True re-save of an OFF-INTERVAL step
+    sorts below the newest ``keep`` steps — its own eviction prefix
+    contains it — and must never evict (any on-disk form of) the copy it
+    just committed."""
+    p_old = {"w": np.zeros((2,), np.float32)}
+    p_new = {"w": np.full((2,), 7.0, np.float32)}
+    mgr = CheckpointManager(str(tmp_path), interval=10, keep=2)
+    for s in (10, 20, 30):
+        mgr.save(s, p_old)
+    assert available_steps(str(tmp_path)) == [20, 30]
+    # off-interval force re-save of an old step (landing in [:-keep])
+    save_checkpoint(str(tmp_path), 5, p_old)
+    save_checkpoint(str(tmp_path), 5, p_new, keep=2)
+    ck = restore_checkpoint(str(tmp_path), step=5, like_params=p_new)
+    np.testing.assert_array_equal(ck.params["w"], p_new["w"])
+    # and the manager path, same scenario
+    mgr.save(5, p_new, force=True)
+    np.testing.assert_array_equal(
+        restore_checkpoint(str(tmp_path), step=5,
+                           like_params=p_new).params["w"], p_new["w"])
+
+
+def test_resave_supersedes_stale_old_copies(tmp_path):
+    """Regression: after a successful re-commit of step N, stale
+    ``step_N.old.*`` crash-window copies (holding SUPERSEDED data) must
+    be removed — a later crash window would otherwise leave two .old
+    candidates and discovery could resolve the ancient one."""
+    p1 = {"w": np.zeros((2,), np.float32)}
+    p2 = {"w": np.ones((2,), np.float32)}
+    save_checkpoint(str(tmp_path), 4, p1)
+    # crash-window leftover: live dir renamed aside by a dead pid
+    os.rename(tmp_path / "step_4", tmp_path / "step_4.old.111")
+    save_checkpoint(str(tmp_path), 4, p2)
+    names = os.listdir(tmp_path)
+    assert not any(n.startswith("step_4.old.") for n in names), names
+    np.testing.assert_array_equal(
+        restore_checkpoint(str(tmp_path)).params["w"], p2["w"])
+
+
+def test_resolve_prefers_newest_old_copy(tmp_path):
+    """When repeated crash windows leave several ``.old`` copies of one
+    step, discovery must resolve the most recently live one (newest
+    manifest), not the lexicographically first pid."""
+    p1 = {"w": np.zeros((2,), np.float32)}
+    p2 = {"w": np.ones((2,), np.float32)}
+    save_checkpoint(str(tmp_path / "a"), 4, p1)
+    save_checkpoint(str(tmp_path / "b"), 4, p2)
+    os.makedirs(tmp_path / "ck")
+    # ancient copy sorts FIRST (the order the old listdir scan trusted)
+    os.rename(tmp_path / "a" / "step_4", tmp_path / "ck" / "step_4.old.111")
+    os.rename(tmp_path / "b" / "step_4", tmp_path / "ck" / "step_4.old.999")
+    os.utime(tmp_path / "ck" / "step_4.old.111" / "manifest.json", (1, 1))
+    os.utime(tmp_path / "ck" / "step_4.old.999" / "manifest.json", None)
+    ck = restore_checkpoint(str(tmp_path / "ck"))
+    np.testing.assert_array_equal(ck.params["w"], p2["w"])
+
+
 def test_adamw_8bit_state_roundtrips_with_exact_resume(tmp_path):
     """The quantized optimizer state (int8 code arrays + per-block
     scale/mid NamedTuples) checkpoints and restores bit-exactly, and a

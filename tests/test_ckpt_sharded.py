@@ -1,0 +1,699 @@
+"""Sharded distributed checkpointing with topology-resharding restore
+(distributed_pytorch_tpu/ckpt/): layout geometry, owned-shard writes with
+per-shard CRC32C, atomic commit under injected kills, the async
+no-collectives-off-main-thread contract, typed corruption errors, and the
+acceptance property — a world-4 run checkpointed mid-training resumes
+bit-exactly on world 4 and loss-correctly on world 2 and world 1, with
+the elastic kill → shrink → resume flow end to end."""
+
+import json
+import multiprocessing as mp
+import os
+import threading
+import zipfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import distributed_pytorch_tpu as dist
+from distributed_pytorch_tpu import models, optim
+from distributed_pytorch_tpu.ckpt import (CheckpointManager, CkptCorrupt,
+                                          CkptError, CkptIncomplete,
+                                          CkptShapeMismatch, ReadStats,
+                                          Target, clear_trace, integrity,
+                                          layout, restore_sharded,
+                                          trace_log)
+from distributed_pytorch_tpu.ops.losses import cross_entropy_per_example
+from distributed_pytorch_tpu.parallel import (fsdp_param_specs,
+                                              make_fsdp_train_step,
+                                              make_train_step,
+                                              shard_layouts,
+                                              shard_model_and_opt)
+from distributed_pytorch_tpu.runtime import context, elastic, faults
+from distributed_pytorch_tpu.utils.checkpoint import (available_steps,
+                                                      restore_checkpoint,
+                                                      save_checkpoint)
+
+
+def _tree_eq(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_trace():
+    faults.reset()
+    clear_trace()
+    yield
+    faults.reset()
+    clear_trace()
+
+
+# ---------------------------------------------------------------------------
+# layout geometry
+# ---------------------------------------------------------------------------
+
+class TestLayout:
+    def test_dim_partitions(self):
+        assert layout.dim_partitions(P("dp", None), (8, 4),
+                                     {"dp": 4}) == (4, 1)
+        assert layout.dim_partitions(P(None, ("dp", "tp")), (2, 8),
+                                     {"dp": 2, "tp": 2}) == (1, 4)
+        # unknown axis names count as 1 (tp state on a dp-only topology)
+        assert layout.dim_partitions(P("tp"), (6,), {"dp": 2}) == (1,)
+        assert layout.dim_partitions(None, (3, 3), {"dp": 4}) == (1, 1)
+        # non-divisible reshard targets are TYPED (supervisors catch
+        # CkptError to fall back to full assembly)
+        with pytest.raises(CkptShapeMismatch):
+            layout.dim_partitions(P("dp"), (6,), {"dp": 4})
+
+    def test_stale_coordinate_is_typed_not_wrapped(self):
+        """A relaunched worker still carrying its pre-shrink rank must
+        get a typed error, never a silent modulo wrap onto some other
+        host's shard."""
+        with pytest.raises(CkptShapeMismatch, match="out of range"):
+            layout.local_slices((8,), P("dp"), {"dp": 4}, {"dp": 5})
+        # an axis absent from the topology is replication, not an error
+        assert layout.local_slices((8,), P("tp"), {"dp": 4},
+                                   {"tp": 3}) == (slice(0, 8),)
+
+    def test_owner_round_robin_matches_dp_rank(self):
+        lay = layout.leaf_layout("w", (8, 2), "float32", P("dp", None),
+                                 {"dp": 4}, writer_world=4)
+        assert [s.writer for s in lay.shards] == [0, 1, 2, 3]
+        assert [s.offsets for s in lay.shards] == [
+            ((0, 2), (0, 2)), ((2, 4), (0, 2)),
+            ((4, 6), (0, 2)), ((6, 8), (0, 2))]
+
+    def test_intersect_and_local_slices(self):
+        lay = layout.leaf_layout("w", (8,), "float32", P("dp"),
+                                 {"dp": 4}, writer_world=1)
+        # dp=2 rank 1 wants [4:8] -> saved shards 2 and 3 exactly
+        req = layout.local_slices((8,), P("dp"), {"dp": 2}, {"dp": 1})
+        assert req == (slice(4, 8),)
+        hits = [(i, layout.intersect(s, req))
+                for i, s in enumerate(lay.shards)]
+        assert [i for i, h in hits if h is not None] == [2, 3]
+        src, dst = hits[2][1]
+        assert src == (slice(0, 2),) and dst == (slice(0, 2),)
+
+    def test_crc_sw_matches_native_and_vector(self):
+        # CRC32C('123456789') is the classic check vector
+        assert integrity.crc32c_sw(b"123456789") == 0xE3069283
+        data = np.arange(999, dtype=np.float32).tobytes()
+        assert integrity.crc32c(data) == integrity.crc32c_sw(data)
+
+
+# ---------------------------------------------------------------------------
+# save/restore round trips + resharding
+# ---------------------------------------------------------------------------
+
+def _state():
+    params = {"w": np.arange(64, dtype=np.float32).reshape(16, 4),
+              "b": np.ones(16, np.float32),
+              "scale": np.float32(0.5)}
+    specs = {"w": P("dp", None), "b": P("dp"), "scale": P()}
+    return params, specs
+
+
+class TestRoundTrip:
+    def test_sharded_roundtrip_and_manifest(self, tmp_path):
+        params, specs = _state()
+        with CheckpointManager(str(tmp_path), sharded=True,
+                               param_specs=specs,
+                               axis_sizes={"dp": 4}) as mgr:
+            assert mgr.save(5, params, extra={"epoch": 2})
+        man = json.load(open(tmp_path / "step_5" / "manifest.json"))
+        assert man["format"] == 2
+        assert man["mesh"]["axes"] == {"dp": 4}
+        w = [l for l in man["trees"]["params"]["leaves"]
+             if l["key"] == "w"][0]
+        assert w["grid"] == [4, 1] and len(w["shards"]) == 4
+        assert all("crc32c" in s for s in w["shards"])
+        ck = restore_checkpoint(str(tmp_path))
+        assert ck.step == 5 and ck.extra == {"epoch": 2}
+        _tree_eq(ck.params, params)
+
+    def test_restore_reshards_to_any_world(self, tmp_path):
+        params, specs = _state()
+        with CheckpointManager(str(tmp_path), sharded=True,
+                               param_specs=specs,
+                               axis_sizes={"dp": 4}) as mgr:
+            mgr.save(1, params)
+        for m in (1, 2, 8):
+            t = Target(specs={"params": specs}, axis_sizes={"dp": m},
+                       coords={"dp": m - 1})
+            ck = restore_sharded(str(tmp_path), target=t)
+            lo, hi = 16 // m * (m - 1), 16 // m * m
+            np.testing.assert_array_equal(ck.params["w"],
+                                          params["w"][lo:hi])
+            np.testing.assert_array_equal(ck.params["b"],
+                                          params["b"][lo:hi])
+            np.testing.assert_array_equal(ck.params["scale"],
+                                          params["scale"])
+
+    def test_slice_restore_reads_only_needed_shards(self, tmp_path):
+        """The resharding contract: a host restoring its dp=2 slice reads
+        the saved members that overlap it and nothing else (half the
+        sharded bytes + the replicated scalar)."""
+        params, specs = _state()
+        with CheckpointManager(str(tmp_path), sharded=True,
+                               param_specs=specs,
+                               axis_sizes={"dp": 4}) as mgr:
+            mgr.save(1, params)
+        full = ReadStats()
+        restore_sharded(str(tmp_path), stats=full)
+        half = ReadStats()
+        restore_sharded(
+            str(tmp_path), stats=half,
+            target=Target(specs={"params": specs}, axis_sizes={"dp": 2},
+                          coords={"dp": 0}))
+        sharded_bytes = (params["w"].nbytes + params["b"].nbytes)
+        assert full.bytes == sharded_bytes + params["scale"].nbytes
+        assert half.bytes == sharded_bytes // 2 + params["scale"].nbytes
+        assert half.members == 2 * 2 + 1  # 2 of 4 shards each + scalar
+
+    def test_bfloat16_leaves_shard_and_reshard(self, tmp_path):
+        params = {"w": jnp.arange(32, dtype=jnp.bfloat16).reshape(8, 4)}
+        specs = {"w": P("dp", None)}
+        with CheckpointManager(str(tmp_path), sharded=True,
+                               param_specs=specs,
+                               axis_sizes={"dp": 4}) as mgr:
+            mgr.save(1, params)
+        ck = restore_checkpoint(str(tmp_path), like_params=params)
+        assert ck.params["w"].dtype == jnp.bfloat16
+        _tree_eq(ck.params, params)
+        t = Target(specs={"params": specs}, axis_sizes={"dp": 2},
+                   coords={"dp": 1})
+        half = restore_sharded(str(tmp_path), target=t)
+        _tree_eq({"w": half.params["w"]},
+                 {"w": np.asarray(params["w"])[4:8]})
+
+    def test_single_controller_writes_all_shards_one_file(self, tmp_path):
+        params, specs = _state()
+        with CheckpointManager(str(tmp_path), sharded=True,
+                               param_specs=specs,
+                               axis_sizes={"dp": 4}) as mgr:
+            mgr.save(1, params)
+        names = set(os.listdir(tmp_path / "step_1"))
+        assert names == {"manifest.json", "manifest_r0.json",
+                         "shard_r0.npz"}
+        with zipfile.ZipFile(tmp_path / "step_1" / "shard_r0.npz") as z:
+            # 4+4 sharded pieces + 1 replicated scalar
+            assert len(z.namelist()) == 9
+
+
+# ---------------------------------------------------------------------------
+# typed failures + events
+# ---------------------------------------------------------------------------
+
+class TestTypedFailures:
+    def _saved(self, tmp_path):
+        params, specs = _state()
+        with CheckpointManager(str(tmp_path), sharded=True,
+                               param_specs=specs,
+                               axis_sizes={"dp": 4}) as mgr:
+            mgr.save(3, params)
+        return params, specs
+
+    def test_corrupt_shard_is_typed_with_attribution(self, tmp_path):
+        self._saved(tmp_path)
+        npz = tmp_path / "step_3" / "shard_r0.npz"
+        info = zipfile.ZipFile(npz).infolist()[0]
+        raw = bytearray(npz.read_bytes())
+        off = info.header_offset + 30 + len(info.filename) + 80
+        raw[off] ^= 0x01
+        npz.write_bytes(bytes(raw))
+        with pytest.raises(CkptCorrupt) as ei:
+            restore_sharded(str(tmp_path))
+        assert ei.value.step == 3
+        assert "shard_r0.npz" in ei.value.shard
+
+    def test_truncated_manifest_is_incomplete(self, tmp_path):
+        self._saved(tmp_path)
+        mpath = tmp_path / "step_3" / "manifest.json"
+        mpath.write_text(mpath.read_text()[:100])
+        with pytest.raises(CkptIncomplete) as ei:
+            restore_checkpoint(str(tmp_path))
+        assert ei.value.step == 3
+
+    def test_missing_shard_file_is_incomplete(self, tmp_path):
+        self._saved(tmp_path)
+        os.remove(tmp_path / "step_3" / "shard_r0.npz")
+        with pytest.raises(CkptIncomplete) as ei:
+            restore_sharded(str(tmp_path))
+        assert "shard_r0.npz" in str(ei.value)
+
+    def test_template_mismatch_is_shape_mismatch(self, tmp_path):
+        self._saved(tmp_path)
+        with pytest.raises(CkptShapeMismatch):
+            restore_sharded(str(tmp_path),
+                            like_params={"only": np.zeros(1)})
+
+    def test_format1_dir_rejected_by_sharded_door(self, tmp_path):
+        save_checkpoint(str(tmp_path), 1, {"w": np.ones(2, np.float32)})
+        with pytest.raises(CkptError):
+            restore_sharded(str(tmp_path))
+
+    def test_save_restore_events_in_metrics_stream(self, tmp_path,
+                                                   monkeypatch):
+        log = tmp_path / "metrics.jsonl"
+        monkeypatch.setenv("DPX_METRICS_LOG", str(log))
+        params, specs = _state()
+        with CheckpointManager(str(tmp_path / "ck"), sharded=True,
+                               async_save=True, param_specs=specs,
+                               axis_sizes={"dp": 4}) as mgr:
+            mgr.save(1, params)
+        restore_checkpoint(str(tmp_path / "ck"))
+        events = [json.loads(l) for l in open(log)]
+        saves = [e for e in events if e["event"] == "ckpt_save"]
+        restores = [e for e in events if e["event"] == "ckpt_restore"]
+        assert saves and restores
+        assert saves[0]["step"] == 1 and saves[0]["sharded"] is True
+        assert saves[0]["async_save"] is True and saves[0]["bytes"] > 0
+        assert saves[0]["shards"] == 9
+        assert restores[0]["saved_axes"] == {"dp": 4}
+
+
+# ---------------------------------------------------------------------------
+# async: no collectives off the main thread, no degrade under host comm
+# ---------------------------------------------------------------------------
+
+class TestAsyncContract:
+    def test_io_off_thread_barriers_on_control_thread(self, tmp_path):
+        params, specs = _state()
+        with CheckpointManager(str(tmp_path), sharded=True,
+                               async_save=True, param_specs=specs,
+                               axis_sizes={"dp": 4}) as mgr:
+            mgr.save(1, params)
+            assert mgr._pending is not None  # commit deferred, not sync
+            mgr.save(2, params)
+        phases = trace_log()
+        assert {th for ph, th in phases if ph == "io"} == {"ckpt-io"}
+        assert all(th == "MainThread" for ph, th in phases
+                   if ph in ("barrier", "commit", "d2h"))
+
+    def test_barrier_off_control_thread_is_typed_error(self, tmp_path):
+        _, specs = _state()
+        mgr = CheckpointManager(str(tmp_path), sharded=True,
+                                param_specs=specs, axis_sizes={"dp": 4})
+        caught = []
+
+        def off_thread():
+            try:
+                mgr._barrier()
+            except BaseException as e:
+                caught.append(e)
+        t = threading.Thread(target=off_thread)
+        t.start()
+        t.join()
+        assert len(caught) == 1 and isinstance(caught[0], CkptError)
+        assert "control thread" in str(caught[0])
+
+    def test_async_does_not_degrade_under_host_front_door(self, tmp_path,
+                                                          monkeypatch):
+        """The old manager silently ran sync whenever a host process
+        group was live; the staged path runs its IO on the background
+        thread and defers the commit even with a live HostComm."""
+        from distributed_pytorch_tpu.runtime.launcher import find_free_port
+        monkeypatch.setenv("DPX_MASTER_PORT", str(find_free_port()))
+        dist.init_process_group(0, 1, backend="host")
+        assert context.get_host_comm() is not None
+        params, specs = _state()
+        try:
+            mgr = CheckpointManager(str(tmp_path), sharded=True,
+                                    async_save=True, param_specs=specs,
+                                    axis_sizes={"dp": 4})
+            assert mgr.save(1, params)
+            assert mgr._pending is not None   # not degraded to sync
+            mgr.wait()
+            assert {th for ph, th in trace_log() if ph == "io"} \
+                == {"ckpt-io"}
+            ck = restore_checkpoint(str(tmp_path))
+            _tree_eq(ck.params, params)
+        finally:
+            dist.cleanup()
+
+    def test_async_io_error_surfaces_and_never_commits(self, tmp_path,
+                                                       monkeypatch):
+        params, specs = _state()
+        from distributed_pytorch_tpu.ckpt import writer as w
+
+        def boom(*a, **k):
+            raise OSError("disk full")
+        monkeypatch.setattr(w, "write_shards", boom)
+        mgr = CheckpointManager(str(tmp_path), sharded=True,
+                                async_save=True, param_specs=specs,
+                                axis_sizes={"dp": 4})
+        mgr.save(1, params)
+        with pytest.raises(OSError, match="disk full"):
+            mgr.wait()
+        assert available_steps(str(tmp_path)) == []  # nothing committed
+
+
+# ---------------------------------------------------------------------------
+# fault injection: kill in the commit window (satellite)
+# ---------------------------------------------------------------------------
+
+def _commit_window_kill_worker(workdir: str, resave_same_step: bool):
+    """Spawn child: commit step 1, then die between the two renames of
+    the next commit (re-save of step 1, or fresh step 2)."""
+    import jax as _jax
+    _jax.config.update("jax_platforms", "cpu")
+    import numpy as _np
+    from jax.sharding import PartitionSpec as _P
+
+    from distributed_pytorch_tpu.ckpt import CheckpointManager as _M
+    from distributed_pytorch_tpu.runtime import faults as _faults
+
+    params = {"w": _np.arange(8, dtype=_np.float32)}
+    specs = {"w": _P("dp")}
+    mgr = _M(workdir, sharded=True, param_specs=specs,
+             axis_sizes={"dp": 4})
+    mgr.save(1, params)
+    # op-call counters only advance while specs are installed, so the
+    # NEXT commit's window is call=1
+    _faults.install("kill@op=ckpt_commit_window,call=1")
+    if resave_same_step:
+        mgr.save(1, {"w": params["w"] + 100}, force=True)
+    else:
+        mgr.save(2, {"w": params["w"] + 100})
+    os._exit(7)  # must never get here: the fault fires first
+
+
+class TestCommitWindowKill:
+    @pytest.mark.parametrize("resave", [True, False],
+                             ids=["resave-same-step", "new-step"])
+    def test_kill_between_renames_leaves_previous_step_restorable(
+            self, tmp_path, resave):
+        ctx = mp.get_context("spawn")
+        p = ctx.Process(target=_commit_window_kill_worker,
+                        args=(str(tmp_path), resave))
+        p.start()
+        p.join(120)
+        assert p.exitcode == faults.KILL_EXIT_CODE
+        # step 1's first commit must still be complete and restorable
+        assert 1 in available_steps(str(tmp_path))
+        ck = restore_checkpoint(str(tmp_path), step=1)
+        np.testing.assert_array_equal(
+            ck.params["w"], np.arange(8, dtype=np.float32))
+        if resave:
+            # killed inside the window: the live dir was renamed aside,
+            # so step 1 survives only as its .old crash-window form —
+            # which discovery resolved above
+            assert not (tmp_path / "step_1" / "manifest.json").exists()
+            assert any(n.startswith("step_1.old.")
+                       for n in os.listdir(tmp_path))
+        else:
+            # the new step never became visible
+            assert available_steps(str(tmp_path)) == [1]
+        # a later save supersedes the crash window cleanly
+        save_checkpoint(str(tmp_path), 1,
+                        {"w": np.full(8, 5.0, np.float32)})
+        np.testing.assert_array_equal(
+            restore_checkpoint(str(tmp_path), step=1).params["w"],
+            np.full(8, 5.0, np.float32))
+        assert not any(".old." in n or ".tmp." in n
+                       for n in os.listdir(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# the acceptance property: world-4 -> {4, 2, 1} resume
+# ---------------------------------------------------------------------------
+
+STEPS, CUT = 4, 2
+
+
+def _lm_setup(world):
+    dist.init_process_group(rank=0, world_size=world)
+    mesh = context.get_mesh()
+    model = models.TransformerLM(vocab=64, dim=32, n_layers=2, n_heads=4,
+                                 max_seq=16)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return cross_entropy_per_example(model.apply(p, x), y).mean(), {}
+
+    opt = optim.adamw(1e-2)
+    p_host = model.init(jax.random.PRNGKey(0))
+    return mesh, model, loss_fn, opt, p_host
+
+
+def _lm_batches(n=STEPS):
+    rng = np.random.default_rng(11)
+    return [(rng.integers(0, 64, size=(8, 16)).astype(np.int32),
+             rng.integers(0, 64, size=(8, 16)).astype(np.int32))
+            for _ in range(n)]
+
+
+class TestReshardResume:
+    def _reference(self):
+        """Uninterrupted world-4 FSDP run: per-step losses + final."""
+        mesh, model, loss_fn, opt, p_host = _lm_setup(4)
+        specs = fsdp_param_specs(p_host, 4, min_size=128)
+        step = make_fsdp_train_step(loss_fn, opt, mesh, specs,
+                                    donate=False)
+        params, st = shard_model_and_opt(p_host, opt.init(p_host), mesh,
+                                         specs)
+        losses = []
+        for b in _lm_batches():
+            params, st, loss, _ = step(params, st, dist.shard_batch(b))
+            losses.append(np.asarray(loss))
+        final = jax.tree_util.tree_map(np.asarray, params)
+        dist.cleanup()
+        return losses, final
+
+    def _run_and_save(self, ckpt_dir):
+        """World-4 run checkpointing (sharded) at step CUT."""
+        mesh, model, loss_fn, opt, p_host = _lm_setup(4)
+        specs = fsdp_param_specs(p_host, 4, min_size=128)
+        step = make_fsdp_train_step(loss_fn, opt, mesh, specs,
+                                    donate=False)
+        params, st = shard_model_and_opt(p_host, opt.init(p_host), mesh,
+                                         specs)
+        mgr = CheckpointManager(ckpt_dir, sharded=True, async_save=True,
+                                param_specs=specs, axis_sizes={"dp": 4})
+        for i, b in enumerate(_lm_batches()[:CUT]):
+            params, st, loss, _ = step(params, st, dist.shard_batch(b))
+            mgr.save(i + 1, params, st, force=(i + 1 == CUT))
+        mgr.wait()
+        dist.cleanup()
+
+    def _resume(self, ckpt_dir, world):
+        """Restore (resharding onto ``world``) and finish the run."""
+        mesh, model, loss_fn, opt, p_host = _lm_setup(world)
+        st_host = opt.init(p_host)
+        ck = restore_checkpoint(ckpt_dir, like_params=p_host,
+                                like_opt_state=st_host)
+        assert ck.step == CUT
+        losses = []
+        if world > 1:
+            specs = fsdp_param_specs(p_host, world, min_size=128)
+            step = make_fsdp_train_step(loss_fn, opt, mesh, specs,
+                                        donate=False)
+            params, st = shard_model_and_opt(ck.params, ck.opt_state,
+                                             mesh, specs)
+        else:
+            step = make_train_step(loss_fn, opt, donate=False)
+            params, st = ck.params, ck.opt_state
+        for b in _lm_batches()[CUT:]:
+            params, st, loss, _ = step(params, st, dist.shard_batch(b))
+            losses.append(np.asarray(loss))
+        final = jax.tree_util.tree_map(np.asarray, params)
+        dist.cleanup()
+        return losses, final
+
+    def test_world4_ckpt_resumes_on_4_2_1(self, tmp_path):
+        ref_losses, ref_final = self._reference()
+        self._run_and_save(str(tmp_path))
+
+        # world 4 -> world 4: bit-exact continuation
+        losses4, final4 = self._resume(str(tmp_path), 4)
+        for got, want in zip(losses4, ref_losses[CUT:]):
+            np.testing.assert_array_equal(got, want)
+        _tree_eq(final4, ref_final)
+
+        # world 4 -> world 2 and world 1: loss-correct (reduction order
+        # differs across mesh sizes; the trajectory must agree to float
+        # tolerance). Params get a looser sanity bound: AdamW divides by
+        # sqrt(nu), which amplifies ulp-level reduction noise early in
+        # training — the loss trajectory is the correctness criterion.
+        for world in (2, 1):
+            losses, final = self._resume(str(tmp_path), world)
+            for got, want in zip(losses, ref_losses[CUT:]):
+                np.testing.assert_allclose(got, want, rtol=1e-4,
+                                           atol=1e-5)
+            for a, b in zip(jax.tree_util.tree_leaves(final),
+                            jax.tree_util.tree_leaves(ref_final)):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-2, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# elastic: kill -> shrink -> resume, end to end
+# ---------------------------------------------------------------------------
+
+E_STEPS, E_CUT = 6, 3
+
+
+def _elastic_shrink_worker(workdir: str, world: int):
+    """Module-level (spawn-picklable) worker: FSDP-style sharded training
+    at ``world`` with sharded checkpoints; resumes (resharding) from the
+    latest checkpoint. DPX_FAULT kills attempt 0 mid-run."""
+    import jax as _jax
+    import numpy as _np
+
+    import distributed_pytorch_tpu as _dist
+    from distributed_pytorch_tpu import models as _models
+    from distributed_pytorch_tpu import optim as _optim
+    from distributed_pytorch_tpu.ckpt import CheckpointManager as _M
+    from distributed_pytorch_tpu.ops.losses import cross_entropy
+    from distributed_pytorch_tpu.parallel import (
+        fsdp_param_specs as _specs_fn,
+        make_fsdp_train_step as _mk_step,
+        shard_model_and_opt as _place)
+    from distributed_pytorch_tpu.runtime import context as _ctx
+    from distributed_pytorch_tpu.runtime import faults as _faults
+    from distributed_pytorch_tpu.utils.checkpoint import (
+        latest_step as _latest, restore_checkpoint as _restore)
+
+    _dist.init_process_group(rank=0, world_size=world)
+    mesh = _ctx.get_mesh()
+    model = _models.DummyModel(in_dim=1, hidden_dim=8, n_classes=4)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return cross_entropy(model.apply(p, x), y), {}
+
+    opt = _optim.adamw(1e-2)
+    p_host = model.init(_jax.random.PRNGKey(0))
+    st_host = opt.init(p_host)
+    start = 0
+    if _latest(workdir) is not None:
+        ck = _restore(workdir, like_params=p_host, like_opt_state=st_host)
+        p_host, st_host, start = ck.params, ck.opt_state, ck.step
+    specs = _specs_fn(p_host, world, min_size=4)
+    params, st = _place(p_host, st_host, mesh, specs)
+    step_fn = _mk_step(loss_fn, opt, mesh, specs, donate=False)
+    mgr = _M(workdir, interval=1, keep=3, sharded=True,
+             param_specs=specs)
+
+    rng = _np.random.default_rng(7)
+    batches = [(rng.random((8, 1), dtype=_np.float32),
+                rng.integers(0, 4, size=(8,)).astype(_np.int32))
+               for _ in range(E_STEPS)]
+    for s in range(start, E_STEPS):
+        _faults.on_step(s, rank=0)
+        params, st, loss, _ = step_fn(params, st,
+                                      _dist.shard_batch(batches[s]))
+        mgr.save(s + 1, params, st)
+    mgr.wait()
+    final = _jax.tree_util.tree_map(_np.asarray, params)
+    _np.savez(os.path.join(workdir, f"final_w{world}.npz"),
+              **{f"p{i}": l for i, l in
+                 enumerate(_jax.tree_util.tree_leaves(final))})
+    _dist.cleanup()
+
+
+def _final(workdir, world):
+    z = np.load(os.path.join(workdir, f"final_w{world}.npz"))
+    return [z[k] for k in sorted(z.files)]
+
+
+def test_elastic_kill_shrink_resume(tmp_path):
+    """Attempt 0 trains at world 4 and is hard-killed mid-run; the
+    supervisor relaunches at world 2 (reconfigure hook); the relaunch
+    restores the world-4 sharded checkpoint RESHARDED onto world 2 and
+    finishes. Final params match a reference that executed the same
+    4-then-2 schedule without any failure."""
+    crashed = tmp_path / "crashed"
+    os.makedirs(crashed)
+    worlds_seen = []
+
+    def shrink(attempt, exitcode, args):
+        assert exitcode == faults.KILL_EXIT_CODE
+        workdir, world = args
+        worlds_seen.append(world)
+        return (workdir, max(world // 2, 1))
+
+    res = elastic.elastic_run(
+        _elastic_shrink_worker, (str(crashed), 4), max_restarts=2,
+        backoff_s=0.01, reconfigure=shrink,
+        env={"DPX_PLATFORM": "cpu", "DPX_CPU_DEVICES": "8",
+             "DPX_FAULT": f"kill@step={E_CUT},attempt=0"})
+    assert res.restarts == 1
+    assert res.exitcodes == (faults.KILL_EXIT_CODE, 0)
+    assert worlds_seen == [4]              # reconfigured exactly once
+    assert os.path.exists(crashed / "final_w2.npz")  # finished shrunk
+
+    # in-process reference executing the same 4 -> 2 schedule, failure-free
+    ref = tmp_path / "ref"
+    os.makedirs(ref)
+    _elastic_ref_schedule(str(ref))
+    for a, b in zip(_final(crashed, 2), _final(str(ref), 2)):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def _elastic_ref_schedule(workdir: str):
+    """The same train-4-steps-at-world-4 / finish-at-world-2 schedule the
+    elastic test executes, in process, with no failures: steps 0..E_CUT-1
+    at dp=4 (checkpointing each step), then restore resharded at dp=2 and
+    finish."""
+    from distributed_pytorch_tpu.ops.losses import cross_entropy
+
+    model = models.DummyModel(in_dim=1, hidden_dim=8, n_classes=4)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return cross_entropy(model.apply(p, x), y), {}
+
+    opt = optim.adamw(1e-2)
+    rng = np.random.default_rng(7)
+    batches = [(rng.random((8, 1), dtype=np.float32),
+                rng.integers(0, 4, size=(8,)).astype(np.int32))
+               for _ in range(E_STEPS)]
+
+    # phase 1: world 4, steps 0..E_CUT-1
+    dist.init_process_group(rank=0, world_size=4)
+    mesh = context.get_mesh()
+    p_host = model.init(jax.random.PRNGKey(0))
+    specs = fsdp_param_specs(p_host, 4, min_size=4)
+    step_fn = make_fsdp_train_step(loss_fn, opt, mesh, specs,
+                                   donate=False)
+    params, st = shard_model_and_opt(p_host, opt.init(p_host), mesh,
+                                     specs)
+    mgr = CheckpointManager(workdir, interval=1, keep=3, sharded=True,
+                            param_specs=specs)
+    for s in range(E_CUT):
+        params, st, loss, _ = step_fn(params, st,
+                                      dist.shard_batch(batches[s]))
+        mgr.save(s + 1, params, st)
+    mgr.wait()
+    dist.cleanup()
+
+    # phase 2: world 2, resharded restore, steps E_CUT..E_STEPS-1
+    dist.init_process_group(rank=0, world_size=2)
+    mesh = context.get_mesh()
+    p_host = model.init(jax.random.PRNGKey(0))
+    st_host = opt.init(p_host)
+    ck = restore_checkpoint(workdir, like_params=p_host,
+                            like_opt_state=st_host)
+    assert ck.step == E_CUT
+    specs = fsdp_param_specs(p_host, 2, min_size=4)
+    step_fn = make_fsdp_train_step(loss_fn, opt, mesh, specs,
+                                   donate=False)
+    params, st = shard_model_and_opt(ck.params, ck.opt_state, mesh,
+                                     specs)
+    for s in range(E_CUT, E_STEPS):
+        params, st, loss, _ = step_fn(params, st,
+                                      dist.shard_batch(batches[s]))
+    final = jax.tree_util.tree_map(np.asarray, params)
+    np.savez(os.path.join(workdir, "final_w2.npz"),
+             **{f"p{i}": l for i, l in
+                enumerate(jax.tree_util.tree_leaves(final))})
+    dist.cleanup()
