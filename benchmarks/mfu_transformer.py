@@ -299,7 +299,8 @@ def sweep(arms=None, steps: int = 20,
     arm and keeps collecting if the tunnel recovers (probe gate aborts
     early when it doesn't, leaving the per-arm records)."""
     if isolate is None:
-        isolate = os.environ.get("JAX_PLATFORMS", "") != "cpu"
+        from distributed_pytorch_tpu.runtime import env as _envreg
+        isolate = (_envreg.get("JAX_PLATFORMS") or "") != "cpu"
     if arms is None:
         arms = [dict(batch=8), dict(batch=8, fused_ce=True),
                 dict(batch=8, fused_ce=True, master_f32=True),

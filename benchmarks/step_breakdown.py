@@ -223,7 +223,9 @@ def run_comm(world=8, hidden=1024, in_dim=256, batch_per_rank=8,
 
     jax.config.update("jax_platforms", "cpu")
     ensure_cpu_devices(world)
-    os.environ.setdefault("DPX_CPU_DEVICES", str(world))
+    from distributed_pytorch_tpu.runtime import env as _envreg
+    if _envreg.raw("DPX_CPU_DEVICES") is None:
+        _envreg.set("DPX_CPU_DEVICES", world)
 
     import distributed_pytorch_tpu as dist
     from distributed_pytorch_tpu import models, optim

@@ -1,0 +1,21 @@
+"""Static + runtime correctness tooling for the comm core (PR 5).
+
+Three legs (docs/analysis.md):
+
+* :mod:`.lint` — **dpxlint**, an AST-based checker enforcing the
+  repo-wide invariants PRs 2-4 accumulated (collectives stay on the
+  control thread, env reads go through the typed registry, blocking
+  calls carry deadlines, typed errors carry attribution, threads are
+  named). CLI: ``python -m tools.dpxlint``.
+* :mod:`.schedule` — the collective-schedule verifier: static extraction
+  of per-front-door collective sequences, plus the cheap always-on
+  runtime recorder whose per-rank rolling digests turn a mismatched
+  collective from a bare ``CommTimeout`` into "rank 2 issued all_gather
+  where ranks 0,1,3 issued all_reduce at seq 417".
+* Sanitizer wiring lives in ``native/Makefile`` (``make asan`` /
+  ``make tsan``) + the ``DPX_NATIVE_LIB`` override in
+  :mod:`..runtime.native`, not in Python.
+"""
+
+from .schedule import (DivergenceReport, RankSchedule,  # noqa: F401
+                       diagnose, diagnose_log, extract_schedules)

@@ -1,0 +1,353 @@
+"""Collective-schedule verifier: who issued what, in which order.
+
+The deadliest distributed bug class this repo can have is a *mismatched
+collective schedule*: one rank's control flow takes a branch the others
+don't, it issues a different collective (or none), and the world
+deadlocks until ``DPX_COMM_TIMEOUT_MS`` turns it into a bare
+``CommTimeout`` that names no call site. The MPI world solved this with
+schedule verification (MUST, MPI-Checker — PAPERS.md); this module is
+the dpx equivalent, in two halves:
+
+**Runtime half (always on, ~a string format + one hash fold per op).**
+Every :class:`~..runtime.native.HostComm` collective calls
+:meth:`RankSchedule.record` with the op's signature ``(op, dtype, size,
+extra)``. The recorder keeps a monotone sequence number, folds each
+signature into a rolling 64-bit FNV-1a digest, and retains the last
+``DPX_SCHEDULE_WINDOW`` records. When an op fails, the comm layer calls
+:meth:`RankSchedule.flush`, which appends one ``comm_schedule``
+line-JSON event (rank, seq, digest, recent window) to the existing
+``DPX_METRICS_LOG`` stream — the same multi-writer-safe channel the
+failure events already ride. :func:`diagnose` then joins all ranks'
+events and names the first sequence number where the ranks disagree,
+the minority rank(s), and both ops. The supervisor
+(:func:`..runtime.multiprocess.launch_multiprocess`) runs it
+automatically on worker failure and logs a ``schedule_divergence``
+event, so the report lands *alongside* the typed ``CommTimeout`` with
+zero operator action.
+
+**Static half.** :func:`extract_schedules` parses the comm front doors'
+source (AST, no import) and returns, per public collective function,
+the sequence of native ops its body can issue. Uses: the front-door
+parity check (both front doors must expose the same collective surface;
+every issued op must be in the native vocabulary) is a tier-1 test, and
+the extraction is the ground truth dpxlint's DPX001 rule shares for
+"what is a collective call".
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+
+def _envreg():
+    # lazy: this module must import with NOTHING but stdlib available —
+    # the dpxlint CLI loads it in a bare CI job where jax (pulled in by
+    # the package __init__ chain) is absent
+    from ..runtime import env
+    return env
+
+
+#: Native collective vocabulary — the ops `HostComm` can issue (what the
+#: runtime recorder sees). `_pre_op` names, not Python method names.
+NATIVE_OPS = ("allreduce", "allreduce_q8", "reduce", "gather", "broadcast",
+              "barrier")
+
+#: HostComm methods composed FROM native ops: calling one issues the
+#: listed primitive sequence (what the runtime recorder will see).
+COMPOSITE_OPS = {"all_gather": ["gather", "broadcast"]}
+
+#: Public collective surface every comm front door must expose (the
+#: reference's §2.1 names + the all_gather extension).
+FRONT_DOOR_SURFACE = ("all_reduce", "reduce", "gather", "all_gather",
+                      "broadcast", "sync_params", "barrier")
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK64 = (1 << 64) - 1
+
+
+def _fold(digest: int, text: str) -> int:
+    for b in text.encode():
+        digest = ((digest ^ b) * _FNV_PRIME) & _MASK64
+    return digest
+
+
+# ---------------------------------------------------------------------------
+# Runtime recorder
+# ---------------------------------------------------------------------------
+
+class RankSchedule:
+    """Per-rank issued-collective recorder (one per ``HostComm``).
+
+    Cheap enough to be always on: one f-string and one 64-bit hash fold
+    per collective — noise next to a TCP round trip. ``window`` bounds
+    memory; 0 (via ``DPX_SCHEDULE_WINDOW=0``) disables retention but
+    keeps the digest."""
+
+    def __init__(self, rank: int, world: int,
+                 window: Optional[int] = None):
+        if window is None:
+            window = max(int(_envreg().get("DPX_SCHEDULE_WINDOW")), 0)
+        self.rank = rank
+        self.world = world
+        self.seq = 0
+        self.digest = _FNV_OFFSET
+        self.window: Deque[Tuple[int, str]] = collections.deque(
+            maxlen=window or None) if window else collections.deque(
+            maxlen=1)
+        self._enabled = window > 0
+        self._flushed_seq = -1
+
+    def record(self, op: str, *, dtype: str = "", size: int = 0,
+               extra: str = "") -> None:
+        self.seq += 1
+        sig = f"{op}|{dtype}|{size}|{extra}"
+        self.digest = _fold(_fold(self.digest, sig), str(self.seq))
+        if self._enabled:
+            self.window.append((self.seq, sig))
+
+    def digest_hex(self) -> str:
+        return f"{self.digest:016x}"
+
+    def flush(self, op: str = "", event: str = "comm_schedule") -> None:
+        """Append this rank's schedule tail to the line-JSON event log.
+
+        Called from the comm layer's failure path BEFORE the typed error
+        raises; must never mask that error, so every failure here is
+        swallowed. Idempotent per sequence point (a teardown that fails
+        several ops in a row flushes once)."""
+        if self.seq == self._flushed_seq:
+            return
+        self._flushed_seq = self.seq
+        try:
+            from ..utils.logging import append_event
+            # the launch tag discriminates runs: DPX_METRICS_LOG is a
+            # long-lived append-only stream, and seq restarts at 1 per
+            # comm — without the tag, a rank's flush from a PREVIOUS
+            # launch could be joined against this launch's schedules
+            append_event(event, rank=self.rank, world=self.world,
+                         seq=self.seq, digest=self.digest_hex(),
+                         failed_op=op,
+                         tag=_envreg().get("DPX_WORKER_TAG"),
+                         window=[[s, sig] for s, sig in self.window])
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Cross-rank divergence diagnosis
+# ---------------------------------------------------------------------------
+
+@dataclass
+class DivergenceReport:
+    """First cross-rank disagreement in the recorded schedules."""
+
+    seq: int                       # first diverging sequence number
+    minority_ranks: List[int]      # rank(s) issuing the odd op out
+    minority_op: str               # their full signature at `seq`
+    majority_ranks: List[int]
+    majority_op: str
+    digests: Dict[int, str] = field(default_factory=dict)
+
+    def __str__(self) -> str:
+        few = ",".join(str(r) for r in self.minority_ranks)
+        many = ",".join(str(r) for r in self.majority_ranks)
+        return (f"schedule divergence at seq {self.seq}: rank {few} "
+                f"issued {self.minority_op} where rank(s) {many} issued "
+                f"{self.majority_op}")
+
+
+def _schedule_events(events: Sequence[dict],
+                     tag: Optional[str]) -> List[dict]:
+    """``comm_schedule`` events of one launch. ``tag=None`` selects the
+    NEWEST launch in the stream (the last event's tag, by append order)
+    — the log is long-lived and a stale rank's flush from a previous
+    launch must never be joined against the current one. Malformed
+    events (the log is a shared multi-writer file) are skipped, never
+    raised on."""
+    sched = [e for e in events if isinstance(e, dict)
+             and e.get("event") == "comm_schedule"]
+    if tag is None and sched:
+        tag = sched[-1].get("tag")
+    return [e for e in sched if e.get("tag") == tag]
+
+
+def _entries_by_rank(events: Sequence[dict]) -> Dict[int, Dict[int, str]]:
+    by_rank: Dict[int, Dict[int, str]] = {}
+    for ev in events:
+        try:
+            rank = int(ev.get("rank", -1))
+            seqs = by_rank.setdefault(rank, {})
+            for seq, sig in ev.get("window", []):
+                seqs[int(seq)] = str(sig)
+        except (TypeError, ValueError):
+            continue  # foreign/damaged event in the shared stream
+    return by_rank
+
+
+def diagnose(events: Sequence[dict],
+             tag: Optional[str] = None) -> Optional[DivergenceReport]:
+    """Join ranks' ``comm_schedule`` events; name the first divergence.
+
+    ``tag`` restricts the join to one launch's events (the supervisor
+    passes its own tag; None = the newest launch in the stream).
+    Returns None when fewer than two ranks reported or every overlapping
+    sequence point agrees (then the failure was a death/stall, not a
+    mismatched schedule — the ``WorkerFailure`` attribution already
+    covers those)."""
+    sched = _schedule_events(events, tag)
+    by_rank = _entries_by_rank(sched)
+    if len(by_rank) < 2:
+        return None
+    digests: Dict[int, str] = {}
+    for e in sched:
+        try:
+            digests[int(e.get("rank", -1))] = str(e.get("digest", ""))
+        except (TypeError, ValueError):
+            continue
+    all_seqs = sorted({s for seqs in by_rank.values() for s in seqs})
+    for seq in all_seqs:
+        present = {r: seqs[seq] for r, seqs in by_rank.items()
+                   if seq in seqs}
+        if len(present) < 2:
+            continue
+        groups: Dict[str, List[int]] = {}
+        for r, sig in present.items():
+            groups.setdefault(sig, []).append(r)
+        if len(groups) == 1:
+            continue
+        ordered = sorted(groups.items(), key=lambda kv: len(kv[1]))
+        minority_sig, minority = ordered[0]
+        majority_sig, majority = ordered[-1]
+        return DivergenceReport(
+            seq=seq, minority_ranks=sorted(minority),
+            minority_op=minority_sig, majority_ranks=sorted(majority),
+            majority_op=majority_sig, digests=digests)
+    return None
+
+
+def diagnose_log(path: Optional[str] = None,
+                 tag: Optional[str] = None) -> Optional[DivergenceReport]:
+    """:func:`diagnose` over a line-JSON metrics log file (defaults to
+    ``$DPX_METRICS_LOG``). Unreadable/absent log → None."""
+    path = path or _envreg().get("DPX_METRICS_LOG")
+    if not path or not os.path.exists(path):
+        return None
+    events = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn line from a killed writer
+    except OSError:
+        return None
+    return diagnose(events, tag=tag)
+
+
+def report_divergence(path: Optional[str] = None,
+                      tag: Optional[str] = None) -> Optional[str]:
+    """Supervisor hook: diagnose the metrics log and, when a divergence
+    is found, append a ``schedule_divergence`` event naming rank/op/seq
+    (and return the human-readable report). None when no divergence.
+    ``tag`` scopes the join to the calling launch's own events."""
+    rep = diagnose_log(path, tag=tag)
+    if rep is None:
+        return None
+    try:
+        from ..utils.logging import append_event
+        append_event("schedule_divergence", path=path, seq=rep.seq,
+                     minority_ranks=rep.minority_ranks,
+                     minority_op=rep.minority_op,
+                     majority_ranks=rep.majority_ranks,
+                     majority_op=rep.majority_op)
+    except Exception:
+        pass
+    return str(rep)
+
+
+# ---------------------------------------------------------------------------
+# Static extraction
+# ---------------------------------------------------------------------------
+
+def _comm_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "comm")
+
+
+def extract_schedules(path: Optional[str] = None
+                      ) -> Dict[str, List[str]]:
+    """Per public function of a comm front-door module, the sequence of
+    native collective ops its body can issue, in source order.
+
+    Pure AST (the module is never imported): an "issue site" is a call
+    whose attribute name is one of :data:`NATIVE_OPS` on a ``comm``-like
+    receiver (``comm.allreduce(...)``, ``self.gather(...)``), or a call
+    to another extracted function of the same module (one level of
+    intra-module inlining — ``all_gather`` reports the ops of the
+    ``gather`` + ``broadcast`` it delegates to). Source order is the
+    *potential* schedule; branches contribute in order of appearance.
+    """
+    path = path or os.path.join(_comm_dir(), "host_backend.py")
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+
+    raw: Dict[str, List[Tuple[str, Optional[str]]]] = {}
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        sites: List[Tuple[str, Optional[str]]] = []
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if isinstance(fn, ast.Attribute) and fn.attr in NATIVE_OPS:
+                sites.append((fn.attr, None))
+            elif (isinstance(fn, ast.Attribute)
+                    and fn.attr in COMPOSITE_OPS):
+                for op in COMPOSITE_OPS[fn.attr]:
+                    sites.append((op, None))
+            elif isinstance(fn, ast.Name):
+                sites.append(("", fn.id))  # possible intra-module call
+        raw[node.name] = sites
+
+    out: Dict[str, List[str]] = {}
+    for name, sites in raw.items():
+        ops: List[str] = []
+        for op, callee in sites:
+            if op:
+                ops.append(op)
+            elif callee in raw and callee != name:
+                ops.extend(o for o, c in raw[callee] if o)
+        out[name] = ops
+    return out
+
+
+def check_front_door_parity() -> List[str]:
+    """Static front-door consistency: every FRONT_DOOR_SURFACE name must
+    exist in BOTH comm front doors (collectives.py and host_backend.py),
+    and every native op host_backend can issue must be in NATIVE_OPS.
+    Returns a list of violation strings (empty = consistent)."""
+    problems: List[str] = []
+    host = extract_schedules(os.path.join(_comm_dir(), "host_backend.py"))
+    spmd = extract_schedules(os.path.join(_comm_dir(), "collectives.py"))
+    for fn in FRONT_DOOR_SURFACE:
+        if fn not in host:
+            problems.append(f"host_backend.py missing front-door {fn}()")
+        if fn not in spmd:
+            problems.append(f"collectives.py missing front-door {fn}()")
+    for fn, ops in host.items():
+        for op in ops:
+            if op not in NATIVE_OPS:
+                problems.append(
+                    f"host_backend.{fn} issues unknown native op {op!r}")
+    return problems

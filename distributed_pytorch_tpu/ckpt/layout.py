@@ -59,6 +59,7 @@ def dim_partitions(spec, shape: Sequence[int],
             # typed: a reshard target (or save spec) that doesn't fit the
             # shapes is the CkptShapeMismatch contract, not a bare
             # ValueError — supervisors catch CkptError to fall back
+            # dpxlint: disable=DPX004 planning-time error on the calling rank; no shard exists yet
             raise CkptShapeMismatch(
                 f"dim {d} of shape {tuple(shape)} not divisible by "
                 f"{parts} (spec {spec!r}, axes {axis_sizes})")
@@ -182,6 +183,7 @@ def local_slices(shape: Sequence[int], spec, axis_sizes: Dict[str, int],
                     # a stale rank from the pre-shrink topology must be a
                     # typed error, never a silent modulo wrap onto some
                     # other host's shard
+                    # dpxlint: disable=DPX004 planning-time error on the calling rank; no shard exists yet
                     raise CkptShapeMismatch(
                         f"coordinate {coord} out of range for mesh axis "
                         f"{ax!r} of size {ax_size}")

@@ -152,7 +152,7 @@ class CheckpointManager:
             raise CkptError(
                 "checkpoint collective (barrier) attempted off the "
                 "manager's control thread — async IO threads must never "
-                "run collectives")
+                "run collectives", rank=self._topo()[0])
         _mark("barrier")
         from ..comm.collectives import barrier
         barrier()
@@ -266,6 +266,7 @@ class CheckpointManager:
 
     def _join_io(self) -> None:
         if self._thread is not None:
+            # dpxlint: disable=DPX003 IO join IS the durability sync point; a deadline would turn committed-means-durable into a race
             self._thread.join()
             self._thread = None
         if self._error is not None:

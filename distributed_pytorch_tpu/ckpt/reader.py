@@ -126,6 +126,7 @@ def _leaf_spec_from_tree(specs, n_leaves: int):
     leaves = jax.tree_util.tree_leaves(
         specs, is_leaf=lambda s: s is None or isinstance(s, PartitionSpec))
     if len(leaves) != n_leaves:
+        # dpxlint: disable=DPX004 template/spec disagreement predates any shard read; nothing to attribute
         raise CkptShapeMismatch(
             f"target spec tree has {len(leaves)} leaves, checkpoint tree "
             f"has {n_leaves}")

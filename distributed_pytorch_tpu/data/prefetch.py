@@ -80,6 +80,7 @@ def device_prefetch(iterable: Iterable, size: int = 2,
 
     try:
         while True:
+            # dpxlint: disable=DPX003 producer is in-process and always lands _STOP or the exception before exiting
             item = q.get()
             if item is _STOP:
                 return

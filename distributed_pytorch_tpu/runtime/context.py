@@ -35,11 +35,12 @@ on top of the same context.
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Any, Optional, Sequence
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from . import env
 
 DATA_AXIS = "dp"
 TENSOR_AXIS = "tp"
@@ -92,13 +93,13 @@ def visible_devices() -> list:
     all_devices = list(jax.devices())
     platform = jax.default_backend()
     if platform == "cpu":
-        forced = os.environ.get(CPU_DEVICES_ENV)
+        forced = env.raw(CPU_DEVICES_ENV)
         if forced is None:
             return []
         if forced.strip().lower() == "all":
             return all_devices
         return all_devices[: int(forced)]
-    spec = os.environ.get(VISIBLE_DEVICES_ENV)
+    spec = env.raw(VISIBLE_DEVICES_ENV)
     if spec is None or spec.strip() == "":
         return all_devices
     picked = []
@@ -145,15 +146,18 @@ def init_process_group(rank: int, world_size: int, backend: Optional[str] = None
     the NATIVE TCP process group (native/dpxhost.cpp), the gloo/c10d
     equivalent.
     """
-    if backend is None and os.environ.get("DPX_BACKEND") == "host":
+    if backend is None and env.get("DPX_BACKEND") == "host":
         backend = "host"
     if backend == "host":
         from .native import HostComm
 
-        comm = HostComm(
-            os.environ.get("DPX_MASTER_ADDR", "127.0.0.1"),
-            int(os.environ["DPX_MASTER_PORT"]),
-            rank, world_size)
+        port_raw = env.raw("DPX_MASTER_PORT")
+        if port_raw is None:
+            raise KeyError("DPX_MASTER_PORT")  # host workers must be told
+        # parse here, not via env.get: a malformed port must raise naming
+        # the bad literal, not silently fall back to the unset default
+        comm = HostComm(env.get("DPX_MASTER_ADDR"), int(port_raw),
+                        rank, world_size)
         _state.initialized = True
         _state.world_size = world_size
         _state.rank = rank

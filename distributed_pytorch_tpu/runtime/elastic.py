@@ -36,10 +36,10 @@ covers kill → shrink → resume end to end).
 from __future__ import annotations
 
 import multiprocessing as mp
-import os
 import time
 from typing import Callable, NamedTuple, Optional, Sequence
 
+from . import env as _env
 from .watchdog import WorkerFailure
 
 ATTEMPT_ENV = "DPX_ELASTIC_ATTEMPT"
@@ -60,12 +60,12 @@ def _child_bootstrap(target, args, child_env):
     use — env-var platform selection is too late in this environment
     (site customization pre-imports jax), and a CI/test child must be
     able to opt out of a wedged TPU."""
-    os.environ.update(child_env)
-    plat = os.environ.get("DPX_PLATFORM")
+    _env.apply_overrides(child_env)
+    plat = _env.get("DPX_PLATFORM")
     if plat:
         import jax
         jax.config.update("jax_platforms", plat)
-        n = os.environ.get("DPX_CPU_DEVICES")
+        n = _env.raw("DPX_CPU_DEVICES")
         if plat == "cpu" and n:
             from .jax_compat import ensure_cpu_devices
             ensure_cpu_devices(int(n))
@@ -116,6 +116,7 @@ def elastic_run(target: Callable, args: Sequence = (), *,
                         args=(target, tuple(args), child_env))
         p.start()
         try:
+            # dpxlint: disable=DPX003 the supervisor's whole job is waiting out the worker; watchdog deadlines live inside it
             p.join()
         except BaseException:
             # supervisor interrupted (KeyboardInterrupt, an exception in
@@ -126,7 +127,7 @@ def elastic_run(target: Callable, args: Sequence = (), *,
                 p.join(5)
                 if p.is_alive():
                     p.kill()
-                    p.join()
+                    p.join()  # dpxlint: disable=DPX003 post-SIGKILL reap returns promptly
             raise
         codes.append(p.exitcode)
         if p.exitcode == 0:
@@ -153,9 +154,9 @@ def elastic_run(target: Callable, args: Sequence = (), *,
 def elastic_attempt() -> int:
     """The current process's restart attempt number (0 = first launch,
     also when not running under :func:`elastic_run`)."""
-    return int(os.environ.get(ATTEMPT_ENV, "0"))
+    return _env.get(ATTEMPT_ENV)
 
 
 def is_elastic() -> bool:
     """Whether this process is supervised by :func:`elastic_run`."""
-    return os.environ.get(ELASTIC_ENV) == "1"
+    return _env.get(ELASTIC_ENV)

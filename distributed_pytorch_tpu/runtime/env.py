@@ -1,0 +1,316 @@
+"""Typed environment-variable registry — the single front door for every
+environment read the framework makes.
+
+Before this module, 29 call sites read ``os.environ`` directly, each with
+its own ad-hoc parse/default/fallback. That scatter had three costs: no
+one place lists the knobs a deployment can set, a typo'd variable name
+fails silently, and a malformed value blows up (or worse, doesn't) at a
+different layer every time. This registry fixes all three:
+
+* every variable the framework reads or writes is **declared** here with
+  its name, type, default, and a docstring — ``docs/env_vars.md`` is
+  generated from these declarations (``python -m tools.gen_env_docs``),
+  so the docs cannot drift from the code;
+* reads go through :func:`get` (typed, default-applying, tolerant of
+  malformed values the way the comm deadline read always was) or
+  :func:`raw`; an **unregistered name raises** ``KeyError`` immediately —
+  the registry is closed, not advisory;
+* the ``dpxlint`` DPX002 rule (:mod:`..analysis.lint`) flags any new raw
+  ``os.environ`` access outside this module, so the scatter cannot grow
+  back.
+
+Writes: the framework legitimately exports a handful of variables to
+itself and to child processes (``DPX_BACKEND`` in the worker shim,
+``DPX_FAULT`` from :func:`..runtime.faults.install`, the elastic
+attempt counter). Those go through :func:`set`/:func:`unset` (registered
+names only). Child-process bootstrap paths that apply a *caller-supplied*
+environment dict verbatim use :func:`apply_overrides` /
+:func:`snapshot` / :func:`restore` — passthrough by design, documented
+as such.
+
+Variables marked ``external=True`` are owned by other systems (XLA, JAX,
+the TPU runtime, torch's rendezvous convention); they are registered so
+reads are typed and documented, but their semantics are defined
+elsewhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Iterable, Mapping, Optional
+
+__all__ = [
+    "EnvVar", "REGISTRY", "register", "get", "raw", "is_set", "set",
+    "unset", "apply_overrides", "snapshot", "restore", "generate_docs",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    """One declared environment variable."""
+
+    name: str
+    type: str            # 'str' | 'int' | 'float' | 'bool'
+    default: Any         # typed default returned when unset/malformed
+    doc: str             # one-line description (docs/env_vars.md row)
+    external: bool = False  # owned by XLA/JAX/TPU/torch, not this repo
+
+    def parse(self, text: str) -> Any:
+        if self.type == "int":
+            return int(text)
+        if self.type == "float":
+            return float(text)
+        if self.type == "bool":
+            # accepted spellings mirror the repo's historical checks
+            # (DPX_ELASTIC == "1", DPX_BENCH_SELFLOG != "0")
+            return text.strip().lower() in ("1", "true", "yes", "on")
+        return text
+
+
+REGISTRY: Dict[str, EnvVar] = {}
+
+
+def register(name: str, type: str = "str", default: Any = None,
+             doc: str = "", external: bool = False) -> EnvVar:
+    """Declare a variable. Idempotent for identical declarations; a
+    conflicting re-declaration raises (two modules disagreeing about a
+    knob's type/default is exactly the bug the registry exists to stop).
+    """
+    if type not in ("str", "int", "float", "bool"):
+        raise ValueError(f"unsupported env var type {type!r} for {name}")
+    var = EnvVar(name=name, type=type, default=default, doc=doc,
+                 external=external)
+    old = REGISTRY.get(name)
+    if old is not None and old != var:
+        raise ValueError(
+            f"conflicting registration for {name}: {old} vs {var}")
+    REGISTRY[name] = var
+    return var
+
+
+def _lookup(name: str) -> EnvVar:
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"environment variable {name!r} is not registered in "
+            f"runtime/env.py — declare it there (name, type, default, "
+            f"docstring) before reading it") from None
+
+
+def get(name: str) -> Any:
+    """Typed value of ``name``: parsed when set, the declared default when
+    unset **or malformed**. Malformed-falls-back is deliberate — it is
+    the contract the comm deadline read always had (a garbage
+    ``DPX_COMM_TIMEOUT_MS`` must degrade to the default, not crash a
+    2000-host job at rendezvous)."""
+    var = _lookup(name)
+    text = os.environ.get(name)
+    if text is None:
+        return var.default
+    try:
+        return var.parse(text)
+    except ValueError:
+        return var.default
+
+
+def raw(name: str) -> Optional[str]:
+    """The unparsed string value (None when unset). For variables whose
+    grammar is richer than one scalar (``DPX_CPU_DEVICES`` accepts an int
+    or ``'all'``; ``DPX_FAULT`` has its own spec language)."""
+    _lookup(name)
+    return os.environ.get(name)
+
+
+def is_set(name: str) -> bool:
+    _lookup(name)
+    return name in os.environ
+
+
+def set(name: str, value: Any) -> None:
+    """Export a registered variable (stringified) to this process and
+    its future children."""
+    _lookup(name)
+    os.environ[name] = str(value)
+
+
+def unset(name: str) -> None:
+    _lookup(name)
+    os.environ.pop(name, None)
+
+
+def apply_overrides(mapping: Mapping[str, str]) -> None:
+    """Apply a caller-supplied environment dict verbatim (child-process
+    bootstrap: the elastic child env, the per-rank worker env). Keys are
+    NOT required to be registered — these dicts legitimately carry
+    user-provided passthrough variables."""
+    os.environ.update({k: str(v) for k, v in mapping.items()})
+
+
+def snapshot(keys: Iterable[str]) -> Dict[str, Optional[str]]:
+    """Current raw values of ``keys`` (None = unset), for :func:`restore`."""
+    return {k: os.environ.get(k) for k in keys}
+
+
+def restore(saved: Mapping[str, Optional[str]]) -> None:
+    """Undo an :func:`apply_overrides` using a prior :func:`snapshot`."""
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def generate_docs() -> str:
+    """The ``docs/env_vars.md`` content — one table row per declaration,
+    framework-owned variables first. ``tools/gen_env_docs.py`` writes
+    this; a tier-1 test asserts the committed file matches."""
+    lines = [
+        "# Environment variables",
+        "",
+        "Generated from the typed registry in "
+        "`distributed_pytorch_tpu/runtime/env.py` by "
+        "`python -m tools.gen_env_docs` — edit the registry, not this "
+        "file. Every environment read the framework makes goes through "
+        "the registry; the `dpxlint` rule DPX002 (`docs/analysis.md`) "
+        "keeps it that way.",
+        "",
+        "## Framework-owned",
+        "",
+        "| Name | Type | Default | Description |",
+        "|---|---|---|---|",
+    ]
+    own = [v for _, v in sorted(REGISTRY.items()) if not v.external]
+    ext = [v for _, v in sorted(REGISTRY.items()) if v.external]
+    for v in own:
+        lines.append(f"| `{v.name}` | {v.type} | `{v.default!r}` | "
+                     f"{v.doc} |")
+    lines += [
+        "",
+        "## External (owned by XLA / JAX / TPU runtime / torch "
+        "conventions)",
+        "",
+        "| Name | Type | Default | Description |",
+        "|---|---|---|---|",
+    ]
+    for v in ext:
+        lines.append(f"| `{v.name}` | {v.type} | `{v.default!r}` | "
+                     f"{v.doc} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# The registry. One declaration per variable the repo reads or writes;
+# the doc string here IS the docs/env_vars.md row.
+# ---------------------------------------------------------------------------
+
+# -- runtime / comm ---------------------------------------------------------
+register("DPX_BACKEND", "str", None,
+         "Force the process-group backend; `host` selects the native TCP "
+         "per-rank-process group (set by the multiprocess worker shim).")
+register("DPX_MASTER_ADDR", "str", "127.0.0.1",
+         "Rendezvous address of the native host process group (the "
+         "MASTER_ADDR analog).")
+register("DPX_MASTER_PORT", "int", None,
+         "Rendezvous base port of the native host process group; rank r "
+         "listens on port+r. Required in host-backend workers.")
+register("DPX_COMM_TIMEOUT_MS", "int", 300_000,
+         "Per-collective deadline in ms for the native host group "
+         "(0 disables). A wedged peer becomes a typed `CommTimeout`, "
+         "never an infinite hang (docs/failures.md).")
+register("DPX_VISIBLE_DEVICES", "str", None,
+         "Comma-separated accelerator device indices visible to this "
+         "process — the `CUDA_VISIBLE_DEVICES` analog "
+         "(runtime/context.py).")
+register("DPX_CPU_DEVICES", "str", None,
+         "Opt N virtual CPU XLA devices in as accelerators (`all` for "
+         "every host device) — the virtual-mesh testing knob.")
+register("DPX_MULTIPROC_ACCEL", "str", "",
+         "Per-rank-process device ownership: `tpu` gives child rank r "
+         "exclusive ownership of local chip r; empty/`cpu` keeps "
+         "children on the CPU backend.")
+register("DPX_NATIVE_LIB", "str", None,
+         "Absolute path of a prebuilt libdpxhost.so to load instead of "
+         "the default build — how the CI sanitizer jobs point the whole "
+         "test suite at an ASan/UBSan/TSan-instrumented native library "
+         "(docs/analysis.md).")
+register("DPX_SCHEDULE_WINDOW", "int", 64,
+         "How many recent per-rank collective records the runtime "
+         "schedule verifier keeps for divergence reports (0 disables "
+         "recording; docs/analysis.md).")
+
+# -- observability ----------------------------------------------------------
+register("DPX_METRICS_LOG", "str", None,
+         "Line-JSON file receiving structured events (worker failures, "
+         "ckpt saves, schedule digests) from every rank and supervisor.")
+
+# -- faults / elastic -------------------------------------------------------
+register("DPX_FAULT", "str", None,
+         "Deterministic fault-injection spec(s): "
+         "`action@key=value,...` with actions kill|delay|drop_conn|"
+         "diverge (grammar in runtime/faults.py, docs/failures.md).")
+register("DPX_ELASTIC_ATTEMPT", "int", 0,
+         "Restart attempt number exported to elastically supervised "
+         "workers (0 = first launch).")
+register("DPX_ELASTIC", "bool", False,
+         "Set to 1 in workers supervised by `elastic_run`.")
+register("DPX_PLATFORM", "str", None,
+         "Platform the elastic child applies via jax.config before any "
+         "backend use (env-var selection is too late under "
+         "site-customized jax).")
+register("DPX_WORKER_TAG", "str", None,
+         "Per-launch tag stamped on spawned rank processes so "
+         "`watchdog.kill_orphan_workers` can clean up after a crashed "
+         "launcher.")
+register("DPX_ELASTIC_TEST_LEAK", "str", None,
+         "Test-only canary asserting elastic child env never leaks into "
+         "the supervisor (tests/test_elastic.py).")
+
+# -- torch front door / benches --------------------------------------------
+register("DPX_GRAD_REDUCE", "str", "mean",
+         "Default gradient-reduction wire of the torch-compat DDP "
+         "wrapper: `mean` (exact) or `quant` (block-int8 ring, "
+         "docs/comms.md).")
+register("DPX_TORCH_THREADS", "int", 8,
+         "Torch intra-op thread count pinned by bench.py for stable "
+         "A/B comparisons.")
+register("DPX_BENCH_SELFLOG", "bool", True,
+         "bench.py appends its own records to the default results log "
+         "(set 0 to disable).")
+
+# -- external ---------------------------------------------------------------
+register("JAX_PLATFORMS", "str", None,
+         "JAX platform selection (this repo's tests force `cpu` via "
+         "jax.config instead — see tests/conftest.py).", external=True)
+register("XLA_FLAGS", "str", None,
+         "XLA compiler/runtime flags; `ensure_cpu_devices` appends "
+         "`--xla_force_host_platform_device_count`.", external=True)
+register("MASTER_ADDR", "str", "localhost",
+         "torch.distributed rendezvous address (torch-compat shim "
+         "convention).", external=True)
+register("MASTER_PORT", "int", 29_500,
+         "torch.distributed rendezvous port (torch-compat shim "
+         "convention).", external=True)
+register("CUDA_VISIBLE_DEVICES", "str", None,
+         "CUDA device visibility — consulted by the torch-compat shim's "
+         "device-count fallback.", external=True)
+register("TPU_VISIBLE_DEVICES", "str", None,
+         "TPU chip visibility; the multiprocess front door sets it to "
+         "give child rank r chip r.", external=True)
+register("TPU_CHIPS_PER_PROCESS_BOUNDS", "str", None,
+         "TPU runtime topology bound set for single-chip child "
+         "processes.", external=True)
+register("TPU_PROCESS_BOUNDS", "str", None,
+         "TPU runtime process-grid bound set for single-chip child "
+         "processes.", external=True)
+register("TPU_WORKER_HOSTNAMES", "str", None,
+         "Comma-separated pod worker hostnames (multi-host discovery).",
+         external=True)
+register("MEGASCALE_COORDINATOR_ADDRESS", "str", None,
+         "Megascale/DCN coordinator address — its presence marks a "
+         "multi-slice deployment.", external=True)
+register("PALLAS_AXON_POOL_IPS", "str", None,
+         "Remote TPU pool tunnel of this environment; cleared in child "
+         "processes that must stay local.", external=True)

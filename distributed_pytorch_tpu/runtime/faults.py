@@ -14,7 +14,7 @@ programmatically via :func:`install`. The spec grammar::
 
     DPX_FAULT = spec [';' spec ...]
     spec      = action '@' key '=' value [',' key '=' value ...]
-    action    = 'kill' | 'delay' | 'drop_conn'
+    action    = 'kill' | 'delay' | 'drop_conn' | 'diverge'
     key       = 'step' | 'rank' | 'op' | 'call' | 'ms' | 'attempt'
 
 Examples::
@@ -60,6 +60,12 @@ Actions:
   peer's :class:`~.native.CommTimeout` / a stale heartbeat).
 - ``drop_conn`` — abort the native comm links (``HostComm.abort``):
   peers observe peer-closed, this rank's next op raises.
+- ``diverge``   — issue a DIVERGENT collective (one extra ``barrier``)
+  on the matched rank at the match point: the classic mismatched-
+  collective-schedule bug (one rank's control flow took a different
+  branch), which deadlocks until the deadline. The schedule verifier
+  (``analysis/schedule.py``) exists to turn exactly this into a report
+  naming the rank/op/sequence; the world-4 chaos test injects it.
 
 Everything is deterministic: no randomness, counters only advance at
 hook call sites, and a given (spec, call history) always injects at the
@@ -75,6 +81,8 @@ import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from . import env as _env
+
 #: Env var holding the fault spec(s).
 FAULT_ENV = "DPX_FAULT"
 
@@ -82,7 +90,7 @@ FAULT_ENV = "DPX_FAULT"
 #: supervisor/test can tell an injected death from an organic one.
 KILL_EXIT_CODE = 43
 
-_ACTIONS = ("kill", "delay", "drop_conn")
+_ACTIONS = ("kill", "delay", "drop_conn", "diverge")
 _INT_KEYS = ("step", "rank", "call", "ms", "attempt")
 
 
@@ -104,7 +112,7 @@ class FaultSpec:
         if self.rank is not None and (rank is None or rank != self.rank):
             return False
         if self.attempt is not None:
-            cur = int(os.environ.get("DPX_ELASTIC_ATTEMPT", "0"))
+            cur = _env.get("DPX_ELASTIC_ATTEMPT")
             if cur != self.attempt:
                 return False
         return True
@@ -156,9 +164,9 @@ def install(spec: Optional[str]) -> List[FaultSpec]:
     Also exports ``DPX_FAULT`` so spawned children inherit the faults."""
     global _specs, _specs_src
     if spec:
-        os.environ[FAULT_ENV] = spec
+        _env.set(FAULT_ENV, spec)
     else:
-        os.environ.pop(FAULT_ENV, None)
+        _env.unset(FAULT_ENV)
     _specs = parse_fault_spec(spec) if spec else []
     _specs_src = spec or ""
     return _specs
@@ -170,7 +178,7 @@ def reset() -> None:
     call would re-parse it and resurrect the specs with fresh (unfired)
     state."""
     global _specs, _specs_src, _cur_step
-    os.environ.pop(FAULT_ENV, None)
+    _env.unset(FAULT_ENV)
     _specs = None
     _specs_src = None
     _cur_step = None
@@ -187,7 +195,7 @@ def fired() -> List[str]:
 def _active() -> List[FaultSpec]:
     """The live spec list, re-parsed whenever ``DPX_FAULT`` changes."""
     global _specs, _specs_src
-    env = os.environ.get(FAULT_ENV, "")
+    env = _env.raw(FAULT_ENV) or ""
     if _specs is None or env != _specs_src:
         _specs = parse_fault_spec(env) if env else []
         _specs_src = env
@@ -224,6 +232,13 @@ def _fire(spec: FaultSpec, site: str, rank: Optional[int], comm) -> None:
         targets = [comm] if comm is not None else _live_comms()
         for c in targets:
             c.abort()
+    elif spec.action == "diverge":
+        # issue a collective the peers are NOT issuing (an extra
+        # barrier): the mismatched-schedule bug class. fired=True was
+        # already set above, so the nested hook call cannot re-fire.
+        targets = [comm] if comm is not None else _live_comms()
+        for c in targets:
+            c.barrier()
 
 
 def on_comm_op(op: str, rank: Optional[int] = None, comm=None) -> None:

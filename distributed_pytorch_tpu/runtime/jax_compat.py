@@ -11,9 +11,10 @@ call site imports from here instead of feature-testing locally.
 from __future__ import annotations
 
 import inspect
-import os
 
 import jax
+
+from . import env as _env
 
 __all__ = ["shard_map", "ensure_cpu_devices", "tpu_compiler_params"]
 
@@ -81,6 +82,6 @@ def ensure_cpu_devices(n: int) -> None:
     except AttributeError:
         pass
     flag = f"--xla_force_host_platform_device_count={n}"
-    flags = os.environ.get("XLA_FLAGS", "")
+    flags = _env.get("XLA_FLAGS") or ""
     if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+        _env.set("XLA_FLAGS", (flags + " " + flag).strip())

@@ -16,7 +16,6 @@ meshes), preserving the reference's 0/1/N graceful-degradation contract.
 
 from __future__ import annotations
 
-import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -24,6 +23,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from . import context
+from . import env as _env
 
 _initialized = False
 
@@ -71,10 +71,10 @@ def _pod_worker_count() -> int:
     has a one-entry TPU_WORKER_HOSTNAMES *and* a megascale coordinator —
     the fleet still needs the join."""
     n = 1
-    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    hosts = _env.get("TPU_WORKER_HOSTNAMES") or ""
     if hosts:
         n = max(n, len([h for h in hosts.split(",") if h.strip()]))
-    if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+    if _env.get("MEGASCALE_COORDINATOR_ADDRESS"):
         n = max(n, 2)
     return n
 

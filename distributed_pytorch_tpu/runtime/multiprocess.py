@@ -28,10 +28,12 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import sys
 import time
 import traceback
 from typing import Callable
 
+from . import env as _env
 from .launcher import find_free_port
 from .watchdog import (WORKER_TAG_ENV, ProcessSupervisor, WorkerFailure,
                        register_active_tag, unregister_active_tag)
@@ -50,7 +52,7 @@ def _child_env_for_rank(rank: int) -> dict:
     ``DPX_MULTIPROC_ACCEL=tpu`` rank r owns LOCAL chip r exclusively.
     Unknown values raise — a typo must not silently demote a multi-chip
     run to CPU."""
-    accel = os.environ.get(MULTIPROC_ACCEL_ENV, "").strip().lower()
+    accel = _env.get(MULTIPROC_ACCEL_ENV).strip().lower()
     if accel == "tpu":
         return {"JAX_PLATFORMS": "tpu",
                 "TPU_VISIBLE_DEVICES": str(rank),
@@ -69,9 +71,9 @@ def _child_env_for_rank(rank: int) -> dict:
 def _worker_shim(rank: int, world_size: int, master_port: int,
                  worker_fn: Callable, args: tuple, err_q) -> None:
     try:
-        os.environ["DPX_BACKEND"] = "host"
-        os.environ["DPX_MASTER_PORT"] = str(master_port)
-        os.environ["DPX_MASTER_ADDR"] = "127.0.0.1"
+        _env.set("DPX_BACKEND", "host")
+        _env.set("DPX_MASTER_PORT", master_port)
+        _env.set("DPX_MASTER_ADDR", "127.0.0.1")
         worker_fn(rank, world_size, *args)
     except Exception as e:
         # typed comm failures carry structured attribution (which op,
@@ -115,9 +117,9 @@ def launch_multiprocess(worker_fn: Callable, nprocs: int, *args,
             for rank in range(nprocs):
                 child_env = {**_child_env_for_rank(rank),
                              WORKER_TAG_ENV: tag}
-                saved = {k: os.environ.get(k) for k in child_env}
+                saved = _env.snapshot(child_env)
                 try:
-                    os.environ.update(child_env)
+                    _env.apply_overrides(child_env)
                     p = ctx.Process(
                         target=_worker_shim,
                         args=(rank, nprocs, port, worker_fn, args, err_q),
@@ -125,11 +127,7 @@ def launch_multiprocess(worker_fn: Callable, nprocs: int, *args,
                     p.start()
                     procs.append(p)
                 finally:
-                    for k, v in saved.items():
-                        if v is None:
-                            os.environ.pop(k, None)
-                        else:
-                            os.environ[k] = v
+                    _env.restore(saved)
         except BaseException:
             # a failed start must not leave earlier ranks hanging in the
             # rendezvous waiting for peers that never launched
@@ -137,6 +135,7 @@ def launch_multiprocess(worker_fn: Callable, nprocs: int, *args,
             raise
 
         try:
+            # dpxlint: disable=DPX003 supervisor join polls children with its own settle/grace escalation
             ProcessSupervisor(procs, err_q, grace_s=grace_s).join()
         except WorkerFailure as e:
             # failure events land in the line-JSON metrics log (path via
@@ -146,6 +145,19 @@ def launch_multiprocess(worker_fn: Callable, nprocs: int, *args,
             append_event("worker_failure", rank=e.rank, op=e.op,
                          kind=e.kind, exitcode=e.exitcode, world=nprocs,
                          tag=tag)
+            # schedule verifier: when the dying ranks flushed divergent
+            # collective schedules, name the odd rank/op/seq alongside
+            # the timeout instead of leaving a bare CommTimeout
+            # (analysis/schedule.py; logs a schedule_divergence event).
+            # Best-effort by contract: the diagnosis must never replace
+            # the typed WorkerFailure it annotates.
+            try:
+                from ..analysis.schedule import report_divergence
+                report = report_divergence(tag=tag)
+                if report:
+                    print(f"# {report}", file=sys.stderr, flush=True)
+            except Exception:
+                pass
             raise
     finally:
         unregister_active_tag(tag)

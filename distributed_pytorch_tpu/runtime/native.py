@@ -15,6 +15,8 @@ from typing import Optional
 
 import numpy as np
 
+from . import env as _envreg
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
@@ -27,7 +29,9 @@ _lib_lock = threading.Lock()
 #: (0 disables). Finite by default — a wedged peer must become a typed
 #: error, never an infinite hang.
 COMM_TIMEOUT_ENV = "DPX_COMM_TIMEOUT_MS"
-DEFAULT_COMM_TIMEOUT_MS = 300_000
+#: Alias of the registry's declared default (runtime/env.py is the
+#: single source of truth for the value; this name is the public export).
+DEFAULT_COMM_TIMEOUT_MS = _envreg.REGISTRY[COMM_TIMEOUT_ENV].default
 
 #: Native error codes (mirror dpxhost.cpp's constants).
 _RC_PEER_CLOSED = -2
@@ -102,14 +106,23 @@ def _needs_build() -> bool:
 
 
 def load_library():
-    """Load (building if needed) the native library; idempotent."""
+    """Load (building if needed) the native library; idempotent.
+
+    ``DPX_NATIVE_LIB`` overrides the library path entirely (no
+    auto-build): the CI sanitizer jobs point it at an ASan/UBSan/TSan
+    build of the same source (``make -C native asan``) so the whole
+    test suite exercises the instrumented library (docs/analysis.md)."""
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if _needs_build():
-            _build()
-        lib = ctypes.CDLL(_LIB_PATH)
+        override = _envreg.get("DPX_NATIVE_LIB")
+        if override:
+            lib = ctypes.CDLL(override)
+        else:
+            if _needs_build():
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
         lib.dpx_comm_init.restype = ctypes.c_void_p
         lib.dpx_comm_init.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                       ctypes.c_int, ctypes.c_int,
@@ -204,12 +217,18 @@ class HostComm:
         # comm/__init__ imports runtime.context — binding here (after all
         # packages finished loading) avoids the cycle
         from . import faults as _faults
+        from ..analysis.schedule import RankSchedule
         from ..comm import wire as _wire
         from ..utils.profiler import CommStats
 
         self._wire = _wire
         self._faults = _faults
         self.stats = CommStats()
+        # always-on collective-schedule recorder: every issued op folds
+        # into a rolling per-rank digest so a cross-rank divergence is
+        # reportable as "rank R issued X where peers issued Y at seq N"
+        # instead of a bare CommTimeout (analysis/schedule.py)
+        self.schedule = RankSchedule(rank=rank, world=world)
         self._lib = load_library()
         # the native layer takes dotted-quad only; resolve hostnames (e.g.
         # 'localhost', the reference's MASTER_ADDR default) here
@@ -221,11 +240,7 @@ class HostComm:
                 f"native rendezvous failed (rank {rank}/{world} on "
                 f"{master_addr}:{base_port})", op="init", rank=rank)
         if op_timeout_ms is None:
-            try:
-                op_timeout_ms = int(os.environ.get(
-                    COMM_TIMEOUT_ENV, DEFAULT_COMM_TIMEOUT_MS))
-            except ValueError:
-                op_timeout_ms = DEFAULT_COMM_TIMEOUT_MS
+            op_timeout_ms = _envreg.get(COMM_TIMEOUT_ENV)
         self._lib.dpx_set_timeout_ms(self._h, op_timeout_ms)
         self.op_timeout_ms = op_timeout_ms
         self.rank = rank
@@ -252,13 +267,23 @@ class HostComm:
         except Exception:
             pass
 
-    def _pre_op(self, op: str):
-        """Fault-injection hook: consulted before every native call."""
+    def _pre_op(self, op: str, *, dtype: str = "", size: int = 0,
+                extra: str = ""):
+        """Per-op entry hook: fault injection first (an injected
+        divergent collective must land in the schedule at ITS issue
+        point), then the schedule recorder folds this op's signature
+        into the rolling digest."""
         self._faults.on_comm_op(op, rank=self.rank, comm=self)
+        self.schedule.record(op, dtype=dtype, size=size, extra=extra)
 
     def _check(self, rc: int, what: str):
         if rc == 0:
             return
+        # a failing collective flushes this rank's recent schedule to the
+        # line-JSON event log BEFORE raising, so the cross-rank verifier
+        # can name the diverging op/rank (analysis/schedule.py) — never
+        # allowed to mask the real typed error
+        self.schedule.flush(op=what)
         peer = self._lib.dpx_last_error_peer(self._h) if self._h else -1
         where = f"(rank {self.rank}, op {what}"
         where += f", peer {peer})" if peer >= 0 else ")"
@@ -287,8 +312,9 @@ class HostComm:
         """
         if op not in self._OPS:
             raise ValueError(f"allreduce op must be sum|max|min, got {op!r}")
-        self._pre_op("allreduce")
         arr = np.ascontiguousarray(arr)
+        self._pre_op("allreduce", dtype=str(arr.dtype), size=int(arr.size),
+                     extra=op)
         code = self._OPS[op]
         nbytes = self._wire.ring_allreduce_wire_bytes(
             arr.size, self.world, arr.dtype.itemsize) // max(self.world, 1)
@@ -318,8 +344,9 @@ class HostComm:
         ranks. ~4x less wire traffic than :meth:`allreduce`."""
         block = block or self._wire.QUANT_BLOCK
         chunk_blocks = chunk_blocks or self._wire.QUANT_CHUNK_BLOCKS
-        self._pre_op("allreduce_q8")
         arr = np.ascontiguousarray(arr, dtype=np.float32)
+        self._pre_op("allreduce_q8", dtype="float32", size=int(arr.size),
+                     extra=f"block={block}")
         nbytes = self._wire.quant_ring_allreduce_wire_bytes(
             arr.size, self.world, block) // max(self.world, 1)
         with self.stats.timed("allreduce_q8", nbytes):
@@ -331,8 +358,8 @@ class HostComm:
 
     def reduce(self, arr: np.ndarray) -> np.ndarray:
         """Rooted sum to rank 0 (non-root buffers unchanged)."""
-        self._pre_op("reduce")
         arr = np.ascontiguousarray(arr, dtype=np.float32)
+        self._pre_op("reduce", dtype="float32", size=int(arr.size))
         with self.stats.timed("reduce", arr.nbytes):
             rc = self._lib.dpx_reduce_f32(
                 self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
@@ -342,8 +369,8 @@ class HostComm:
 
     def gather(self, arr: np.ndarray) -> Optional[list]:
         """Rooted gather to rank 0: returns the list there, None elsewhere."""
-        self._pre_op("gather")
         arr = np.ascontiguousarray(arr)
+        self._pre_op("gather", dtype=str(arr.dtype), size=int(arr.size))
         nbytes = arr.nbytes
         with self.stats.timed("gather", nbytes):
             if self.rank == 0:
@@ -369,8 +396,9 @@ class HostComm:
         return self.broadcast(stacked, src=0)
 
     def broadcast(self, arr: np.ndarray, src: int = 0) -> np.ndarray:
-        self._pre_op("broadcast")
         arr = np.ascontiguousarray(arr)
+        self._pre_op("broadcast", dtype=str(arr.dtype), size=int(arr.size),
+                     extra=f"src={src}")
         with self.stats.timed("broadcast", arr.nbytes):
             rc = self._lib.dpx_broadcast(
                 self._h, arr.ctypes.data_as(ctypes.c_char_p), arr.nbytes,

@@ -21,6 +21,7 @@ surface through ``join=True``. This module automates all of it:
 from __future__ import annotations
 
 import os
+import queue as _queue
 import signal
 import time
 from typing import Dict, List, Optional, Sequence
@@ -115,8 +116,14 @@ class ProcessSupervisor:
         {kind, op, peer} meta dict (runtime/multiprocess._worker_shim)."""
         out = []
         if self.err_q is not None:
-            while not self.err_q.empty():
-                item = self.err_q.get()
+            while True:
+                # empty()/get() on an mp.Queue race against the feeder
+                # thread of a dying child — a bounded get can never hang
+                # the supervisor on a report that will never finish
+                try:
+                    item = self.err_q.get(timeout=0.25)
+                except _queue.Empty:
+                    break
                 if len(item) == 2:
                     item = (item[0], item[1], {})
                 out.append(item)
@@ -132,7 +139,7 @@ class ProcessSupervisor:
         for p in self.procs:
             if p.is_alive():
                 p.kill()
-                p.join()
+                p.join()  # dpxlint: disable=DPX003 post-SIGKILL reap returns promptly
 
     def join(self) -> None:
         """Block until all workers finish; raise :class:`WorkerFailure` on

@@ -141,7 +141,8 @@ class InferenceEngine:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         with self._cond:
             if self._stop:
-                raise EngineStopped("engine is shut down")
+                # pre-admission: no request id exists yet to attribute
+                raise EngineStopped("engine is shut down")  # dpxlint: disable=DPX004 pre-admission, no request id assigned yet
             rid = self._next_id
             self._next_id += 1
         self._validate(prompt, sp, rid)
@@ -161,7 +162,8 @@ class InferenceEngine:
         # never in a dead scheduler with a forever-pending future
         with self._cond:
             if self._stop:
-                raise EngineStopped("engine is shut down")
+                raise EngineStopped("engine is shut down",
+                                    request_id=rid)
             self._scheduler.submit(req)   # may raise AdmissionRejected
             self._cond.notify_all()
         return req.handle
@@ -204,6 +206,7 @@ class InferenceEngine:
             self._stop = True
             self._cond.notify_all()
         if wait and self._thread is not None:
+            # dpxlint: disable=DPX003 loop exits at its next iteration boundary once _stop is set; per-request deadlines bound the iterations
             self._thread.join()
             self._thread = None
 
@@ -237,6 +240,7 @@ class InferenceEngine:
                 # queue AND the running set are empty
                 while (not self._stop and not self._running
                        and not len(self._scheduler)):
+                    # dpxlint: disable=DPX003 untimed wait safe per the invariant above: every idle-exit transition notifies under this lock
                     self._cond.wait()
                 if self._stop:
                     break

@@ -12,6 +12,7 @@ import time
 from typing import Any, Dict, Optional
 
 from ..runtime import context
+from ..runtime import env as _env
 
 #: Env var: when set, structured EVENTS (worker failures, elastic
 #: relaunches) are appended to this line-JSON file regardless of rank —
@@ -36,7 +37,7 @@ def append_event(event: str, path: Optional[str] = None, **fields: Any
     process-local lock (one ``write`` per line keeps lines intact across
     processes too — POSIX appends of this size don't interleave).
     """
-    path = path or os.environ.get(METRICS_LOG_ENV)
+    path = path or _env.get(METRICS_LOG_ENV)
     if not path:
         return False
     rec = {"event": event, "time": time.time(), **fields}

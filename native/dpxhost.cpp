@@ -584,8 +584,11 @@ DPX_REDUCE_INTO(reduce_into_f64, double)
 // each W-1 hops of n/W elements.
 static int ring_allreduce(Comm* c, char* data, int64_t n, int elem_size,
                           int op) {
-  if (c->world == 1) return 0;
+  // aborted wins over the world==1 shortcut: the documented contract is
+  // that EVERY op on an aborted comm fails fast (found by
+  // tools/native_stress.py under the PR 5 sanitizer wiring)
   if (c->aborted) return kErr;
+  if (c->world == 1) return 0;
   const int w = c->world;
   const int64_t deadline = op_deadline(c);
   const int64_t chunk = (n + w - 1) / w;  // elements per segment (last ragged)
@@ -866,9 +869,9 @@ int q8_hop(Comm* c, const QGrid& g, float* data, int chunk_blocks,
 int dpx_allreduce_q8(void* handle, float* data, int64_t n, int block,
                      int chunk_blocks) {
   Comm* c = static_cast<Comm*>(handle);
+  if (c->aborted) return kErr;  // contract: aborted beats the no-op path
   if (c->world == 1 || n == 0) return 0;
   if (block <= 0 || chunk_blocks <= 0) return kErr;
-  if (c->aborted) return kErr;
   const int w = c->world;
   const int64_t deadline = op_deadline(c);
   QGrid g(n, block, w);
@@ -957,8 +960,8 @@ int dpx_allreduce_q8(void* handle, float* data, int64_t n, int block,
 // contract, reference distributed.py:136-144).
 int dpx_reduce_f32(void* handle, float* data, int64_t n) {
   Comm* c = static_cast<Comm*>(handle);
+  if (c->aborted) return kErr;  // contract: aborted beats the no-op path
   if (c->world == 1) return 0;
-  if (c->aborted) return kErr;
   int64_t dl = op_deadline(c);
   if (c->rank == 0) {
     std::vector<float> buf(static_cast<size_t>(n));
@@ -977,11 +980,11 @@ int dpx_reduce_f32(void* handle, float* data, int64_t n) {
 // slot pre-filled by the caller); ignored elsewhere.
 int dpx_gather(void* handle, const char* send, int64_t nbytes, char* recv) {
   Comm* c = static_cast<Comm*>(handle);
+  if (c->aborted) return kErr;  // contract: aborted beats the no-op path
   if (c->world == 1) {
     if (recv && recv != send) memcpy(recv, send, static_cast<size_t>(nbytes));
     return 0;
   }
-  if (c->aborted) return kErr;
   int64_t dl = op_deadline(c);
   if (c->rank == 0) {
     memcpy(recv, send, static_cast<size_t>(nbytes));
@@ -999,8 +1002,8 @@ int dpx_gather(void* handle, const char* send, int64_t nbytes, char* recv) {
 // Broadcast from src: relayed through rank 0 when src != 0.
 int dpx_broadcast(void* handle, char* data, int64_t nbytes, int src) {
   Comm* c = static_cast<Comm*>(handle);
+  if (c->aborted) return kErr;  // contract: aborted beats the no-op path
   if (c->world == 1) return 0;
-  if (c->aborted) return kErr;
   int64_t dl = op_deadline(c);
   int rc;
   if (src != 0) {
@@ -1029,8 +1032,8 @@ int dpx_broadcast(void* handle, char* data, int64_t nbytes, int src) {
 // Barrier: hub collects a token from every rank, then releases them.
 int dpx_barrier(void* handle) {
   Comm* c = static_cast<Comm*>(handle);
+  if (c->aborted) return kErr;  // contract: aborted beats the no-op path
   if (c->world == 1) return 0;
-  if (c->aborted) return kErr;
   int64_t dl = op_deadline(c);
   uint32_t tok = kMagic;
   int rc;

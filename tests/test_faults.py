@@ -4,6 +4,7 @@ mid-allreduce becomes a typed ``CommError`` on every survivor within 2x
 the per-op deadline (never a hang), the supervisor reaps the world and
 names the dead rank + op, and an elastic relaunch resumes bit-exact."""
 
+import json
 import multiprocessing as mp
 import os
 import sys
@@ -15,6 +16,7 @@ import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from distributed_pytorch_tpu.analysis import schedule
 from distributed_pytorch_tpu.runtime import elastic, faults
 from distributed_pytorch_tpu.runtime.multiprocess import launch_multiprocess
 from distributed_pytorch_tpu.runtime.native import (CommError, CommPeerDied,
@@ -303,7 +305,7 @@ def test_chaos_kill_mid_allreduce_world4(monkeypatch):
         except BaseException as e:  # noqa: BLE001
             result["exc"] = e
 
-    t = threading.Thread(target=run, daemon=True)
+    t = threading.Thread(target=run, name="test-chaos-run", daemon=True)
     t.start()
     t.join(timeout=120)  # the hard no-hang bound for the whole world
     assert not t.is_alive(), "chaos run hung: deadline guard failed"
@@ -326,6 +328,134 @@ def test_chaos_kill_mid_allreduce_world4(monkeypatch):
         assert elapsed < 2 * TIMEOUT_MS / 1000.0, (rank, elapsed)
     # rank 3 receives directly from rank 2 on the ring: it must blame it
     assert reports[3][2] == 2
+
+
+# ---------------------------------------------------------------------------
+# schedule verifier: an injected divergent collective is NAMED (rank/op/seq)
+# ---------------------------------------------------------------------------
+
+
+def test_diverge_spec_parses():
+    (s,) = faults.parse_fault_spec("diverge@op=allreduce,call=3,rank=2")
+    assert s.action == "diverge" and s.call == 3 and s.rank == 2
+
+
+def test_diagnose_synthetic_events():
+    """Unit semantics of the cross-rank join: agreement -> None; the
+    first differing sequence point yields minority/majority attribution."""
+    agree = [{"event": "comm_schedule", "rank": r, "digest": "d",
+              "window": [[1, "allreduce|float32|8|sum"]]} for r in range(3)]
+    assert schedule.diagnose(agree) is None
+    assert schedule.diagnose(agree[:1]) is None  # one rank can't diverge
+
+    events = []
+    for r in range(4):
+        sig3 = ("barrier|||" if r == 2 else "allreduce|float32|512|sum")
+        events.append({
+            "event": "comm_schedule", "rank": r, "digest": f"d{r}",
+            "window": [[1, "allreduce|float32|512|sum"],
+                       [2, "allreduce|float32|512|sum"], [3, sig3]]})
+    rep = schedule.diagnose(events)
+    assert rep is not None and rep.seq == 3
+    assert rep.minority_ranks == [2] and rep.majority_ranks == [0, 1, 3]
+    assert rep.minority_op.startswith("barrier")
+    assert "rank 2" in str(rep) and "seq 3" in str(rep)
+
+    # launches don't cross-contaminate: a stale flush from a PREVIOUS
+    # launch (different tag, seq numbering restarted) must not be joined
+    # against the newest launch's schedules — rank 0's old barrier here
+    # would otherwise read as a divergence against run-2's allreduces
+    stale = [{"event": "comm_schedule", "rank": 0, "digest": "old",
+              "tag": "run-1", "window": [[1, "barrier|||"]]}]
+    fresh = [{"event": "comm_schedule", "rank": r, "digest": "new",
+              "tag": "run-2", "window": [[1, "allreduce|float32|8|sum"]]}
+             for r in range(2)]
+    assert schedule.diagnose(stale + fresh) is None  # newest tag only
+    assert schedule.diagnose(stale + fresh, tag="run-1") is None  # 1 rank
+
+    # malformed events in the shared stream are skipped, never raised on
+    junk = [{"event": "comm_schedule", "rank": "not-a-rank",
+             "tag": "run-2", "window": "nope"}]
+    assert schedule.diagnose(stale + fresh + junk) is None
+
+
+def _diverge_worker(rank, world, q):
+    """Two clean allreduces; entering the third, rank 2's control flow
+    'takes a different branch' (injected ``diverge``): it issues a
+    barrier where ranks 0,1,3 issue allreduce #3 — the classic
+    mismatched-collective-schedule bug, cut short by the deadline."""
+    import numpy as np
+    import distributed_pytorch_tpu as dist
+
+    dist.init_process_group(rank, world)
+    for _ in range(2):
+        dist.all_reduce(np.ones(512, np.float32))
+    _report_and_reraise(
+        q, rank, lambda: dist.all_reduce(np.ones(512, np.float32)))
+
+
+def test_schedule_verifier_names_divergent_rank_world4(tmp_path,
+                                                       monkeypatch):
+    """Acceptance (ISSUE 5): DPX_FAULT injects a divergent collective on
+    rank 2 at allreduce call 3 in a world of 4. Everyone still fails
+    typed within the deadline (PR 2's guarantee), but the flushed
+    per-rank schedules now let the verifier name the diverging rank, op,
+    and sequence number — and the supervisor logs that report
+    automatically, alongside the worker_failure event, instead of
+    leaving a bare CommTimeout."""
+    log = str(tmp_path / "metrics.jsonl")
+    monkeypatch.setenv("DPX_METRICS_LOG", log)
+    monkeypatch.setenv(faults.FAULT_ENV,
+                       "diverge@op=allreduce,call=3,rank=2")
+    monkeypatch.setenv("DPX_COMM_TIMEOUT_MS", str(TIMEOUT_MS))
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+
+    result = {}
+
+    def run():
+        try:
+            launch_multiprocess(_diverge_worker, 4, q)
+        except BaseException as e:  # noqa: BLE001
+            result["exc"] = e
+
+    t = threading.Thread(target=run, name="test-diverge-run", daemon=True)
+    t.start()
+    t.join(timeout=120)  # hard no-hang bound: divergence != deadlock
+    assert not t.is_alive(), "diverge run hung: deadline guard failed"
+    assert isinstance(result.get("exc"), WorkerFailure)
+
+    # every rank raised typed; the diverging rank's own error names the
+    # op it was actually stuck in (the barrier nobody else joined)
+    reports = {}
+    while len(reports) < 4:
+        rank, kind, op, peer, elapsed = q.get(timeout=10)
+        reports[rank] = (kind, op, elapsed)
+    assert reports[2][1] == "barrier"
+    for rank, (kind, op, elapsed) in reports.items():
+        assert kind in ("CommTimeout", "CommPeerDied", "CommError"), (
+            rank, kind)
+        assert elapsed < 2 * TIMEOUT_MS / 1000.0, (rank, elapsed)
+
+    # THE acceptance: the verifier names rank 2, the odd op, and seq 3
+    rep = schedule.diagnose_log(log)
+    assert rep is not None, "no divergence diagnosed from flushed schedules"
+    assert rep.minority_ranks == [2]
+    assert rep.minority_op.startswith("barrier")
+    assert rep.majority_ranks == [0, 1, 3]
+    assert rep.majority_op.startswith("allreduce|float32|512")
+    assert rep.seq == 3
+    s = str(rep)
+    assert "rank 2" in s and "barrier" in s and "seq 3" in s
+
+    # the supervisor ran the verifier with zero operator action: a
+    # schedule_divergence event landed in the same line-JSON stream
+    with open(log) as f:
+        events = [json.loads(ln) for ln in f if ln.strip()]
+    kinds = {e["event"] for e in events}
+    assert "worker_failure" in kinds
+    div = [e for e in events if e["event"] == "schedule_divergence"]
+    assert div and div[0]["minority_ranks"] == [2] and div[0]["seq"] == 3
 
 
 # ---------------------------------------------------------------------------

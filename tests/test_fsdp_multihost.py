@@ -2,6 +2,8 @@
 8-device virtual mesh. FSDP must be a pure layout change: identical loss
 trajectory to replicated DP, with params/grads/moments actually sharded."""
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -213,6 +215,63 @@ class TestMultihost:
         np.testing.assert_array_equal(b, [3])
 
 
+@functools.lru_cache(maxsize=1)
+def _dcn_capability():
+    """Probe whether THIS environment can form real cross-process DCN
+    device visibility (two jax.distributed processes whose jax.devices()
+    span both hosts). Some CI/dev containers rendezvous fine but never
+    merge device views — the full test would fail on an environment
+    limitation, not a code bug, so the tier-1 gate skips with the
+    probe's reason instead (ISSUE 5 satellite). Returns a tri-state
+    verdict: ``capable`` / ``incapable`` (the worker's deliberate exit
+    31) / ``broken`` (any other crash — the gate FAILS on those rather
+    than hiding a real regression behind a skip). Cached per session:
+    the probe costs two jax startups."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    from distributed_pytorch_tpu.runtime.launcher import find_free_port
+
+    # _multihost_worker.PROBE_INCAPABLE — referenced by value: importing
+    # the worker module would run its XLA_FLAGS scrub and platform switch
+    # inside THIS test process
+    PROBE_INCAPABLE = 31
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "_multihost_worker.py")
+    coord = f"127.0.0.1:{find_free_port()}"
+    procs = [subprocess.Popen(
+        [_sys.executable, worker, "--probe", coord, "2", str(i)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append(out.strip())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        # a hung probe is NOT the worker's deliberate incapable verdict:
+        # localhost rendezvous answers in seconds when healthy, so a
+        # deadlock here is a regression signal and must fail, not skip
+        return "broken", ("DCN probe hung (jax.distributed rendezvous "
+                          "deadlocked past 120s)")
+    codes = [p.returncode for p in procs]
+    if all(rc == 0 for rc in codes):
+        return "capable", ""
+    if all(rc in (0, PROBE_INCAPABLE) for rc in codes):
+        # the worker's deliberate verdict, not a crash: skippable
+        return "incapable", ("real cross-process DCN unavailable in this "
+                             "environment: " + "; ".join(outs))
+    # any OTHER exit means the probe itself broke (an import error, a
+    # regression in multihost.initialize) — that must FAIL tier-1, not
+    # silently skip it
+    return "broken", (f"DCN probe crashed (exit codes {codes}): "
+                      + "; ".join(outs))
+
+
 class TestRealMultiProcess:
     def test_two_process_dcn_step(self):
         """REAL multi-process jax.distributed: two OS processes with a
@@ -223,13 +282,20 @@ class TestRealMultiProcess:
         do any of this: its rendezvous is hardcoded localhost-single-node,
         reference distributed.py:48.) Workers run tests/_multihost_worker.py
         in fresh subprocesses — platform selection must precede backend
-        init, so this cannot run in-process."""
+        init, so this cannot run in-process. Gated on a capability probe:
+        environments that cannot merge device views across processes
+        SKIP with the probe's reason rather than failing tier-1."""
         import os
         import subprocess
         import sys as _sys
 
         from distributed_pytorch_tpu.runtime.launcher import find_free_port
 
+        verdict, reason = _dcn_capability()
+        if verdict == "broken":
+            pytest.fail(reason)
+        if verdict == "incapable":
+            pytest.skip(reason)
         here = os.path.dirname(os.path.abspath(__file__))
         worker = os.path.join(here, "_multihost_worker.py")
         coord = f"127.0.0.1:{find_free_port()}"

@@ -1,0 +1,3 @@
+"""Developer CLIs: ``python -m tools.dpxlint`` (invariant lint, PR 5)
+and ``python -m tools.gen_env_docs`` (regenerate docs/env_vars.md from
+the typed registry)."""

@@ -1,0 +1,94 @@
+"""dpxlint CLI — run the repo invariant lint (analysis/lint.py).
+
+Usage::
+
+    python -m tools.dpxlint                  # lint repo, baseline applied
+    python -m tools.dpxlint --no-baseline    # every finding, raw
+    python -m tools.dpxlint --write-baseline # accept current findings
+    python -m tools.dpxlint path/ other.py   # restrict to paths
+
+Exit code 0 = clean (no findings outside the committed baseline),
+1 = new findings, 2 = a linted file failed to parse. CI runs
+``python -m tools.dpxlint --baseline`` as the fast lint job
+(.github/workflows/tier1.yml); the rule catalog is docs/analysis.md.
+
+This module deliberately avoids importing jax (or any package module
+with heavy imports): the lint must run in a bare CI job in
+milliseconds. ``analysis.lint`` imports only stdlib + the env registry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _load_lint():
+    """Import analysis.lint WITHOUT executing the package __init__ (which
+    pulls jax): fabricate lightweight parent packages so the module's
+    relative imports resolve against the source tree. setdefault keeps
+    an already-imported real package (in-process test use) intact."""
+    import importlib
+    import types
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    if "distributed_pytorch_tpu" not in sys.modules:
+        pkg = types.ModuleType("distributed_pytorch_tpu")
+        pkg.__path__ = [os.path.join(root, "distributed_pytorch_tpu")]
+        sys.modules["distributed_pytorch_tpu"] = pkg
+    return importlib.import_module(
+        "distributed_pytorch_tpu.analysis.lint")
+
+
+def main(argv=None) -> int:
+    lint = _load_lint()
+
+    ap = argparse.ArgumentParser(prog="dpxlint", description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: repo root)")
+    ap.add_argument("--baseline", nargs="?", const=lint.DEFAULT_BASELINE,
+                    default=lint.DEFAULT_BASELINE, metavar="FILE",
+                    help="baseline file (default: committed baseline)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings as the new baseline")
+    args = ap.parse_args(argv)
+
+    root = lint.repo_root()
+    findings = lint.lint_paths(args.paths or None, root=root)
+
+    parse_failures = [f for f in findings if f.rule == "DPX000"]
+    findings = [f for f in findings if f.rule != "DPX000"]
+
+    baseline_path = (args.baseline if os.path.isabs(args.baseline)
+                     else os.path.join(root, args.baseline))
+    if args.write_baseline:
+        lint.save_baseline(baseline_path, findings)
+        print(f"dpxlint: wrote {len(findings)} finding(s) to "
+              f"{os.path.relpath(baseline_path, root)}")
+        return 0
+
+    if not args.no_baseline and os.path.exists(baseline_path):
+        findings = lint.apply_baseline(
+            findings, lint.load_baseline(baseline_path))
+
+    for f in parse_failures:
+        print(str(f), file=sys.stderr)
+    for f in findings:
+        print(str(f))
+    if parse_failures:
+        return 2
+    if findings:
+        print(f"dpxlint: {len(findings)} new finding(s) — fix, add "
+              "'# dpxlint: disable=DPXnnn <reason>', or re-baseline "
+              "(docs/analysis.md)", file=sys.stderr)
+        return 1
+    print("dpxlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,45 @@
+"""Regenerate docs/env_vars.md from the typed env registry.
+
+Usage: ``python -m tools.gen_env_docs`` (writes the file) or
+``--check`` (exit 1 when the committed file is stale — the tier-1 test
+tests/test_dpxlint.py::test_env_docs_current runs this in-process).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+DOC_PATH = os.path.join("docs", "env_vars.md")
+
+
+def main(argv=None) -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    from distributed_pytorch_tpu.runtime import env
+
+    ap = argparse.ArgumentParser(prog="gen_env_docs", description=__doc__)
+    ap.add_argument("--check", action="store_true",
+                    help="verify docs/env_vars.md is current; write "
+                         "nothing")
+    args = ap.parse_args(argv)
+
+    want = env.generate_docs()
+    path = os.path.join(root, DOC_PATH)
+    have = open(path).read() if os.path.exists(path) else None
+    if args.check:
+        if have != want:
+            print(f"{DOC_PATH} is stale — run python -m tools.gen_env_docs",
+                  file=sys.stderr)
+            return 1
+        print(f"{DOC_PATH} is current")
+        return 0
+    with open(path, "w") as f:
+        f.write(want)
+    print(f"wrote {DOC_PATH} ({len(env.REGISTRY)} variables)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,226 @@
+"""Pure-ctypes stress driver for native/dpxhost.cpp — the sanitizer
+workhorse (docs/analysis.md).
+
+Drives every exported native op (ring allreduce f32/f64 x sum/max/min,
+quantized ring, rooted reduce/gather/broadcast, barrier, CRC32C, abort
+teardown) across a real multi-process TCP group, verifying numerics —
+WITHOUT importing jax or the package. That matters because the
+ASan/UBSan/TSan runs preload the sanitizer runtime into an
+uninstrumented python: jaxlib's MLIR bindings abort under the ASan
+``__cxa_throw`` interceptor and wedge under TSan, so the instrumented
+native library must be exercised by a driver whose process never touches
+jax. (The uninstrumented-suite ASan run still covers the native code on
+the jax-free paths — tests/test_host_backend.py's native tests pass
+under ASan — but THIS driver is the one that works under all three
+sanitizers.)
+
+Usage::
+
+    python tools/native_stress.py --lib native/libdpxhost-asan.so \
+        --world 4 --iters 2
+
+Exit 0 = every check on every rank passed. Run under a sanitizer via::
+
+    ASAN_OPTIONS=detect_leaks=0 \
+    python tools/native_stress.py --lib native/libdpxhost-asan.so \
+        --preload "$(g++ -print-file-name=libasan.so)"
+
+``--preload`` sets LD_PRELOAD for the WORKER processes only: the
+harness parent stays uninstrumented (a TSan-preloaded CPython parent
+wedges before spawn on this toolchain; instrumenting the harness buys
+nothing anyway — the code under test runs in the workers).
+(detect_leaks=0: CPython itself "leaks" interned objects by design; the
+native library's own allocations are all vector/RAII-scoped.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import ctypes
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+#: Standard CRC32C check value (RFC 3720): crc of b"123456789".
+CRC32C_CHECK = 0xE3069283
+
+SIZES = (1, 3, 255, 1024, 65536 + 7)
+
+
+def load(lib_path: str):
+    lib = ctypes.CDLL(lib_path)
+    lib.dpx_comm_init.restype = ctypes.c_void_p
+    lib.dpx_comm_init.argtypes = [ctypes.c_char_p] + [ctypes.c_int] * 4
+    lib.dpx_comm_destroy.argtypes = [ctypes.c_void_p]
+    lib.dpx_comm_abort.argtypes = [ctypes.c_void_p]
+    lib.dpx_set_timeout_ms.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    f32p = ctypes.POINTER(ctypes.c_float)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    lib.dpx_allreduce_f32_op.argtypes = [ctypes.c_void_p, f32p,
+                                         ctypes.c_int64, ctypes.c_int]
+    lib.dpx_allreduce_f64_op.argtypes = [ctypes.c_void_p, f64p,
+                                         ctypes.c_int64, ctypes.c_int]
+    lib.dpx_allreduce_q8.argtypes = [ctypes.c_void_p, f32p,
+                                     ctypes.c_int64, ctypes.c_int,
+                                     ctypes.c_int]
+    lib.dpx_reduce_f32.argtypes = [ctypes.c_void_p, f32p, ctypes.c_int64]
+    lib.dpx_gather.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int64, ctypes.c_char_p]
+    lib.dpx_broadcast.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int64, ctypes.c_int]
+    lib.dpx_barrier.argtypes = [ctypes.c_void_p]
+    lib.dpx_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.dpx_crc32c.restype = ctypes.c_uint32
+    for f in ("dpx_allreduce_f32_op", "dpx_allreduce_f64_op",
+              "dpx_allreduce_q8", "dpx_reduce_f32", "dpx_gather",
+              "dpx_broadcast", "dpx_barrier"):
+        getattr(lib, f).restype = ctypes.c_int
+    return lib
+
+
+def check(cond: bool, what: str) -> None:
+    if not cond:
+        raise AssertionError(what)
+
+
+def f32ptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def worker(lib_path: str, base_port: int, rank: int, world: int,
+           iters: int) -> int:
+    lib = load(lib_path)
+    crc = lib.dpx_crc32c(b"123456789", 9)
+    check(crc == CRC32C_CHECK,
+          f"crc32c check value {crc:#x} != {CRC32C_CHECK:#x}")
+
+    h = lib.dpx_comm_init(b"127.0.0.1", base_port, rank, world, 20000)
+    check(bool(h), "rendezvous failed")
+    lib.dpx_set_timeout_ms(h, 30000)
+    tri = world * (world + 1) / 2.0
+    for it in range(iters):
+        for n in SIZES:
+            # sum / max / min rings, f32 and f64
+            a = np.full(n, rank + 1, np.float32)
+            check(lib.dpx_allreduce_f32_op(h, f32ptr(a), n, 0) == 0,
+                  "allreduce f32 sum rc")
+            check(float(a[0]) == tri and float(a[-1]) == tri,
+                  "allreduce f32 sum value")
+            d = np.full(n, rank + 1, np.float64)
+            dp = d.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+            check(lib.dpx_allreduce_f64_op(h, dp, n, 1) == 0,
+                  "allreduce f64 max rc")
+            check(float(d[0]) == world, "allreduce f64 max value")
+            m = np.full(n, rank + 1, np.float32)
+            check(lib.dpx_allreduce_f32_op(h, f32ptr(m), n, 2) == 0,
+                  "allreduce f32 min rc")
+            check(float(m[-1]) == 1.0, "allreduce f32 min value")
+
+            # quantized ring: lossy sum, but bit-identical across ranks
+            rng = np.random.default_rng(1000 + n + it)
+            base = rng.standard_normal((world, n)).astype(np.float32)
+            q = base[rank].copy()
+            check(lib.dpx_allreduce_q8(h, f32ptr(q), n, 64, 4) == 0,
+                  "allreduce_q8 rc")
+            want = base.sum(axis=0)
+            # one quant step per hop; partial-sum amax can reach
+            # world*amax, and there are ~world hops => world^2 bound
+            tol = 2.0 * world * world * (np.abs(base).max() / 127.0) + 1e-6
+            check(float(np.abs(q - want).max()) <= tol,
+                  f"q8 error beyond bound at n={n}")
+            # cross-rank bit-identity: gather every rank's result CRC
+            qc = np.uint32(lib.dpx_crc32c(
+                q.ctypes.data_as(ctypes.c_void_p), q.nbytes))
+            rbuf = (np.zeros(world, np.uint32) if rank == 0 else None)
+            rc = lib.dpx_gather(
+                h, qc.tobytes(), 4,
+                rbuf.ctypes.data_as(ctypes.c_char_p)
+                if rank == 0 else None)
+            check(rc == 0, "gather rc")
+            if rank == 0:
+                check(len(set(rbuf.tolist())) == 1,
+                      f"q8 results not bit-identical: {rbuf}")
+
+            # rooted reduce + broadcast round trip
+            r = np.full(n, float(rank), np.float32)
+            check(lib.dpx_reduce_f32(h, f32ptr(r), n) == 0, "reduce rc")
+            if rank == 0:
+                check(float(r[0]) == world * (world - 1) / 2.0,
+                      "reduce value")
+            b = (np.arange(n, dtype=np.float32) if rank == 0
+                 else np.zeros(n, np.float32))
+            check(lib.dpx_broadcast(
+                h, b.ctypes.data_as(ctypes.c_char_p), b.nbytes, 0) == 0,
+                "broadcast rc")
+            check(float(b[-1]) == n - 1, "broadcast value")
+        check(lib.dpx_barrier(h) == 0, "barrier rc")
+    lib.dpx_comm_destroy(h)
+
+    # abort-path teardown: a second group is aborted, every later op must
+    # fail fast (exercises close/shutdown paths under the sanitizer)
+    h2 = lib.dpx_comm_init(b"127.0.0.1", base_port + world + 1, rank,
+                           world, 20000)
+    check(bool(h2), "second rendezvous failed")
+    lib.dpx_comm_abort(h2)
+    a = np.ones(8, np.float32)
+    check(lib.dpx_allreduce_f32_op(h2, f32ptr(a), 8, 0) != 0,
+          "op on aborted comm must fail")
+    lib.dpx_comm_destroy(h2)
+    print(f"rank {rank}: ok", flush=True)
+    return 0
+
+
+def find_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="native_stress",
+                                 description=__doc__)
+    ap.add_argument("--lib", default="native/libdpxhost.so")
+    ap.add_argument("--world", type=int, default=4)
+    ap.add_argument("--iters", type=int, default=2)
+    ap.add_argument("--timeout", type=float, default=240.0)
+    ap.add_argument("--preload", default=None, metavar="LIBSAN",
+                    help="LD_PRELOAD for the worker processes (sanitizer "
+                         "runtime); the parent stays uninstrumented")
+    ap.add_argument("--worker", nargs=4, metavar=("PORT", "RANK",
+                                                  "WORLD", "ITERS"),
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        port, rank, world, iters = map(int, args.worker)
+        return worker(args.lib, port, rank, world, iters)
+
+    port = find_free_port()
+    child_env = dict(os.environ)  # dpxlint: disable=DPX002 verbatim child-env passthrough; this harness must not import the jax-backed registry
+    if args.preload:
+        child_env["LD_PRELOAD"] = args.preload
+    procs = [subprocess.Popen(
+        [sys.executable, __file__, "--lib", args.lib, "--worker",
+         str(port), str(r), str(args.world), str(args.iters)],
+        env=child_env)
+        for r in range(args.world)]
+    rc = 0
+    try:
+        for p in procs:
+            p.wait(timeout=args.timeout)
+            rc |= p.returncode
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        print("native_stress: HUNG", file=sys.stderr)
+        return 3
+    print(f"native_stress: {'ok' if rc == 0 else 'FAILED'} "
+          f"(world={args.world}, lib={args.lib})")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

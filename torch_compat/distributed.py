@@ -36,6 +36,8 @@ deliberately preserved: ``reduce`` leaves non-root buffers untouched
 
 from __future__ import annotations
 
+# dpxlint: disable-file=DPX002 standalone shim: must import under bare torch with no jax, so it cannot use the runtime/env.py registry (vars are still documented there)
+
 import math
 import os
 import socket
@@ -347,8 +349,10 @@ class DistributedDataParallel(torch.nn.Module):
             from distributed_pytorch_tpu.ops.quant import ErrorFeedback
             ef = self._bucket_ef.setdefault(bucket_idx, ErrorFeedback())
             flat = ef.compensate(flat)
+            # dpxlint: disable=DPX001 the grad-sync worker thread IS this front door's rank execution context (torch DDP's reducer-thread model); ordering is pinned by the bucket_done events
             out = _COMM.allreduce_q8(flat)
         else:
+            # dpxlint: disable=DPX001 see above: reducer-thread model, bucket-ordered
             out = _COMM.allreduce(flat)
         if out is not flat:
             flat = out
@@ -381,7 +385,7 @@ class DistributedDataParallel(torch.nn.Module):
                 self._abort = False
                 self._worker = threading.Thread(
                     target=self._worker_main, args=(self._bucket_done,),
-                    daemon=True)
+                    name="dpx-ddp-reducer", daemon=True)
                 self._worker.start()
                 # runs on the autograd engine once this backward pass
                 # completes, whether or not every hook fired
