@@ -13,6 +13,13 @@ Usage: python benchmarks/report.py [--log FILE] [--write-baseline]
 the BEGIN/END MEASURED AUTO markers (the watcher runs this after every
 pass that lands a stage, so fresh evidence reaches BASELINE.md on disk
 even when no one is at the keyboard).
+
+Reading the store goes through perfbench (``record.iter_rows``), so
+malformed lines are surfaced as comments instead of silently skipped,
+and the newest schema record renders a gated-metrics table — value,
+spread (IQR/median), trial count, trusted — with withheld
+``vs_baseline`` rows carrying their reason instead of going blank
+(docs/benchmarking.md).
 """
 
 from __future__ import annotations
@@ -22,22 +29,61 @@ import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 DEFAULT_LOG = os.path.join(REPO, "benchmarks", "tpu_results.jsonl")
 
 
+_PB_RECORD = None
+
+
+def _perfbench_record():
+    """The perfbench record module, loaded WITHOUT importing the real
+    package: run_all_tpu's watcher shells out to report.py on a 60s
+    budget precisely because report is jax-free and cannot hang on a
+    wedged tunnel — the heavy package __init__ (api → jax) must never
+    be pulled here.  When the real module is already in sys.modules
+    (in-process test use) it is reused; otherwise the stdlib-only
+    perfbench modules are loaded file-based under a PRIVATE package
+    name, so the genuine package is neither imported nor shadowed."""
+    global _PB_RECORD
+    if _PB_RECORD is not None:
+        return _PB_RECORD
+    real = sys.modules.get("distributed_pytorch_tpu.perfbench.record")
+    if real is not None:
+        _PB_RECORD = real
+        return _PB_RECORD
+    import importlib.util
+    import types
+
+    pdir = os.path.join(REPO, "distributed_pytorch_tpu", "perfbench")
+    pkg_name = "_report_perfbench"
+    if pkg_name not in sys.modules:
+        pkg = types.ModuleType(pkg_name)
+        pkg.__path__ = [pdir]
+        sys.modules[pkg_name] = pkg
+    # record's relative imports resolve inside the private package;
+    # dependency order matters (errors -> stats -> record)
+    for sub in ("errors", "stats", "record"):
+        name = f"{pkg_name}.{sub}"
+        if name in sys.modules:
+            continue
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(pdir, sub + ".py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    _PB_RECORD = sys.modules[f"{pkg_name}.record"]
+    return _PB_RECORD
+
+
+def load_rows_checked(path):
+    """(rows, malformed) via perfbench's one store reader — malformed
+    is [(1-based line, reason), ...], surfaced by main() as comments."""
+    return _perfbench_record().iter_rows(path)
+
+
 def load_rows(path):
-    rows = []
-    try:
-        with open(path) as f:
-            for line in f:
-                if line.strip():
-                    try:
-                        rows.append(json.loads(line))
-                    except json.JSONDecodeError:
-                        pass
-    except OSError:
-        pass
-    return rows
+    return load_rows_checked(path)[0]
 
 
 def latest_per_stage(rows):
@@ -65,6 +111,73 @@ def _fmt(v, nd=3):
         s = f"{v:.{nd}f}"
         return s.rstrip("0").rstrip(".") if "." in s else s
     return str(v)
+
+
+def newest_schema_record(rows):
+    """Newest non-retracted row carrying a perfbench schema record —
+    including not-ok rows: a carry-forward headline is logged ok=False
+    (it must never become a future last_good) but its provenance and
+    withheld vs_baseline are exactly what the report must show."""
+    schema = _perfbench_record().SCHEMA
+    best = None
+    for r in rows:
+        if r.get("retracted"):
+            continue
+        res = r.get("result", {})
+        if isinstance(res, dict) and res.get("schema") == schema:
+            best = r
+    return best
+
+
+def render_gated(row):
+    """The gated-metrics section of one schema record: headline
+    provenance/trust, vs_baseline or its withhold reason (never a
+    silent blank), and the per-metric spread/IQR/trusted table."""
+    res = row["result"]
+    lines = ["", f"### Gated metrics (stage {row.get('stage', '?')}, "
+             f"{row.get('ts') or res.get('ts', '?')}; perfbench "
+             "spread-gate policy — docs/benchmarking.md)", ""]
+    if "value" in res:
+        head = (f"Headline `{res.get('metric')}` = "
+                f"**{_fmt(float(res['value']), 4)}** {res.get('unit')}, "
+                f"provenance **{res.get('provenance')}**")
+        lg = res.get("last_good")
+        if res.get("provenance") == "last_good" and isinstance(lg, dict):
+            head += (f" (carried forward from stage {lg.get('stage')}, "
+                     f"{lg.get('ts', '?')})")
+        lines.append(head + ".")
+    if not res.get("trusted"):
+        lines.append(f"**UNTRUSTED**: "
+                     f"{_truncate_words(res.get('untrusted_reason', '?'))}")
+    if "vs_baseline" in res:
+        lines.append(f"vs_baseline: **{_fmt(float(res['vs_baseline']))}x**"
+                     " (both sides passed the spread gate).")
+    elif "vs_baseline_withheld" in res:
+        lines.append(f"vs_baseline **withheld**: "
+                     f"{_truncate_words(res['vs_baseline_withheld'])}")
+    metrics = res.get("metrics") or {}
+    if metrics:
+        lines += ["", "| metric | value | unit | spread (IQR/med) | "
+                  "trials | trusted |", "|---|---|---|---|---|---|"]
+        for name in sorted(metrics):
+            b = metrics[name]
+            if not isinstance(b, dict):
+                continue
+            spread = (f"{b['spread_frac']:.1%}"
+                      if isinstance(b.get("spread_frac"), (int, float))
+                      else "n/a")
+            n = (b.get("trials") or {}).get("n_trials", 1)
+            if b.get("trusted"):
+                trust = ("yes" if b.get("provenance") == "measured"
+                         else f"yes ({b.get('provenance')})")
+            else:
+                trust = ("no: " + _truncate_words(
+                    b.get("untrusted_reason", "?"), 80))
+            val = (_fmt(float(b["value"]), 4)
+                   if isinstance(b.get("value"), (int, float)) else "n/a")
+            lines.append(f"| {name} | {val} | {b.get('unit', '?')} | "
+                         f"{spread} | {n} | {trust} |")
+    return lines
 
 
 def render(rows) -> str:
@@ -116,6 +229,11 @@ def render(rows) -> str:
                 f"| long-context (seq 4096) MFU | {_fmt(lng['mfu'], 4)}"
                 f" (hw {_fmt(lng.get('mfu_hw') or 0, 4)}) | "
                 f"stage mfu_long |")
+        lines.append("")
+
+    sr = newest_schema_record(rows)
+    if sr:
+        lines += render_gated(sr)
         lines.append("")
 
     smoke = res("mfu_smoke")
@@ -271,7 +389,10 @@ def main(argv):
                   file=sys.stderr)
             return 2
         path = argv[i + 1]
-    rows = load_rows(path)
+    rows, malformed = load_rows_checked(path)
+    for line_no, reason in malformed:
+        print(f"# report: skipping malformed store line {line_no}: "
+              f"{reason}", file=sys.stderr)
     md = render(rows)
     print(md)
     rc = 0
@@ -285,6 +406,7 @@ def main(argv):
     live = latest_per_stage(rows)
     print(json.dumps({"stages_on_file": sorted(live),
                       "n_rows": len(rows),
+                      "n_malformed": len(malformed),
                       "n_retracted": sum(bool(r.get("retracted"))
                                          for r in rows)}))
     return rc

@@ -51,7 +51,9 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-import bench  # noqa: E402  (the shared subprocess/JSON plumbing)
+# the shared probe/retry/JSON-subprocess plumbing lives in the perfbench
+# subsystem now (bench.py is a thin shim over the same module)
+from distributed_pytorch_tpu.perfbench import runner  # noqa: E402
 
 # In watch mode a failed stage is retried on later heals; after this many
 # failures with a healthy backend it is skipped for the rest of the run (a
@@ -95,8 +97,8 @@ def regenerate_baseline(py: str, out_path: str) -> None:
 
 def run_stage(name: str, argv, timeout_s: int, env: dict = None) -> dict:
     t0 = time.time()
-    payload = bench.run_json_subprocess(argv, timeout_s, label=name,
-                                        env=env, keep_stdout_tail=True)
+    payload = runner.run_json_subprocess(argv, timeout_s, label=name,
+                                         env=env, keep_stdout_tail=True)
     rec = {"stage": name, "ok": "error" not in payload,
            "wall_s": round(time.time() - t0, 1), "result": payload}
     return rec
@@ -117,7 +119,7 @@ def watch_for_backend(interval_s: float, max_hours: float,
                       out_path: str) -> bool:
     """Probe the tunnel until it heals or the time budget runs out.
 
-    Each probe is a subprocess with a hard timeout (bench.probe_backend)
+    Each probe is a subprocess with a hard timeout (runner.probe_backend)
     — the tunnel in this environment wedges for hours at a time and an
     in-process probe would hang with it. Returns True on a healthy
     probe; on expiry appends a watch_expired row so the round's record
@@ -133,7 +135,7 @@ def watch_for_backend(interval_s: float, max_hours: float,
         t0 = time.time()
         # default 45s timeout: see probe_backend's docstring (narrow
         # hung-probe window; a kill after a heal can re-wedge the tunnel)
-        ok = bench.probe_backend()
+        ok = runner.probe_backend()
         stamp = time.strftime("%H:%M:%S")
         print(f"[watch {stamp}] probe {n}: "
               f"{'HEALTHY' if ok else 'down'} ({time.time() - t0:.0f}s)",
@@ -294,7 +296,7 @@ def _run(argv):
             hours_left = max(0.0, (deadline - time.time()) / 3600.0)
             if not watch_for_backend(interval_s, hours_left, out_path):
                 return 1
-        info = bench.wait_for_backend(max_tries=2, base_sleep_s=15.0)
+        info = runner.wait_for_backend(max_tries=2, base_sleep_s=15.0)
         if not info:
             rec = {"stage": "tpu_health_gate", "ok": False,
                    "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -320,7 +322,7 @@ def _run(argv):
             for name, cmd, timeout_s, env in stages:
                 if name in done or skipped(name):
                     continue
-                if ran_this_pass and not bench.probe_backend():
+                if ran_this_pass and not runner.probe_backend():
                     # the tunnel wedged mid-collection: stop this pass
                     # instead of burning each remaining stage's full
                     # timeout against a dead backend (collected stages
@@ -351,7 +353,7 @@ def _run(argv):
                     # wedged UNDER it is a wedge victim — recording the
                     # attempt would let one bad evening permanently
                     # skip a flagship stage (ADVICE round 5)
-                    if bench.probe_backend():
+                    if runner.probe_backend():
                         attempts[name] = attempts.get(name, 0) + 1
                         rec["attempt"] = attempts[name]
                     else:

@@ -18,12 +18,20 @@ length/max-new in that arm, since ``generate`` has no per-row
 lengths; the engine arms use the mixed population.
 
 Per-arm output: tokens/s, p50/p99 TTFT and TPOT (serve.metrics
-definitions). ``--smoke`` shrinks everything to a seconds-scale CPU
-run AND asserts engine streams equal standalone ``generate()`` — the
-CI job that keeps the engine loop from rotting (tier1.yml).
+definitions). Throughput numbers go through the perfbench statistical
+policy (docs/benchmarking.md): each closed-loop arm runs
+warmup-discarded repeated trials, tokens/s is the median with IQR and
+the hard spread gate attached, and the engine-vs-static throughput
+ratio is structurally withheld (with the gate's reason) when either
+side comes back untrusted. The printed line is a schema-valid
+``dpx.bench.record`` (perfbench/record.py). ``--smoke`` shrinks
+everything to a seconds-scale CPU run AND asserts engine streams equal
+standalone ``generate()`` — the CI job that keeps the engine loop from
+rotting (tier1.yml).
 
 Usage: python benchmarks/serve_bench.py [--smoke] [--slots N]
            [--requests N] [--rate R] [--max-new N] [--seed S]
+           [--trials N] [--warmup N]
 """
 
 from __future__ import annotations
@@ -129,6 +137,22 @@ def run_static(model, params, reqs, n_slots, max_len):
     return aggregate(records, wall_s=time.monotonic() - t0)
 
 
+def measured_arm(run_once, *, warmup, trials):
+    """Repeated-trial wrapper for one throughput arm: runs ``run_once``
+    (returning an aggregate rep with ``tokens_per_sec``) ``warmup +
+    trials`` times under the perfbench policy.  The first trial pays the
+    arm's jit compiles — exactly the cold-start artifact the warmup
+    discard exists for.  Returns ``(last rep + trials detail, stats)``."""
+    from distributed_pytorch_tpu.perfbench import stats as pbstats
+    reps = [run_once() for _ in range(warmup + trials)]
+    st = pbstats.summarize([r["tokens_per_sec"] for r in reps],
+                           warmup=warmup)
+    rep = dict(reps[-1])
+    rep["tokens_per_sec"] = round(st.median, 2)
+    rep["tokens_per_sec_trials"] = st.to_dict(nd=2)
+    return rep, st
+
+
 def main(argv):
     smoke = "--smoke" in argv
 
@@ -143,19 +167,49 @@ def main(argv):
     rate = flag("--rate", 0.0) or (50.0 if smoke else 8.0)
     seed = flag("--seed", 0)
     max_len = 64 if smoke else 512
+    from distributed_pytorch_tpu.perfbench import record as pbrecord
+    from distributed_pytorch_tpu.perfbench import stats as pbstats
+    from distributed_pytorch_tpu.runtime import env as dpxenv
+    warmup = flag("--warmup", 1 if smoke else
+                  int(dpxenv.get("DPX_BENCH_WARMUP")))
+    trials = flag("--trials", 3 if smoke else
+                  int(dpxenv.get("DPX_BENCH_TRIALS")))
 
     model, params = build_model(smoke)
-    rec = {"bench": "serve", "smoke": smoke,
-           "config": {"n_slots": n_slots, "n_requests": n_req,
-                      "max_new": max_new, "rate_rps": rate,
-                      "max_len": max_len, "vocab": model.vocab,
-                      "dim": model.dim, "n_layers": model.n_layers},
-           "arms": {}}
+    rec = pbrecord.make_record("serve_engine_closed_tokens_per_sec",
+                               "tokens_per_sec", device="cpu-loopback")
+    rec.update({"bench": "serve", "smoke": smoke,
+                "config": {"n_slots": n_slots, "n_requests": n_req,
+                           "max_new": max_new, "rate_rps": rate,
+                           "max_len": max_len, "vocab": model.vocab,
+                           "dim": model.dim, "n_layers": model.n_layers,
+                           "warmup": warmup, "trials": trials},
+                "arms": {}})
 
-    # closed loop (mixed population)
+    # closed loop (mixed population) — the headline arm. outs (for the
+    # smoke correctness gate) come from the FIRST run: identical
+    # submissions, and divergence would invalidate every trial equally.
     mixed = make_requests(n_req, model.vocab, max_new, seed)
-    closed, outs = run_engine(model, params, mixed, n_slots, max_len)
+    first = {}
+
+    def closed_once():
+        rep, outs = run_engine(model, params, mixed, n_slots, max_len)
+        first.setdefault("outs", outs)
+        return rep
+
+    closed, closed_st = measured_arm(closed_once, warmup=warmup,
+                                     trials=trials)
+    outs = first["outs"]
     rec["arms"]["engine_closed"] = closed
+    rec["value"] = round(closed_st.median, 2)
+    rec["provenance"] = "measured"
+    rec["trusted"] = closed_st.trusted
+    if closed_st.trusted:
+        rec.pop("untrusted_reason", None)
+    else:
+        rec["untrusted_reason"] = closed_st.untrusted_reason
+    rec["metrics"]["serve_engine_closed_tokens_per_sec"] = \
+        pbrecord.make_metric(None, "tokens_per_sec", stats=closed_st)
 
     if smoke:
         # correctness gate: engine streams == standalone generate()
@@ -180,17 +234,39 @@ def main(argv):
     rec["arms"]["engine_open_poisson"] = open_rep
 
     # static-batching baseline (uniform shapes; generate has no per-row
-    # lengths)
+    # lengths) — same trial policy on BOTH sides of the ratio
     uni = make_requests(n_req, model.vocab, max_new, seed, uniform=True)
-    rec["arms"]["static_batch"] = run_static(model, params, uni, n_slots,
-                                             max_len)
-    eng_uni, _ = run_engine(model, params, uni, n_slots, max_len)
+    static, static_st = measured_arm(
+        lambda: run_static(model, params, uni, n_slots, max_len),
+        warmup=warmup, trials=trials)
+    rec["arms"]["static_batch"] = static
+    rec["metrics"]["serve_static_batch_tokens_per_sec"] = \
+        pbrecord.make_metric(None, "tokens_per_sec", stats=static_st)
+    eng_uni, eng_uni_st = measured_arm(
+        lambda: run_engine(model, params, uni, n_slots, max_len)[0],
+        warmup=warmup, trials=trials)
     rec["arms"]["engine_closed_uniform"] = eng_uni
-    st, en = rec["arms"]["static_batch"], eng_uni
+    rec["metrics"]["serve_engine_uniform_tokens_per_sec"] = \
+        pbrecord.make_metric(None, "tokens_per_sec", stats=eng_uni_st)
+
+    # continuous-vs-static throughput: printed only when both sides pass
+    # the spread gate, withheld with the gate's reason otherwise
+    ratio, why = pbstats.gated_ratio(eng_uni_st, static_st)
+    if ratio is not None:
+        rec["engine_vs_static_tokens_x"] = round(ratio, 2)
+    else:
+        rec["engine_vs_static_tokens_x_withheld"] = why
+    st, en = static, eng_uni
     if st.get("ttft_ms_p50") and en.get("ttft_ms_p50"):
+        # last-trial latency detail (a distribution, not a gated median)
         rec["engine_vs_static_ttft_p50_x"] = round(
             st["ttft_ms_p50"] / en["ttft_ms_p50"], 2)
 
+    issues = pbrecord.validate_record(rec, strict=False)
+    if issues:
+        rec["schema_issues"] = issues
+        print(f"# WARNING: serve record failed schema self-validation: "
+              f"{'; '.join(issues[:3])}", file=sys.stderr)
     print(json.dumps(rec))
     return 0
 

@@ -1,0 +1,49 @@
+"""Typed benchmark failure vocabulary (the PR-2 error style for perf).
+
+Benchmark failures were strings embedded in ad-hoc dicts; nothing could
+act on them structurally and ``tools/benchdiff.py`` had no way to say
+*which metric* regressed against *which baseline* other than prose.
+Mirrors the CommError / CkptError / ServeError pattern: every raise
+carries attribution kwargs (dpxlint DPX004 enforces at least one), so a
+CI job or a driver can attribute a red benchmark to a metric and a
+stored baseline row without grepping message text.
+"""
+
+from __future__ import annotations
+
+__all__ = ["BenchError", "RecordInvalid", "BenchRegression"]
+
+
+class BenchError(RuntimeError):
+    """A benchmark-subsystem failure, attributed to a metric/stage."""
+
+    def __init__(self, msg: str, *, metric: str = "", stage: str = ""):
+        super().__init__(msg)
+        self.metric = metric
+        self.stage = stage
+
+
+class RecordInvalid(BenchError):
+    """A benchmark record (or a trajectory-store line) failed schema
+    validation. ``field`` names the offending key; ``line`` is the
+    1-based trajectory-store line number when the record came from
+    ``tpu_results.jsonl``."""
+
+    def __init__(self, msg: str, *, field: str = "", line: int = -1,
+                 **kw):
+        super().__init__(msg, **kw)
+        self.field = field
+        self.line = line
+
+
+class BenchRegression(BenchError):
+    """A new record is statistically significantly worse than the stored
+    trajectory baseline for the same metric."""
+
+    def __init__(self, msg: str, *, metric: str = "",
+                 baseline: float = 0.0, measured: float = 0.0,
+                 drop_frac: float = 0.0, **kw):
+        super().__init__(msg, metric=metric, **kw)
+        self.baseline = baseline
+        self.measured = measured
+        self.drop_frac = drop_frac

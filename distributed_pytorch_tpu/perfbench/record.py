@@ -1,0 +1,311 @@
+"""Versioned, schema-validated benchmark records + the trajectory store.
+
+The BENCH trajectory's failure mode (rounds 1-5) was records that were
+*shaped like* evidence but weren't: ``value: null`` headlines, ratios
+computed from 70%-spread baselines, and free-form dicts whose meaning
+drifted per round.  This module pins the record down:
+
+* every record carries ``schema``/``schema_version`` and passes
+  :func:`validate_record` before it is printed or stored — a null metric
+  value is a *schema violation*, not a sad default.  A metric is either
+  a gated median-of-trials value (``provenance: "measured"``), an
+  explicit carry-forward (``provenance: "last_good"`` + the source row),
+  or absent with ``provenance: "unmeasured"`` and an ``error``;
+* ``vs_baseline`` may never coexist with ``vs_baseline_withheld`` — the
+  withhold is structural, with the gate's reason attached;
+* records land in the line-JSON trajectory store
+  (``benchmarks/tpu_results.jsonl``) through the thread-safe
+  ``utils.logging.append_event`` path (one O_APPEND write per line, safe
+  across the engine/ckpt-IO/rank writers that share the metrics stream);
+* :func:`iter_rows` is the one reader: malformed lines are surfaced
+  (counted, or raised as typed :class:`RecordInvalid` in strict mode)
+  instead of silently skipped.
+
+Module level is stdlib-only (``tools/benchdiff.py`` loads this without
+the package ``__init__``); the append path imports ``utils.logging``
+lazily, in processes that have the real package.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import sys
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from .errors import RecordInvalid
+from .stats import TrialStats
+
+__all__ = ["SCHEMA", "SCHEMA_VERSION", "make_metric", "make_record",
+           "env_fingerprint", "validate_record", "validate_metric_blob",
+           "append_row", "iter_rows"]
+
+SCHEMA = "dpx.bench.record"
+SCHEMA_VERSION = 1
+
+_PROVENANCES = ("measured", "last_good", "unmeasured")
+_DIRECTIONS = ("higher", "lower")
+
+
+def _is_num(v: Any) -> bool:
+    return (isinstance(v, (int, float)) and not isinstance(v, bool)
+            and math.isfinite(v))
+
+
+# ---------------------------------------------------------------------------
+# construction
+# ---------------------------------------------------------------------------
+
+def make_metric(value: Optional[float], unit: str, *,
+                stats: Optional[TrialStats] = None,
+                provenance: str = "measured",
+                direction: str = "higher",
+                last_good: Optional[dict] = None,
+                untrusted_reason: Optional[str] = None) -> dict:
+    """One gated metric blob.  ``stats`` (when the value came from
+    repeated trials) contributes the trials detail AND the trust
+    verdict.  A *measured* blob without stats is a single observation —
+    it carries no spread, so a regression gate built on it would be the
+    narrowest possible (the r05 single-rep 2x swing class); it is
+    therefore marked untrusted, which keeps it out of benchdiff
+    verdicts until the producing stage feeds real trials."""
+    blob: Dict[str, Any] = {"unit": unit, "provenance": provenance,
+                            "direction": direction}
+    if stats is not None:
+        blob["value"] = value if value is not None else stats.median
+        blob["trials"] = stats.to_dict()
+        blob["spread_frac"] = round(stats.spread_frac, 4)
+        if not stats.trusted and untrusted_reason is None:
+            untrusted_reason = stats.untrusted_reason
+    elif value is not None:
+        blob["value"] = value
+        if provenance == "measured" and untrusted_reason is None:
+            untrusted_reason = ("single observation — no repeated-trials "
+                                "detail to gate a comparison on")
+    if last_good is not None:
+        blob["last_good"] = last_good
+    blob["trusted"] = untrusted_reason is None
+    if untrusted_reason is not None:
+        blob["untrusted_reason"] = untrusted_reason
+    return blob
+
+
+def make_record(metric: str, unit: str, *, device: str = "unknown",
+                ts: Optional[str] = None) -> dict:
+    """A fresh top-level record shell in the unmeasured state.  Callers
+    fill ``value``/``provenance``/``metrics``/... and must pass
+    :func:`validate_record` before printing or appending."""
+    return {
+        "schema": SCHEMA,
+        "schema_version": SCHEMA_VERSION,
+        "metric": metric,
+        "unit": unit,
+        "provenance": "unmeasured",
+        "trusted": False,
+        "untrusted_reason": "nothing measured yet",
+        "metrics": {},
+        "device": device,
+        "env_fingerprint": env_fingerprint(),
+        "ts": ts or time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+
+
+def env_fingerprint() -> dict:
+    """The environment identity a number was measured under: every
+    *set* framework-owned registry variable (via ``runtime/env.py``'s
+    snapshot — the typed registry is the single source of what counts as
+    environment) plus the interpreter version, digested so two records
+    can be compared at a glance."""
+    try:
+        from ..runtime import env
+        keys = sorted(n for n, v in env.REGISTRY.items()
+                      if not v.external and env.is_set(n))
+        vars_ = {k: v for k, v in env.snapshot(keys).items()
+                 if v is not None}
+    except Exception:  # noqa: BLE001 — fingerprint must never block a record
+        vars_ = {}
+    fp = {"python": sys.version.split()[0], "vars": vars_}
+    fp["digest"] = hashlib.sha256(
+        json.dumps(fp, sort_keys=True).encode()).hexdigest()[:12]
+    return fp
+
+
+# ---------------------------------------------------------------------------
+# validation
+# ---------------------------------------------------------------------------
+
+def validate_metric_blob(name: str, blob: Any) -> List[str]:
+    """Schema issues of one metric blob (empty list = valid)."""
+    issues: List[str] = []
+    if not isinstance(blob, dict):
+        return [f"metrics[{name}]: not a dict"]
+
+    def bad(field, why):
+        issues.append(f"metrics[{name}].{field}: {why}")
+
+    if not _is_num(blob.get("value")):
+        bad("value", "must be a finite number (null/missing is the "
+            "round-3 failure mode this schema exists to forbid)")
+    if not isinstance(blob.get("unit"), str) or not blob.get("unit"):
+        bad("unit", "must be a non-empty string")
+    if blob.get("provenance") not in ("measured", "last_good"):
+        bad("provenance", f"must be measured|last_good, "
+            f"got {blob.get('provenance')!r}")
+    if blob.get("provenance") == "last_good" \
+            and not isinstance(blob.get("last_good"), dict):
+        bad("last_good", "carry-forward blob requires its source detail")
+    if blob.get("direction") not in _DIRECTIONS:
+        bad("direction", f"must be one of {_DIRECTIONS}")
+    if not isinstance(blob.get("trusted"), bool):
+        bad("trusted", "must be a bool")
+    elif not blob["trusted"] and not blob.get("untrusted_reason"):
+        bad("untrusted_reason", "required when trusted is false")
+    trials = blob.get("trials")
+    if trials is not None:
+        if not isinstance(trials, dict):
+            bad("trials", "must be a dict")
+        else:
+            runs = trials.get("runs")
+            if not (isinstance(runs, list) and runs
+                    and all(_is_num(r) for r in runs)):
+                bad("trials.runs", "must be a non-empty list of numbers")
+            for k in ("median", "spread_frac"):
+                if not _is_num(trials.get(k)):
+                    bad(f"trials.{k}", "must be a finite number")
+    return issues
+
+
+def validate_record(rec: Any, *, strict: bool = True) -> List[str]:
+    """All schema issues of a top-level record.  With ``strict`` (the
+    default) a non-empty issue list raises :class:`RecordInvalid`
+    attributed to the first offending field."""
+    issues: List[str] = []
+    if not isinstance(rec, dict):
+        issues = ["record: not a dict"]
+    else:
+        def bad(field, why):
+            issues.append(f"{field}: {why}")
+
+        if rec.get("schema") != SCHEMA:
+            bad("schema", f"expected {SCHEMA!r}, got {rec.get('schema')!r}")
+        if rec.get("schema_version") != SCHEMA_VERSION:
+            bad("schema_version",
+                f"expected {SCHEMA_VERSION}, got "
+                f"{rec.get('schema_version')!r}")
+        if not isinstance(rec.get("metric"), str) or not rec.get("metric"):
+            bad("metric", "must be a non-empty string")
+        if not isinstance(rec.get("unit"), str) or not rec.get("unit"):
+            bad("unit", "must be a non-empty string")
+        prov = rec.get("provenance")
+        if prov not in _PROVENANCES:
+            bad("provenance", f"must be one of {_PROVENANCES}")
+        elif prov == "unmeasured":
+            if "value" in rec:
+                bad("value", "must be ABSENT when unmeasured — a null "
+                    "headline is exactly what this schema forbids")
+            if not rec.get("error"):
+                bad("error", "unmeasured records must say why")
+        else:
+            if not _is_num(rec.get("value")):
+                bad("value", "must be a finite number when provenance "
+                    f"is {prov!r}")
+            if prov == "last_good" \
+                    and not isinstance(rec.get("last_good"), dict):
+                bad("last_good", "carry-forward requires its source "
+                    "detail (stage, ts, source log)")
+        if not isinstance(rec.get("trusted"), bool):
+            bad("trusted", "must be a bool")
+        elif not rec["trusted"] and not rec.get("untrusted_reason"):
+            bad("untrusted_reason", "required when trusted is false")
+        if "vs_baseline" in rec:
+            if not _is_num(rec["vs_baseline"]):
+                bad("vs_baseline", "must be a finite number "
+                    "(withhold it structurally instead of nulling it)")
+            if "vs_baseline_withheld" in rec:
+                bad("vs_baseline_withheld",
+                    "must not coexist with vs_baseline")
+        elif "vs_baseline_withheld" in rec \
+                and not isinstance(rec["vs_baseline_withheld"], str):
+            bad("vs_baseline_withheld", "must be the withhold reason "
+                "string")
+        metrics = rec.get("metrics")
+        if not isinstance(metrics, dict):
+            bad("metrics", "must be a dict of metric blobs")
+        else:
+            for name, blob in sorted(metrics.items()):
+                issues.extend(validate_metric_blob(name, blob))
+        if not isinstance(rec.get("env_fingerprint"), dict) \
+                or "digest" not in rec.get("env_fingerprint", {}):
+            bad("env_fingerprint", "must carry the registry snapshot "
+                "digest (runtime/env.snapshot)")
+        if not isinstance(rec.get("ts"), str) or not rec.get("ts"):
+            bad("ts", "must be a timestamp string")
+    if issues and strict:
+        first_field = issues[0].split(":", 1)[0]
+        raise RecordInvalid(
+            f"record failed schema validation ({len(issues)} issue(s)): "
+            + "; ".join(issues),
+            metric=str(rec.get("metric", "") if isinstance(rec, dict)
+                       else ""),
+            field=first_field)
+    return issues
+
+
+# ---------------------------------------------------------------------------
+# trajectory-store IO
+# ---------------------------------------------------------------------------
+
+def append_row(path: str, stage: str, result: dict, *,
+               ok: Optional[bool] = None,
+               wall_s: Optional[float] = None) -> bool:
+    """Append one ``{stage, ok, wall_s, result, ts}`` row to the
+    trajectory store through the thread-safe ``append_event`` path (one
+    locked O_APPEND write per line — the same multi-writer contract the
+    ckpt/serve metrics stream relies on).  Returns whether a line was
+    written."""
+    from ..utils.logging import append_event
+    return append_event(
+        "bench_row", path=path, stage=stage,
+        ok=bool(result.get("error") is None) if ok is None else bool(ok),
+        wall_s=round(wall_s, 1) if wall_s is not None else None,
+        result=result, ts=time.strftime("%Y-%m-%dT%H:%M:%S"))
+
+
+def iter_rows(path: str, *, strict: bool = False
+              ) -> Tuple[List[dict], List[Tuple[int, str]]]:
+    """Parse the trajectory store: ``(rows, malformed)`` where
+    ``malformed`` is ``[(1-based line number, reason), ...]``.  In
+    strict mode the first malformed line raises :class:`RecordInvalid`
+    attributed to its line number — the store is evidence, and a
+    corrupted line in evidence should be loud somewhere (the CI
+    benchdiff job runs strict)."""
+    rows: List[dict] = []
+    malformed: List[Tuple[int, str]] = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines: Iterable[str] = f.readlines()
+    except OSError:
+        return rows, malformed
+    for i, line in enumerate(lines, 1):
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError as e:
+            if strict:
+                raise RecordInvalid(
+                    f"trajectory store {path} line {i}: not valid JSON "
+                    f"({e.msg})", field="<line>", line=i) from None
+            malformed.append((i, f"not valid JSON: {e.msg}"))
+            continue
+        if not isinstance(row, dict):
+            if strict:
+                raise RecordInvalid(
+                    f"trajectory store {path} line {i}: not a JSON "
+                    "object", field="<line>", line=i)
+            malformed.append((i, "not a JSON object"))
+            continue
+        rows.append(row)
+    return rows, malformed

@@ -1,0 +1,176 @@
+"""Wedge-aware benchmark execution: probes, retries, JSON subprocesses.
+
+The canonical home of the plumbing ``bench.py`` and
+``benchmarks/run_all_tpu.py`` grew ad hoc across rounds 1-5 (BENCH_r01
+died to a wedged tunnel; round 3 lost its headline to a mid-run wedge).
+The contracts, unchanged but now owned by the subsystem:
+
+* **subprocess-isolated probing** — a wedged tunnel hangs an in-process
+  ``jax.devices()`` beyond recovery, so every probe is a child with a
+  hard timeout, and only a real TPU counts as healthy (a CPU fallback
+  would grind the flagship through interpret-mode pallas for hours);
+* **bounded retries with exponential backoff**
+  (:func:`wait_for_backend`, tries from ``DPX_BENCH_PROBE_TRIES``);
+* **parseable-record-no-matter-what** (:func:`run_json_subprocess`) —
+  on any child failure (nonzero exit, timeout, unparseable output) the
+  caller still gets an ``error`` record carrying whatever the child did
+  produce, so a record is *always* emitted with provenance instead of
+  nothing;
+* the ``#``-prefixed flushed progress contract (:func:`progress`,
+  :func:`arm`) that keeps per-arm attribution in a SIGKILLed child's
+  stdout tail.
+
+Module level is stdlib-only; the typed env registry is imported lazily
+(same standalone-load contract as the rest of ``perfbench``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Optional
+
+__all__ = ["REPO", "probe_backend", "wait_for_backend", "progress",
+           "arm", "run_json_subprocess"]
+
+#: Repo root (three levels up: perfbench/ -> distributed_pytorch_tpu/ ->
+#: repo) — the PYTHONPATH every benchmark child needs on sys.path.
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _env():
+    from ..runtime import env
+    return env
+
+
+def probe_backend(timeout_s: int = 45) -> dict:
+    """Probe JAX backend init in a SUBPROCESS (a wedged tunnel hangs the
+    whole process — a timeout around an in-process jax.devices() call
+    cannot recover it).  Only a real TPU counts as healthy.
+
+    The 45s default is deliberate at every call site: a healthy probe
+    answers in ~6s, and a probe hung against a wedged tunnel gets
+    SIGKILLed at the timeout — a kill landing just after a heal can
+    re-wedge the tunnel (killed clients wedge it), so the hung-probe
+    window is kept as narrow as detection reliability allows."""
+    code = ("import jax, json; d = jax.devices()[0]; "
+            "print(json.dumps({'platform': d.platform, "
+            "'kind': d.device_kind}))")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+        if out.returncode == 0 and out.stdout.strip():
+            info = json.loads(out.stdout.strip().splitlines()[-1])
+            if info.get("platform") == "tpu":
+                return info
+    except (subprocess.TimeoutExpired, json.JSONDecodeError):
+        pass
+    return {}
+
+
+def wait_for_backend(max_tries: Optional[int] = None,
+                     base_sleep_s: float = 30.0) -> dict:
+    """Bounded retries with exponential backoff; returns probe info
+    ({} = no TPU).  ``max_tries`` defaults to ``DPX_BENCH_PROBE_TRIES``."""
+    if max_tries is None:
+        max_tries = int(_env().get("DPX_BENCH_PROBE_TRIES"))
+    for i in range(max_tries):
+        info = probe_backend()
+        if info:
+            return info
+        if i < max_tries - 1:
+            sleep = base_sleep_s * (2 ** i)
+            print(f"# backend probe {i + 1}/{max_tries} failed; "
+                  f"retrying in {sleep:.0f}s", file=sys.stderr)
+            time.sleep(sleep)
+    return {}
+
+
+def progress(msg: str) -> None:
+    """One flushed "#"-prefixed stdout line — the progress contract every
+    on-chip stage leans on: "#" preserves the parse-last-line-as-JSON
+    collector contract, and the flush makes the line survive a collector
+    SIGKILL (block-buffered pipes lose unflushed output), so a wedged
+    stage's kept stdout tail shows exactly how far it got."""
+    print(f"# {msg}", flush=True)
+
+
+def arm(label: str, thunk: Callable):
+    """Banner-then-run: announce ``label`` via :func:`progress`, then
+    execute the zero-arg ``thunk`` and return its result.  The one
+    shared shape for multi-arm benchmark stages — the banner prints
+    BEFORE any of the arm's work (setup included), so a tunnel wedge
+    anywhere in the arm is attributed to the right label in the kept
+    stdout tail."""
+    progress(label)
+    return thunk()
+
+
+def run_json_subprocess(argv, timeout_s: int, *, label: str,
+                        env: Optional[dict] = None,
+                        keep_stdout_tail: bool = False) -> dict:
+    """Run a subprocess with a hard timeout and parse its LAST stdout
+    line as JSON.  Single implementation of the
+    parseable-record-no-matter-what contract — used by bench.py's stage
+    runner and dp8 bench, benchmarks/run_all_tpu.py, and the mfu sweep.
+    On any failure (nonzero exit, timeout, unparseable output) returns
+    an ``error`` record carrying whatever the child did produce — a
+    stage that prints its record and then exits nonzero (e.g. a failed
+    numerics validation) keeps its measurements, marked with ``error``
+    and ``rc``.  ``keep_stdout_tail`` preserves the human-readable tail
+    (tables) alongside the parsed record."""
+    _e = _env()
+    base_env = _e.environ_copy()
+    base_env["PYTHONPATH"] = (REPO + os.pathsep
+                              + (_e.raw("PYTHONPATH") or ""))
+    if env:
+        base_env.update(env)
+    if base_env.get("JAX_PLATFORMS") == "cpu":
+        # this environment's sitecustomize dials the TPU relay at EVERY
+        # python startup when PALLAS_AXON_POOL_IPS is set; a wedged
+        # tunnel then hangs even pure-CPU children before user code
+        # runs. CPU stages have no business talking to the relay.
+        base_env.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        out = subprocess.run(argv, capture_output=True, text=True,
+                             timeout=timeout_s, env=base_env)
+    except subprocess.TimeoutExpired as e:
+        # TimeoutExpired carries the partial output (text decoded when
+        # the child wrote any) — keep it: on a flaky backend the progress
+        # lines before the wedge are exactly the diagnostics needed
+        rec = {"error": f"{label} timed out after {timeout_s}s"}
+        # stdout gets a wider tail than stderr: sweep stages emit one
+        # "# ..." progress line per completed arm to stdout precisely so
+        # a timeout keeps the partial per-arm record
+        for name, cap in (("stdout", 2500), ("stderr", 800)):
+            v = getattr(e, name, None)
+            if v:
+                if isinstance(v, bytes):
+                    v = v.decode(errors="replace")
+                rec[f"{name}_tail"] = v.strip()[-cap:]
+        return rec
+
+    payload = None
+    if out.stdout.strip():
+        try:
+            payload = json.loads(out.stdout.strip().splitlines()[-1])
+        except json.JSONDecodeError:
+            payload = None
+    if isinstance(payload, dict):
+        if out.returncode != 0:
+            payload.setdefault(
+                "error", f"{label} exited rc={out.returncode}")
+            payload["rc"] = out.returncode
+    elif out.returncode == 0 and payload is not None:
+        payload = {"value": payload}
+    else:
+        payload = {"error": (out.stderr or "no parseable output")
+                   .strip()[-500:] or f"{label} produced no output"}
+    if keep_stdout_tail:
+        payload["stdout_tail"] = out.stdout.strip()[-1500:]
+    return payload

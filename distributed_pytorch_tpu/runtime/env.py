@@ -42,7 +42,8 @@ from typing import Any, Dict, Iterable, Mapping, Optional
 
 __all__ = [
     "EnvVar", "REGISTRY", "register", "get", "raw", "is_set", "set",
-    "unset", "apply_overrides", "snapshot", "restore", "generate_docs",
+    "unset", "apply_overrides", "snapshot", "restore", "environ_copy",
+    "generate_docs",
 ]
 
 
@@ -151,6 +152,16 @@ def apply_overrides(mapping: Mapping[str, str]) -> None:
 def snapshot(keys: Iterable[str]) -> Dict[str, Optional[str]]:
     """Current raw values of ``keys`` (None = unset), for :func:`restore`."""
     return {k: os.environ.get(k) for k in keys}
+
+
+def environ_copy() -> Dict[str, str]:
+    """A mutable copy of the FULL process environment, for child-process
+    construction (the benchmark subprocess runner builds each child's
+    env from this plus explicit overrides).  Passthrough by design, like
+    :func:`apply_overrides`: a child legitimately inherits variables the
+    registry has never heard of — the registry's closedness governs what
+    *this framework reads*, not what it forwards."""
+    return dict(os.environ)
 
 
 def restore(saved: Mapping[str, Optional[str]]) -> None:
@@ -279,6 +290,34 @@ register("DPX_TORCH_THREADS", "int", 8,
 register("DPX_BENCH_SELFLOG", "bool", True,
          "bench.py appends its own records to the default results log "
          "(set 0 to disable).")
+register("DPX_BENCH_TRIALS", "int", 5,
+         "Repeated-trial count of the perfbench statistical policy "
+         "(perfbench/stats.py; docs/benchmarking.md).")
+register("DPX_BENCH_WARMUP", "int", 1,
+         "Leading trials discarded as warmup before median/IQR "
+         "aggregation (the r05 dp8 cold-start artifact: 621.6 vs warm "
+         "~900 steps/s).")
+register("DPX_BENCH_MAX_SPREAD", "float", 0.15,
+         "Hard spread gate (IQR/median) above which trial stats are "
+         "marked untrusted and vs_baseline ratios are structurally "
+         "withheld (perfbench/stats.py).")
+register("DPX_BENCH_PROBE_TRIES", "int", 4,
+         "Bounded TPU-backend probe retries (exponential backoff) "
+         "before a benchmark falls back to last_good carry-forward "
+         "(perfbench/runner.py).")
+register("DPX_BENCH_AFFINITY", "int", 8,
+         "Pin benchmark processes to the first N allowed CPUs for "
+         "run-to-run comparability (0 = leave affinity alone; "
+         "perfbench/stats.pin_process — the dp8 bench child reads this, "
+         "so it actually governs the pinning it documents).")
+register("DPX_BENCH_BUDGET_S", "float", 120.0,
+         "Wall-clock budget of stats.measure_until's hunt for a "
+         "stationary trial window on a contended host (perfbench/"
+         "stats.py; the loopback dp8 smoke runs under it).")
+register("DPX_BENCH_MIN_DROP", "float", 0.10,
+         "Regression-sensitivity floor of tools/benchdiff.py: changes "
+         "smaller than this are never flagged even when spreads are "
+         "tiny.")
 
 # -- external ---------------------------------------------------------------
 register("JAX_PLATFORMS", "str", None,
@@ -314,3 +353,7 @@ register("MEGASCALE_COORDINATOR_ADDRESS", "str", None,
 register("PALLAS_AXON_POOL_IPS", "str", None,
          "Remote TPU pool tunnel of this environment; cleared in child "
          "processes that must stay local.", external=True)
+register("PYTHONPATH", "str", None,
+         "Python module search path; the benchmark subprocess runner "
+         "prepends the repo root for every child "
+         "(perfbench/runner.py).", external=True)

@@ -15,6 +15,8 @@ sys.path.insert(0, REPO)
 
 import bench  # noqa: E402
 
+from distributed_pytorch_tpu.perfbench import runner  # noqa: E402
+
 
 def test_unknown_stage_emits_json_and_rc2():
     out = subprocess.run(
@@ -23,6 +25,39 @@ def test_unknown_stage_emits_json_and_rc2():
     assert out.returncode == 2
     rec = json.loads(out.stdout.strip().splitlines()[-1])
     assert "error" in rec
+
+
+def test_import_failure_rc0_record_but_smoke_gate_fails():
+    """A perfbench import failure keeps the parseable-error-record
+    contract (rc 0) for the collector — but under --smoke, which is a
+    CI GATE, it must exit nonzero: a gate whose assertions never ran
+    must not pass green."""
+    sabotage = ("import sys, runpy; sys.argv = ['bench.py'%s]; "
+                "sys.modules['distributed_pytorch_tpu.perfbench'] = None; "
+                "runpy.run_path(%r, run_name='__main__')")
+    bench_py = os.path.join(REPO, "bench.py")
+    out = subprocess.run(
+        [sys.executable, "-c", sabotage % (", '--smoke'", bench_py)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "perfbench import failed" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "-c", sabotage % ("", bench_py)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "perfbench import failed" in rec["error"]
+    # a LIBRARY importer must see the real ImportError, not an rc-0
+    # process exit behind a flagship-metric error line
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, %r); "
+         "sys.modules['distributed_pytorch_tpu.perfbench'] = None; "
+         "import bench" % REPO],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode != 0
+    assert ("ImportError" in out.stderr
+            or "ModuleNotFoundError" in out.stderr)
 
 
 def test_run_stage_parses_last_json_line(monkeypatch):
@@ -97,8 +132,10 @@ def test_wait_for_backend_bounded(monkeypatch):
         calls.append(1)
         return {}
 
-    monkeypatch.setattr(bench, "probe_backend", fake_probe)
-    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    # the probe/wait plumbing's canonical home is perfbench.runner
+    # (bench.wait_for_backend is a compat re-export of the same function)
+    monkeypatch.setattr(runner, "probe_backend", fake_probe)
+    monkeypatch.setattr(runner.time, "sleep", lambda s: None)
     assert bench.wait_for_backend(max_tries=3, base_sleep_s=0.0) == {}
     assert len(calls) == 3
 
@@ -118,8 +155,11 @@ def test_append_and_last_good_roundtrip(tmp_path, monkeypatch):
     bench.append_result("bench_mfu", {"error": "wedged"})  # ok=False
     rows = [json.loads(l) for l in log.read_text().splitlines()]
     assert [r["ok"] for r in rows] == [True, True, False]
-    assert all(set(r) == {"stage", "ok", "wall_s", "result", "ts"}
+    # the run_all_tpu row shape, now written through the thread-safe
+    # append_event path (which stamps event/time on every line)
+    assert all(set(r) >= {"stage", "ok", "wall_s", "result", "ts"}
                for r in rows)
+    assert all(r["event"] == "bench_row" for r in rows)
 
     lg = bench.last_good_record()
     assert lg["mfu"] == 0.40 and lg["stage"] == "bench_mfu"
@@ -136,7 +176,7 @@ def test_append_and_last_good_roundtrip(tmp_path, monkeypatch):
                                        "value": 7.42}}) + "\n")
     lg = bench.last_good_record()
     assert lg["mfu"] == 0.45
-    assert lg["source"] == "benchmarks/tpu_results.jsonl"
+    assert lg["source"] == str(log)    # the store actually read
 
 
 def test_report_renders_latest_nonretracted(tmp_path):

@@ -15,8 +15,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "benchmarks"))
 
-import bench  # noqa: E402
 import run_all_tpu  # noqa: E402
+
+from distributed_pytorch_tpu.perfbench import runner  # noqa: E402
 
 
 def _wire(monkeypatch, tmp_path, *, probe_script, stage_fails,
@@ -30,7 +31,9 @@ def _wire(monkeypatch, tmp_path, *, probe_script, stage_fails,
     monkeypatch.setattr(run_all_tpu, "watch_for_backend",
                         lambda *a, **k: (calls.__setitem__(
                             "watch", calls["watch"] + 1) or watch_healthy))
-    monkeypatch.setattr(bench, "wait_for_backend",
+    # run_all_tpu consumes the probe/wait plumbing from perfbench.runner
+    # (bench.py re-exports the same functions for compat)
+    monkeypatch.setattr(runner, "wait_for_backend",
                         lambda **k: {"kind": "fake-tpu"})
 
     def fake_probe(timeout_s=120):
@@ -38,7 +41,7 @@ def _wire(monkeypatch, tmp_path, *, probe_script, stage_fails,
         calls["probe"] += 1
         return probe_script[i] if i < len(probe_script) else True
 
-    monkeypatch.setattr(bench, "probe_backend", fake_probe)
+    monkeypatch.setattr(runner, "probe_backend", fake_probe)
 
     def fake_stage(name, cmd, timeout_s, env=None):
         calls["stages"].append(name)

@@ -1,0 +1,161 @@
+"""benchdiff CLI — diff a benchmark record against the BENCH trajectory.
+
+Usage::
+
+    python -m tools.benchdiff                     # newest stored record
+                                                  # vs the rows before it
+    python -m tools.benchdiff --record rec.json   # explicit new record
+    python -m tools.benchdiff --record -          # record on stdin
+    python -m tools.benchdiff --log FILE          # non-default store
+    python -m tools.benchdiff --min-drop 0.05     # sensitivity floor
+    python -m tools.benchdiff --strict            # malformed store lines
+                                                  # are fatal
+
+Compares every trusted *measured* metric of the new record against the
+newest trusted measured baseline for the same metric in the trajectory
+store (``benchmarks/tpu_results.jsonl``) and prints an attributed
+report.  A change counts as a regression only when it exceeds
+``max(min_drop, baseline spread, new spread)`` in the metric's worse
+direction — the same spread gate that governs ``vs_baseline``
+(docs/benchmarking.md).
+
+Exit codes: 0 = no regression, 1 = regression (CI fails the bench-smoke
+job on this), 2 = usage / invalid record / corrupt store in --strict.
+
+Like ``tools/dpxlint.py``, this deliberately avoids the heavy package
+``__init__`` (which pulls jax): the perfbench record/trajectory modules
+are stdlib-only and load against fabricated lightweight parent packages,
+so the diff runs in a bare CI job in milliseconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load_perfbench():
+    """Import the perfbench modules.  The REAL package is tried first —
+    a fabricated skeleton left in sys.modules would permanently shadow
+    the genuine package __init__ for the rest of the process.  Only
+    when the real import chain fails (a bare venv where the package
+    __init__ pulls jax) are lightweight parent packages fabricated so
+    the stdlib-only perfbench modules resolve against the source tree.
+
+    NOT shared with benchmarks/report.py's private-name loader on
+    purpose: trajectory.diff's default min_drop resolves through
+    ``..runtime.env``, which only works under the real package name —
+    fine for this CLI-owned process, unacceptable for report.py, which
+    must never import the real package (jax-free watcher contract) and
+    therefore loads record-only under a private name."""
+    import importlib
+    import types
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    try:
+        return importlib.import_module("distributed_pytorch_tpu.perfbench")
+    except Exception:  # noqa: BLE001 — bare venv: the __init__ chain needs jax
+        pass
+    pkg_dir = os.path.join(root, "distributed_pytorch_tpu")
+    for name, sub in (("distributed_pytorch_tpu", ""),
+                      ("distributed_pytorch_tpu.runtime", "runtime"),
+                      ("distributed_pytorch_tpu.utils", "utils")):
+        if name not in sys.modules:
+            pkg = types.ModuleType(name)
+            pkg.__path__ = [os.path.join(pkg_dir, sub) if sub
+                            else pkg_dir]
+            sys.modules[name] = pkg
+    return importlib.import_module("distributed_pytorch_tpu.perfbench")
+
+
+def main(argv=None) -> int:
+    pb = _load_perfbench()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    default_log = os.path.join(root, "benchmarks", "tpu_results.jsonl")
+
+    ap = argparse.ArgumentParser(prog="benchdiff", description=__doc__)
+    ap.add_argument("--log", default=default_log,
+                    help="trajectory store (default: "
+                         "benchmarks/tpu_results.jsonl)")
+    ap.add_argument("--record", default=None, metavar="FILE|-",
+                    help="new record to diff (JSON file, or - for "
+                         "stdin); default: the newest schema record in "
+                         "the store, diffed against the rows before it")
+    ap.add_argument("--min-drop", type=float, default=None,
+                    help="sensitivity floor (default: "
+                         "DPX_BENCH_MIN_DROP)")
+    ap.add_argument("--strict", action="store_true",
+                    help="malformed trajectory lines / invalid records "
+                         "are fatal (exit 2)")
+    args = ap.parse_args(argv)
+
+    try:
+        rows, malformed = pb.record.iter_rows(args.log,
+                                              strict=args.strict)
+    except pb.RecordInvalid as e:
+        print(f"benchdiff: {e}", file=sys.stderr)
+        return 2
+    for line_no, reason in malformed:
+        print(f"# benchdiff: skipping malformed store line {line_no}: "
+              f"{reason}", file=sys.stderr)
+
+    if args.record is not None:
+        try:
+            text = (sys.stdin.read() if args.record == "-"
+                    else open(args.record, encoding="utf-8").read())
+            new_rec = json.loads(text)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"benchdiff: cannot read record: {e}", file=sys.stderr)
+            return 2
+        # bench.py self-logs its record to the store by default — if the
+        # record under test already landed there, diffing it against its
+        # own row would mask every regression as "unchanged 0%"
+        base_rows = [r for r in rows if r.get("result") != new_rec]
+    else:
+        # newest schema record in the store is "new"; everything before
+        # its row is the baseline trajectory.  Row-level ok is not
+        # required: an unmeasured-flagship record logs ok=false, but its
+        # trusted measured metrics (the per-blob gate decides) must
+        # still be regression-checked — on a TPU-less container these
+        # are the only fresh numbers there are.
+        idx = None
+        for i, row in enumerate(rows):
+            res = row.get("result", {})
+            if (not row.get("retracted") and isinstance(res, dict)
+                    and res.get("schema") == pb.record.SCHEMA):
+                idx = i
+        if idx is None:
+            print("benchdiff: no schema records in the trajectory yet — "
+                  "nothing to compare")
+            return 0
+        new_rec = rows[idx]["result"]
+        base_rows = rows[:idx]
+
+    issues = pb.record.validate_record(new_rec, strict=False)
+    if issues:
+        msg = (f"benchdiff: new record fails schema validation: "
+               + "; ".join(issues[:5]))
+        print(msg, file=sys.stderr)
+        if args.strict:
+            return 2
+        print("# benchdiff: diffing what can be diffed anyway "
+              "(non-strict)", file=sys.stderr)
+
+    report = pb.trajectory.diff(new_rec, base_rows,
+                                min_drop=args.min_drop)
+    print(report.format())
+    print(json.dumps({
+        "regressions": len(report.regressions),
+        "improvements": len(report.improvements),
+        "unchanged": len(report.unchanged),
+        "skipped": len(report.skipped),
+        "ok": report.ok,
+    }))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
