@@ -88,8 +88,9 @@ def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int, tiled: bool
 # (comm/wire.py is the single source of truth) — exported so bucketing
 # callers (parallel/data_parallel._reduce_grads) can pad each leaf to a
 # block multiple and keep scale blocks from spanning leaves
-from .wire import (QUANT_BLOCK, quant_ring_allreduce_wire_bytes,  # noqa: E402,F401
-                   quant_wire_bytes, ring_allreduce_wire_bytes)
+from .wire import (QUANT_BLOCK, quant_leg_wire_bytes,  # noqa: E402,F401
+                   quant_ring_allreduce_wire_bytes, quant_wire_bytes,
+                   ring_allreduce_wire_bytes)
 
 
 def quantized_pmean_wire_bytes(n: int, world: int,
@@ -155,6 +156,62 @@ def quantized_pmean(x, axis_name: str, *, block: int = QUANT_BLOCK):
     if pad:
         out = out[:size]
     return out.reshape(shape).astype(dtype)
+
+
+def quantized_reduce_scatter(x, axis_name: str, *, block: int = QUANT_BLOCK):
+    """Bandwidth-compressed (int8) reduce-scatter SUM over a mesh axis —
+    LOSSY; the scatter half of :func:`quantized_pmean`.
+
+    ``x``: a FLAT f32 vector whose length is a multiple of
+    ``world * block`` (the :mod:`..optim.sharded` layout guarantees
+    this). Each device symmetrically int8-quantizes its world
+    chunk-rows (one f32 scale per ``block`` elements), exchanges them
+    with ``all-to-all``, and dequantize-accumulates ITS chunk in f32.
+    Returns this device's ``(len(x)/world,)`` chunk of the SUM (callers
+    divide by world for a mean). One quantization step of error per
+    contribution; int8 + scales on the wire instead of f32."""
+    n = int(lax.psum(1, axis_name))
+    if n == 1:
+        return x
+    from ..ops.quant import dequantize_grad_blocks, quantize_grad_blocks
+
+    size = x.shape[0]
+    if size % (n * block):
+        raise ValueError(
+            f"quantized_reduce_scatter needs len(x) divisible by "
+            f"world*block = {n * block}, got {size}")
+    nb = size // (n * block)
+    q, scale = quantize_grad_blocks(x.astype(jnp.float32)
+                                    .reshape(n, nb, block))
+    rq = all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+    rs = all_to_all(scale, axis_name, split_axis=0, concat_axis=0)
+    return jnp.sum(dequantize_grad_blocks(rq, rs), axis=0).ravel()
+
+
+def quantized_all_gather(x, axis_name: str, *, block: int = QUANT_BLOCK):
+    """Bandwidth-compressed (int8) all-gather over a mesh axis — LOSSY
+    but BIT-IDENTICAL on every device: each device quantizes its flat
+    chunk once, the int8 codes + scales are all-gathered, and every
+    device (the owner included) decodes the same bytes — so replicated
+    values rebuilt from sharded updates cannot drift across devices.
+    ``x``: a flat f32 chunk whose length is a multiple of ``block``.
+    Returns the ``(world * len(x),)`` concatenation in axis order.
+    A 1-device axis is a NO-OP (exact, no grid snap) — the same
+    contract as ``dpx_allgather_q8`` and the numpy leg spec."""
+    n = int(lax.psum(1, axis_name))
+    if x.shape[0] % block:
+        raise ValueError(
+            f"quantized_all_gather needs len(x) divisible by block = "
+            f"{block}, got {x.shape[0]}")
+    if n == 1:
+        return x.astype(jnp.float32)
+    from ..ops.quant import dequantize_grad_blocks, quantize_grad_blocks
+
+    q, scale = quantize_grad_blocks(x.astype(jnp.float32)
+                                    .reshape(-1, block))
+    gq = all_gather(q[None], axis_name, axis=0, tiled=True)
+    gs = all_gather(scale[None], axis_name, axis=0, tiled=True)
+    return dequantize_grad_blocks(gq, gs).ravel()
 
 
 def axis_index(axis_name: str):

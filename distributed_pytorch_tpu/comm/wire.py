@@ -194,9 +194,110 @@ def quant_ring_allreduce_wire_bytes(n: int, world: int,
     return total
 
 
+def quant_leg_wire_bytes(n: int, world: int, block: int = QUANT_BLOCK) -> int:
+    """Total wire bytes (all ranks) of ONE leg of the quantized ring —
+    ``dpx_reduce_scatter_q8`` or ``dpx_allgather_q8`` each move exactly
+    half of :func:`quant_ring_allreduce_wire_bytes` (every segment
+    travels world-1 hops once per leg)."""
+    if world <= 1:
+        return 0
+    total = 0
+    for start, cnt in segment_blocks(n, world, block):
+        total += (world - 1) * span_wire_bytes(start, cnt, n, block)
+    return total
+
+
+def ring_owned_span(n: int, world: int, rank: int,
+                    block: int = QUANT_BLOCK) -> Tuple[int, int]:
+    """(element offset, element count) of the segment rank ``rank`` OWNS
+    after the ring reduce-scatter leg — segment ``(rank+1) % world`` of
+    the block-aligned grid (the same ownership convention as
+    ``native/dpxhost.cpp``'s ring schedule)."""
+    seg = (rank + 1) % world
+    start, cnt = segment_blocks(n, world, block)[seg]
+    return block_span_elems(start, cnt, n, block)
+
+
 # ---------------------------------------------------------------------------
 # executable spec: the quantized ring, simulated in numpy
 # ---------------------------------------------------------------------------
+
+
+def _seg_spans(n: int, w: int, block: int) -> List[slice]:
+    """Per-segment element slices, computed ONCE per simulation (the
+    hop loops index it O(world^2) times)."""
+    out = []
+    for start, cnt in segment_blocks(n, w, block):
+        lo, elems = block_span_elems(start, cnt, n, block)
+        out.append(slice(lo, lo + elems))
+    return out
+
+
+def simulate_quant_reduce_scatter(per_rank: Sequence[np.ndarray],
+                                  block: int = QUANT_BLOCK
+                                  ) -> Tuple[List[np.ndarray], int]:
+    """The reduce-scatter LEG of the quantized ring, simulated.
+
+    ``per_rank``: one equal-shape array per rank. Returns ``(buffers,
+    wire_bytes)`` where ``buffers[r]`` is rank r's FLAT working buffer
+    after the leg: the span :func:`ring_owned_span` ``(n, w, r)`` holds
+    the full (lossily accumulated) SUM of that segment; every other span
+    holds a partial accumulation (undefined to callers — exactly the
+    ``dpx_reduce_scatter_q8`` contract, bit for bit)."""
+    w = len(per_rank)
+    data = [np.ascontiguousarray(x, dtype=np.float32).ravel().copy()
+            for x in per_rank]
+    n = data[0].size
+    if w == 1:
+        return data, 0
+    spans = _seg_spans(n, w, block)
+    bytes_moved = 0
+    # quantize the outgoing f32 partial each hop, receiver dequantize-
+    # accumulates (all sends of a step happen "at once": quantize from
+    # the pre-step snapshot, like the real ring)
+    for step in range(w - 1):
+        sends = {}
+        for r in range(w):
+            send_seg = (r - step) % w
+            q, s = quantize_blocks(data[r][spans[send_seg]], block)
+            sends[r] = (q, s)
+            bytes_moved += q.size + SCALE_BYTES * s.size
+        for r in range(w):
+            recv_seg = (r - step - 1) % w
+            q, s = sends[(r - 1) % w]
+            data[r][spans[recv_seg]] += dequantize_blocks(q, s, block)
+    return data, bytes_moved
+
+
+def simulate_quant_allgather(per_rank: Sequence[np.ndarray],
+                             block: int = QUANT_BLOCK
+                             ) -> Tuple[List[np.ndarray], int]:
+    """The byte-forwarding all-gather LEG of the quantized ring,
+    simulated. Rank r contributes the span :func:`ring_owned_span`
+    ``(n, w, r)`` of its flat buffer; afterwards every rank's buffer is
+    BIT-IDENTICAL (each span is the dequantized grid of its owner's
+    bytes, owner included). Mirrors ``dpx_allgather_q8`` bit for bit."""
+    w = len(per_rank)
+    data = [np.ascontiguousarray(x, dtype=np.float32).ravel().copy()
+            for x in per_rank]
+    n = data[0].size
+    if w == 1:
+        return data, 0
+    spans = _seg_spans(n, w, block)
+    bytes_moved = 0
+    wires = {}
+    for r in range(w):
+        own = (r + 1) % w
+        q, s = quantize_blocks(data[r][spans[own]], block)
+        wires[own] = (q, s)
+        data[r][spans[own]] = dequantize_blocks(q, s, block)
+    for step in range(w - 1):
+        for r in range(w):
+            recv_seg = (r - step) % w
+            q, s = wires[recv_seg]
+            data[r][spans[recv_seg]] = dequantize_blocks(q, s, block)
+            bytes_moved += q.size + SCALE_BYTES * s.size
+    return data, bytes_moved
 
 
 def simulate_quant_ring(per_rank: Sequence[np.ndarray],
@@ -211,47 +312,12 @@ def simulate_quant_ring(per_rank: Sequence[np.ndarray],
     bit-identical to ``dpx_allreduce_q8``, so this doubles as the parity
     oracle for the native path — and all results are bit-identical
     across ranks by construction of the byte-forwarding all-gather leg.
-    """
-    w = len(per_rank)
+    Composed from the two standalone leg simulations, exactly like the
+    native op is (``dpx_allreduce_q8`` == reduce-scatter + all-gather)."""
     shape = per_rank[0].shape
-    data = [np.ascontiguousarray(x, dtype=np.float32).ravel().copy()
-            for x in per_rank]
-    n = data[0].size
-    if w == 1:
-        return [data[0].reshape(shape)], 0
-    segs = segment_blocks(n, w, block)
-    bytes_moved = 0
-
-    def span(seg):
-        lo, cnt = block_span_elems(segs[seg][0], segs[seg][1], n, block)
-        return slice(lo, lo + cnt)
-
-    # reduce-scatter: quantize the outgoing f32 partial each hop,
-    # receiver dequantize-accumulates (all sends of a step happen "at
-    # once": quantize from the pre-step snapshot, like the real ring)
-    for step in range(w - 1):
-        sends = {}
-        for r in range(w):
-            send_seg = (r - step) % w
-            q, s = quantize_blocks(data[r][span(send_seg)], block)
-            sends[r] = (q, s)
-            bytes_moved += q.size + SCALE_BYTES * s.size
-        for r in range(w):
-            recv_seg = (r - step - 1) % w
-            q, s = sends[(r - 1) % w]
-            data[r][span(recv_seg)] += dequantize_blocks(q, s, block)
-
-    # all-gather: owner quantizes once; bytes forwarded unchanged
-    wires = {}
-    for r in range(w):
-        own = (r + 1) % w
-        q, s = quantize_blocks(data[r][span(own)], block)
-        wires[own] = (q, s)
-        data[r][span(own)] = dequantize_blocks(q, s, block)
-    for step in range(w - 1):
-        for r in range(w):
-            recv_seg = (r - step) % w
-            q, s = wires[recv_seg]
-            data[r][span(recv_seg)] = dequantize_blocks(q, s, block)
-            bytes_moved += q.size + SCALE_BYTES * s.size
-    return [d.reshape(shape) for d in data], bytes_moved
+    if len(per_rank) == 1:
+        return [np.ascontiguousarray(per_rank[0], dtype=np.float32)
+                .reshape(shape).copy()], 0
+    data, rs_bytes = simulate_quant_reduce_scatter(per_rank, block)
+    data, ag_bytes = simulate_quant_allgather(data, block)
+    return [d.reshape(shape) for d in data], rs_bytes + ag_bytes

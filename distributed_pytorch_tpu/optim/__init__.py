@@ -182,8 +182,8 @@ def adafactor(lr: float = None, *, decay_pow: float = 0.8,
     return Optimizer(init, update)
 
 
-# Schedules/transforms import Optimizer from this module, so they load
-# after it is defined.
+# Schedules/transforms (and the sharded-update subsystem) import
+# Optimizer from this module, so they load after it is defined.
 from . import schedules  # noqa: E402
 from .schedules import (accumulate, clip_by_global_norm, constant,  # noqa: E402
                         cosine_decay, ema_params, linear_warmup,
@@ -340,3 +340,10 @@ def adamw_8bit(lr: float, b1: float = 0.9, b2: float = 0.999,
                                          nu=pick("v"))
 
     return Optimizer(init, update)
+
+
+# Cross-replica sharded weight update (ZeRO-1) — loads last: it wraps
+# Optimizer and builds on the quantized-ring comm layer.
+from . import sharded  # noqa: E402
+from .sharded import (FlatLayout, ShardedOptimizer,  # noqa: E402,F401
+                      ShardedOptState, build_layout, shard_optimizer)

@@ -1,0 +1,74 @@
+"""optim/sharded — cross-replica sharded weight update (ZeRO-1) on the
+quantized ring.
+
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (arXiv 2004.13336) applied to this repo's two comm front
+doors: the data-parallel update stops being ``allreduce(grads) ->
+replicated optimizer step`` (every rank burning the same update FLOPs
+and holding the full optimizer state) and becomes::
+
+    reduce-scatter(grads)  ->  local step on the owned 1/world slice
+                           ->  all-gather(updated params)
+
+Same total wire bytes as the allreduce it replaces (the allreduce IS
+those two legs), ~1/world the optimizer-state memory and update compute
+per replica. On the host TCP ring both legs ride the PR 1 block-int8
+wire (``dpx_reduce_scatter_q8`` / ``dpx_allgather_q8``, CRC32C-framed,
+chunk-pipelined, deadline-guarded, error-feedback on both legs); under
+the mesh they are ``psum_scatter`` / ``all_gather`` (optionally
+quantized via the same block codec). See ``docs/optimizer_sharding.md``.
+
+Public surface:
+
+* :func:`build_layout` / :class:`FlatLayout` — the shared flat-bucket
+  coordinate system (block-aligned, equal segments, ckpt-portable via
+  ``pad_multiple``);
+* :func:`shard_optimizer` / :class:`ShardedOptimizer` /
+  :class:`ShardedOptState` — wrap any elementwise ``Optimizer``
+  unchanged;
+* :func:`make_sharded_train_step` — front-door dispatch; reached from
+  ``parallel.make_train_step(..., weight_update="sharded")``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .. import Optimizer
+from .layout import FlatLayout, build_layout, lcm_pad_multiple
+from .optimizer import ShardedOptimizer, ShardedOptState, shard_optimizer
+
+__all__ = [
+    "FlatLayout", "build_layout", "lcm_pad_multiple",
+    "ShardedOptimizer", "ShardedOptState", "shard_optimizer",
+    "make_sharded_train_step",
+]
+
+
+def make_sharded_train_step(loss_fn: Callable, optimizer: Optimizer,
+                            donate: bool = True,
+                            grad_reduce: str = "mean",
+                            pad_multiple: Optional[int] = None
+                            ) -> Callable:
+    """The ``weight_update="sharded"`` engine behind
+    :func:`...parallel.make_train_step`: dispatches to the host-ring
+    engine when a native process group is live, else to the compiled
+    SPMD engine (which also covers world == 1 with the same state
+    structure). The returned step carries ``init_opt_state(params)``
+    (build the sharded state) and, on the SPMD engine,
+    ``state_specs(opt_state)`` (the checkpoint-facing PartitionSpecs).
+    """
+    from ...runtime import context
+
+    if context.get_host_comm() is not None:
+        from .host import make_host_sharded_train_step
+        if pad_multiple is not None:
+            raise ValueError(
+                "pad_multiple applies to the SPMD/global-state engine; "
+                "the host engine derives its layout from the live world")
+        return make_host_sharded_train_step(loss_fn, optimizer,
+                                            grad_reduce=grad_reduce)
+    from .spmd import make_spmd_sharded_train_step
+    return make_spmd_sharded_train_step(loss_fn, optimizer, donate=donate,
+                                        grad_reduce=grad_reduce,
+                                        pad_multiple=pad_multiple)

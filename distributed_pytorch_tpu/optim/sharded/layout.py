@@ -1,0 +1,178 @@
+"""Flat cross-replica shard layout — the coordinate system of the
+sharded weight update (arXiv 2004.13336).
+
+The sharded optimizer does not shard per-leaf (that is the GSPMD/FSDP
+road, :mod:`...parallel.fsdp`): it flattens the whole param/grad tree
+into ONE f32 bucket and shards the bucket's index space evenly across
+the ``world`` replicas — the same single-bucket shape the quantized
+ring collectives already move (``parallel/data_parallel._reduce_grads``
+buckets exactly like this). The layout is the contract both front doors
+share:
+
+* every leaf is zero-padded to a :data:`~...comm.wire.QUANT_BLOCK`
+  multiple, so no quantization-scale block ever spans two leaves (a
+  tiny layernorm grad must never share a scale with an embedding
+  grad's tail);
+* the bucket tail is zero-padded to a multiple of ``pad_multiple``
+  (default ``world * block``), which makes every replica's segment the
+  same length AND block-aligned — so the equal-segment grid the SPMD
+  ``psum_scatter`` needs and the block grid the native ring
+  (``comm/wire.py:segment_blocks``) computes are the SAME grid;
+* padding is zeros and stays zeros: gradients of padding are zero, and
+  every supported (elementwise) optimizer maps zero-grad/zero-param to
+  zero-param, so the pad region never contaminates real elements.
+
+``pad_multiple`` is the cross-topology knob: a layout built with
+``pad_multiple = lcm(world_a, world_b) * block`` produces the same
+global flat length at both worlds, so a sharded-optimizer checkpoint
+written at dp=world_a restores onto dp=world_b through the ordinary
+resharding restore (:mod:`...ckpt`) with no conversion step — the flat
+state leaves are 1-D arrays sharded ``P(axis)`` and the reader just
+re-slices them.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ...comm import wire as _wire
+
+
+class FlatLayout(NamedTuple):
+    """Frozen description of how a pytree maps onto the flat bucket."""
+
+    treedef: Any                 # jax treedef of the source pytree
+    shapes: Tuple[Tuple[int, ...], ...]   # per-leaf shapes
+    dtypes: Tuple[Any, ...]      # per-leaf dtypes (restored on unflatten)
+    offsets: Tuple[int, ...]     # per-leaf start offset in the bucket
+    sizes: Tuple[int, ...]       # per-leaf true element counts
+    n_padded: int                # total bucket length (all padding in)
+    world: int
+    block: int
+
+    @property
+    def seg(self) -> int:
+        """Elements per replica segment (equal by construction)."""
+        return self.n_padded // self.world
+
+    def span(self, seg_index: int) -> Tuple[int, int]:
+        """(lo, hi) element range of segment ``seg_index``."""
+        lo = seg_index * self.seg
+        return lo, lo + self.seg
+
+    def ring_segment(self, rank: int) -> int:
+        """The segment ``rank`` OWNS under the native ring's schedule
+        (segment ``(rank+1) % world`` — ``dpx_reduce_scatter_q8``'s
+        ownership convention, which the equal grid makes identical to
+        ``comm/wire.py:ring_owned_span``)."""
+        return (rank + 1) % self.world
+
+    # -- flatten / unflatten -----------------------------------------------
+
+    def flatten_np(self, tree) -> np.ndarray:
+        """Tree -> flat f32 numpy bucket (host front door)."""
+        leaves = self.treedef.flatten_up_to(tree)
+        out = np.zeros(self.n_padded, np.float32)
+        for leaf, off, size in zip(leaves, self.offsets, self.sizes):
+            out[off:off + size] = np.asarray(
+                leaf, dtype=np.float32).ravel()
+        return out
+
+    def flatten_jnp(self, tree):
+        """Tree -> flat f32 jnp bucket (traceable; SPMD front door)."""
+        import jax.numpy as jnp
+        leaves = self.treedef.flatten_up_to(tree)
+        parts = []
+        cursor = 0
+        for leaf, off, size in zip(leaves, self.offsets, self.sizes):
+            if off > cursor:  # inter-leaf pad
+                parts.append(jnp.zeros(off - cursor, jnp.float32))
+            parts.append(jnp.ravel(leaf).astype(jnp.float32))
+            cursor = off + size
+        if cursor < self.n_padded:
+            parts.append(jnp.zeros(self.n_padded - cursor, jnp.float32))
+        return jnp.concatenate(parts)
+
+    def unflatten_jnp(self, flat):
+        """Flat bucket -> tree (leaf dtypes restored)."""
+        import jax
+        leaves = []
+        for shape, dtype, off, size in zip(self.shapes, self.dtypes,
+                                           self.offsets, self.sizes):
+            leaves.append(flat[off:off + size].reshape(shape)
+                          .astype(dtype))
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    # -- sharding specs -----------------------------------------------------
+
+    def state_specs(self, state, axis: str = "dp"):
+        """PartitionSpec tree for a flat-bucket optimizer state: 1-D
+        leaves whose length divides evenly across ``world`` (the flat
+        moments, masters, int8 code vectors, per-block scale vectors)
+        shard along ``axis``; everything else (step counters)
+        replicates. This is the ``opt_specs`` the sharded checkpoint
+        writer (:class:`...ckpt.CheckpointManager`) consumes — the
+        resharding restore then absorbs the sharded moments for free."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def pick(x):
+            shape = tuple(getattr(x, "shape", ()) or ())
+            if (len(shape) == 1 and shape[0] > 0
+                    and shape[0] % self.world == 0):
+                return P(axis)
+            return P()
+
+        return jax.tree_util.tree_map(pick, state)
+
+
+def build_layout(params, world: int, *, block: int = _wire.QUANT_BLOCK,
+                 pad_multiple: Optional[int] = None) -> FlatLayout:
+    """Build the :class:`FlatLayout` of ``params`` for ``world``
+    replicas. ``pad_multiple`` (elements) overrides the default
+    ``world * block`` tail padding — pass ``lcm(worlds) * block`` when a
+    checkpoint must restore across topology changes (see module doc)."""
+    import jax
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    if pad_multiple is None:
+        pad_multiple = world * block
+    if pad_multiple % (world * block):
+        raise ValueError(
+            f"pad_multiple ({pad_multiple}) must be a multiple of "
+            f"world*block ({world * block}) so segments stay equal and "
+            f"block-aligned")
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if not leaves:
+        raise ValueError("cannot build a shard layout for an empty tree")
+    shapes, dtypes, offsets, sizes = [], [], [], []
+    off = 0
+    for leaf in leaves:
+        shape = tuple(np.shape(leaf))
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        shapes.append(shape)
+        # bare Python scalar leaves have no .dtype; np.asarray only for
+        # those (device arrays must not take a host round-trip here),
+        # canonicalized so a Python float restores as f32 under jax's
+        # default x64-disabled config instead of warning every step
+        dtypes.append(leaf.dtype if hasattr(leaf, "dtype")
+                      else jax.dtypes.canonicalize_dtype(
+                          np.asarray(leaf).dtype))
+        offsets.append(off)
+        sizes.append(size)
+        off += size + ((-size) % block)   # per-leaf pad to a block edge
+    n_padded = off + ((-off) % pad_multiple)
+    return FlatLayout(treedef=treedef, shapes=tuple(shapes),
+                      dtypes=tuple(dtypes), offsets=tuple(offsets),
+                      sizes=tuple(sizes), n_padded=n_padded,
+                      world=world, block=block)
+
+
+def lcm_pad_multiple(worlds: List[int],
+                     block: int = _wire.QUANT_BLOCK) -> int:
+    """The ``pad_multiple`` under which every world in ``worlds`` builds
+    the same global flat length (checkpoint-portable layouts)."""
+    return math.lcm(*[int(w) for w in worlds]) * block

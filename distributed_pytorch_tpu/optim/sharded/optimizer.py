@@ -1,0 +1,118 @@
+"""``shard_optimizer`` — wrap any elementwise :class:`...optim.Optimizer`
+so its state and update live on 1/world of the flat param bucket.
+
+The wrapper changes NOTHING about the optimizer's arithmetic: the inner
+``(init, update)`` pair runs verbatim on a flat f32 vector instead of
+the param tree. Because every supported optimizer is **elementwise**
+(each element's update depends only on that element's grad, param and
+moments — ``sgd``, ``adamw``, ``adamw_8bit``; NOT ``adafactor``, whose
+factored moments couple rows/columns, and NOT global-norm clipping
+wrappers), updating a slice of the bucket is bit-identical to updating
+the whole bucket and slicing — which is what the numerical-equivalence
+acceptance test pins.
+
+State shape (:class:`ShardedOptState`):
+
+* ``inner`` — the wrapped optimizer's state over the flat bucket (or a
+  slice of it): param-shaped moments become 1-D f32 vectors, step
+  counters stay scalars.
+* ``master`` — the exact f32 value of the owned params. This is the
+  error-feedback residual of the quantized all-gather leg in disguise:
+  the replicated working params hold the int8-grid value every rank
+  decoded, the master keeps the exact value, and the next step updates
+  the master — so the one-quantization-step gap between them
+  (``|master - working| <= scale/2`` per block) never compounds across
+  steps, exactly like the PR 1 grad-ring residual.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+from .. import Optimizer
+from . import layout as _layout
+
+
+class ShardedOptState(NamedTuple):
+    inner: Any      # wrapped optimizer's state over the flat bucket
+    master: Any     # exact f32 owned params (flat)
+
+
+def _reject_non_elementwise(inner_state) -> None:
+    """Turn the detectable non-elementwise case into a typed error
+    instead of silent numerical corruption: adafactor's factored
+    moments couple rows/columns, so a flat-slice update computes
+    DIFFERENT (wrong) statistics while appearing to train. Detected by
+    its state type at init. (Global-norm clipping wrappers are equally
+    unsupported — the norm is a cross-shard reduction — but they reuse
+    the inner state type and cannot be detected structurally; that
+    restriction stays documentation, docs/optimizer_sharding.md.)"""
+    import jax
+
+    from .. import AdafactorState
+    is_af = lambda x: isinstance(x, AdafactorState)
+    if any(is_af(n) for n in
+           jax.tree_util.tree_leaves(inner_state, is_leaf=is_af)):
+        raise TypeError(
+            "shard_optimizer requires an ELEMENTWISE optimizer "
+            "(sgd/adamw/adamw_8bit): adafactor's factored second "
+            "moments couple rows and columns and cannot be updated on "
+            "a flat 1/world slice — keep weight_update='replicated', "
+            "or use parallel.make_zero1_train_step, whose per-leaf "
+            "specs keep the factored vectors intact")
+
+
+class ShardedOptimizer(NamedTuple):
+    """The sharded face of an :class:`...optim.Optimizer`: same
+    ``(init, update)`` contract, but over flat f32 slices. Engines
+    (:mod:`.host`, :mod:`.spmd`) move the bytes; this only does math."""
+
+    inner: Optimizer
+    layout: _layout.FlatLayout
+
+    def init_flat(self, flat_params) -> ShardedOptState:
+        """State over a flat f32 vector — the FULL bucket for the
+        single-controller/SPMD global state (leaves then shard along the
+        mesh axis via :meth:`FlatLayout.state_specs`), or one rank's
+        slice for the host front door."""
+        inner_state = self.inner.init(flat_params)
+        _reject_non_elementwise(inner_state)
+        return ShardedOptState(inner=inner_state, master=flat_params)
+
+    def init_global(self, params) -> ShardedOptState:
+        """State over the whole flat bucket of ``params``."""
+        import jax.numpy as jnp
+        flat = jnp.asarray(self.layout.flatten_np(params))
+        return self.init_flat(flat)
+
+    def init_slice(self, params, rank: int) -> ShardedOptState:
+        """State over the segment ``rank`` owns on the native ring."""
+        import jax.numpy as jnp
+        flat = self.layout.flatten_np(params)
+        lo, hi = self.layout.span(self.layout.ring_segment(rank))
+        return self.init_flat(jnp.asarray(flat[lo:hi]))
+
+    def update_flat(self, g_flat, state: ShardedOptState
+                    ) -> Tuple[Any, ShardedOptState]:
+        """One optimizer step on a flat slice: ``g_flat`` is the MEAN
+        gradient of the owned elements; returns ``(new_master,
+        new_state)``. Pure and traceable — engines jit it."""
+        new_master, new_inner = self.inner.update(
+            g_flat, state.inner, state.master)
+        return new_master, ShardedOptState(inner=new_inner,
+                                           master=new_master)
+
+    def state_specs(self, state: ShardedOptState, axis: str = "dp"):
+        """PartitionSpec tree of a global flat state (ckpt-facing)."""
+        return self.layout.state_specs(state, axis=axis)
+
+
+def shard_optimizer(opt: Optimizer,
+                    layout: _layout.FlatLayout) -> ShardedOptimizer:
+    """Wrap ``opt`` (an elementwise ``Optimizer`` NamedTuple, unchanged)
+    for the cross-replica sharded weight update over ``layout``."""
+    if not isinstance(opt, Optimizer):
+        raise TypeError(
+            f"shard_optimizer wraps an optim.Optimizer NamedTuple, got "
+            f"{type(opt).__name__}")
+    return ShardedOptimizer(inner=opt, layout=layout)

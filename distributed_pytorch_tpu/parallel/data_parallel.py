@@ -56,7 +56,8 @@ class StatefulStepOutput(NamedTuple):
 
 def make_train_step(loss_fn: Callable, optimizer: Optimizer,
                     donate: bool = True,
-                    grad_reduce: str = "mean") -> Callable:
+                    grad_reduce: str = "mean",
+                    weight_update: Optional[str] = None) -> Callable:
     """Compile a data-parallel training step.
 
     ``loss_fn(params, batch) -> (loss, metrics)`` where ``loss`` is the
@@ -78,10 +79,30 @@ def make_train_step(loss_fn: Callable, optimizer: Optimizer,
     (``dpx_allreduce_q8``) with an error-feedback residual
     (:class:`..ops.quant.ErrorFeedback`) carrying each step's
     quantization error into the next step's bucket.
+
+    ``weight_update``: ``"replicated"`` (every rank runs the full
+    optimizer step — DDP/torch semantics) or ``"sharded"`` (ZeRO-1,
+    arXiv 2004.13336: reduce-scatter the grads, step only the owned
+    1/world slice, all-gather the updated params — 1/world optimizer
+    memory and update compute; :mod:`..optim.sharded`). Defaults to the
+    typed env knob ``DPX_WEIGHT_UPDATE``. The sharded step's
+    ``opt_state`` comes from the returned step's
+    ``init_opt_state(params)``, not ``optimizer.init`` — the moments
+    live on flat 1/world slices.
     """
     if grad_reduce not in ("mean", "int8", "quant"):
         raise ValueError(f"grad_reduce must be mean|quant|int8, "
                          f"got {grad_reduce!r}")
+    if weight_update is None:
+        from ..runtime import env as _env
+        weight_update = _env.get("DPX_WEIGHT_UPDATE")
+    if weight_update not in ("replicated", "sharded"):
+        raise ValueError(f"weight_update must be replicated|sharded, "
+                         f"got {weight_update!r}")
+    if weight_update == "sharded":
+        from ..optim.sharded import make_sharded_train_step
+        return make_sharded_train_step(loss_fn, optimizer, donate=donate,
+                                       grad_reduce=grad_reduce)
     world = context.get_world_size()
     if context.get_host_comm() is not None:
         return _make_host_train_step(loss_fn, optimizer,

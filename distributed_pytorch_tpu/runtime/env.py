@@ -280,6 +280,11 @@ register("DPX_ELASTIC_TEST_LEAK", "str", None,
          "the supervisor (tests/test_elastic.py).")
 
 # -- torch front door / benches --------------------------------------------
+register("DPX_WEIGHT_UPDATE", "str", "replicated",
+         "Default weight-update mode of `parallel.make_train_step`: "
+         "`replicated` (every rank runs the full optimizer step) or "
+         "`sharded` (ZeRO-1 reduce-scatter/local-step/all-gather on "
+         "the quantized ring, docs/optimizer_sharding.md).")
 register("DPX_GRAD_REDUCE", "str", "mean",
          "Default gradient-reduction wire of the torch-compat DDP "
          "wrapper: `mean` (exact) or `quant` (block-int8 ring, "
@@ -314,6 +319,10 @@ register("DPX_BENCH_BUDGET_S", "float", 120.0,
          "Wall-clock budget of stats.measure_until's hunt for a "
          "stationary trial window on a contended host (perfbench/"
          "stats.py; the loopback dp8 smoke runs under it).")
+register("DPX_BENCH_SHARDED_ELEMS", "int", 0,
+         "Bucket elements of the dp8_sharded_adam bench arm (0 = the "
+         "full-size default; the CI smoke sets a small bucket to stay "
+         "seconds-scale — bench.py).")
 register("DPX_BENCH_MIN_DROP", "float", 0.10,
          "Regression-sensitivity floor of tools/benchdiff.py: changes "
          "smaller than this are never flagged even when spreads are "

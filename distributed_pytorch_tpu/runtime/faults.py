@@ -32,7 +32,11 @@ everything):
 - ``rank``    — the calling rank (passed by the hook call sites).
 - ``op``      — the comm op name; specs carrying ``op`` fire from
   :func:`on_comm_op` (the :class:`~.native.HostComm` methods call it
-  before every native collective). The CHECKPOINT save path fires three
+  before every native collective — see :data:`COMM_OPS` for the
+  registered names; the sharded weight update adds ``reduce_scatter``
+  and ``allgather``, so ``kill@op=reduce_scatter`` dies entering the
+  grad scatter and ``kill@op=allgather`` entering the param gather of a
+  ZeRO-1 step). The CHECKPOINT save path fires three
   ops of its own (``utils/checkpoint.py`` + ``ckpt/writer.py``):
   ``op=ckpt`` at shard/tree write entry, ``op=ckpt_commit`` at commit
   entry, and ``op=ckpt_commit_window`` between the two commit renames —
@@ -92,6 +96,16 @@ KILL_EXIT_CODE = 43
 
 _ACTIONS = ("kill", "delay", "drop_conn", "diverge")
 _INT_KEYS = ("step", "rank", "call", "ms", "attempt")
+
+#: Comm-layer op names that fire op-scoped specs from :func:`on_comm_op`
+#: (the HostComm hook sites; informational — the grammar accepts any op
+#: string, this is the registry of names the runtime actually emits).
+#: ``reduce_scatter``/``allgather`` are the sharded-weight-update legs
+#: (optim/sharded/); ``ckpt*`` ops fire from the checkpoint save path
+#: and ``serve_step`` from the serving engine's iteration hook.
+COMM_OPS = ("allreduce", "allreduce_q8", "reduce_scatter", "allgather",
+            "reduce", "gather", "broadcast", "barrier",
+            "ckpt", "ckpt_commit", "ckpt_commit_window", "serve_step")
 
 
 @dataclass

@@ -153,6 +153,12 @@ def load_library():
                                          ctypes.c_int64, ctypes.c_int,
                                          ctypes.c_int]
         lib.dpx_allreduce_q8.restype = ctypes.c_int
+        for name in ("dpx_reduce_scatter_q8", "dpx_allgather_q8"):
+            fn = getattr(lib, name)
+            fn.argtypes = [ctypes.c_void_p,
+                           ctypes.POINTER(ctypes.c_float),
+                           ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+            fn.restype = ctypes.c_int
         lib.dpx_reduce_f32.argtypes = [ctypes.c_void_p,
                                        ctypes.POINTER(ctypes.c_float),
                                        ctypes.c_int64]
@@ -355,6 +361,58 @@ class HostComm:
                 arr.size, block, chunk_blocks)
         self._check(rc, "allreduce_q8")
         return arr
+
+    def reduce_scatter_q8(self, arr: np.ndarray, block: int = None,
+                          chunk_blocks: int = None) -> np.ndarray:
+        """In-place QUANTIZED ring reduce-scatter (sum) on a float32
+        array — the first leg of :meth:`allreduce_q8` alone.
+
+        On return, this rank's :func:`~..comm.wire.ring_owned_span`
+        holds the reduced sum; every other span holds a partial
+        accumulation (undefined). Half the allreduce's wire bytes. The
+        weight-update half of the ZeRO-1 recipe runs between this and
+        :meth:`allgather_q8` (optim/sharded/)."""
+        block = block or self._wire.QUANT_BLOCK
+        chunk_blocks = chunk_blocks or self._wire.QUANT_CHUNK_BLOCKS
+        arr = np.ascontiguousarray(arr, dtype=np.float32)
+        self._pre_op("reduce_scatter", dtype="float32",
+                     size=int(arr.size), extra=f"q8,block={block}")
+        nbytes = self._wire.quant_leg_wire_bytes(
+            arr.size, self.world, block) // max(self.world, 1)
+        with self.stats.timed("reduce_scatter", nbytes):
+            rc = self._lib.dpx_reduce_scatter_q8(
+                self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                arr.size, block, chunk_blocks)
+        self._check(rc, "reduce_scatter")
+        return arr
+
+    def allgather_q8(self, arr: np.ndarray, block: int = None,
+                     chunk_blocks: int = None) -> np.ndarray:
+        """In-place QUANTIZED ring all-gather on a float32 array — the
+        byte-forwarding second leg of :meth:`allreduce_q8` alone.
+
+        This rank contributes its :func:`~..comm.wire.ring_owned_span`;
+        afterwards the full buffer is BIT-IDENTICAL on every rank (each
+        span decodes its owner's forwarded bytes, owner included)."""
+        block = block or self._wire.QUANT_BLOCK
+        chunk_blocks = chunk_blocks or self._wire.QUANT_CHUNK_BLOCKS
+        arr = np.ascontiguousarray(arr, dtype=np.float32)
+        self._pre_op("allgather", dtype="float32", size=int(arr.size),
+                     extra=f"q8,block={block}")
+        nbytes = self._wire.quant_leg_wire_bytes(
+            arr.size, self.world, block) // max(self.world, 1)
+        with self.stats.timed("allgather", nbytes):
+            rc = self._lib.dpx_allgather_q8(
+                self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                arr.size, block, chunk_blocks)
+        self._check(rc, "allgather")
+        return arr
+
+    def owned_span(self, n: int, block: int = None):
+        """(offset, count) of the flat span this rank owns after
+        :meth:`reduce_scatter_q8` of an n-element buffer."""
+        block = block or self._wire.QUANT_BLOCK
+        return self._wire.ring_owned_span(n, self.world, self.rank, block)
 
     def reduce(self, arr: np.ndarray) -> np.ndarray:
         """Rooted sum to rank 0 (non-root buffers unchanged)."""

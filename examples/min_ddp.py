@@ -73,7 +73,6 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
 
     # Optimizer and loss (min_DDP.py:74-75)
     optimizer = optim.adamw(0.0001)
-    opt_state = dist.replicate(optimizer.init(params))
 
     def loss_fn(p, batch):
         x, y = batch
@@ -84,6 +83,11 @@ def main_worker(rank, world_size, argv=None, quiet=False, history=None):
         return per_ex.mean(), {"correct": correct, "preds": preds}
 
     step_fn = make_train_step(loss_fn, optimizer)
+    # sharded weight update (DPX_WEIGHT_UPDATE=sharded): the step owns
+    # its flat 1/world state layout; replicated keeps optimizer.init
+    opt_state = (step_fn.init_opt_state(params)
+                 if hasattr(step_fn, "init_opt_state")
+                 else dist.replicate(optimizer.init(params)))
 
     if not quiet:
         print("Run epochs")

@@ -34,6 +34,14 @@ def parse_args(argv=None):
                         help="gradient wire: exact f32 ring, or the "
                              "block-int8 quantized ring (~4x less TCP "
                              "traffic, error-feedback compensated)")
+    parser.add_argument("--weight-update", default=None,
+                        choices=("replicated", "sharded"),
+                        help="optimizer update: replicated on every "
+                             "rank (DDP semantics) or ZeRO-1 sharded "
+                             "over the ring — 1/world optimizer memory "
+                             "and update compute "
+                             "(docs/optimizer_sharding.md; defaults to "
+                             "DPX_WEIGHT_UPDATE)")
     return parser.parse_args(argv)
 
 
@@ -66,7 +74,6 @@ def main_worker(rank, world_size, argv=None):
                               n_classes=args.n_classes)
     params = model.init(jax.random.PRNGKey(0))
     optimizer = optim.adamw(0.0001)
-    opt_state = optimizer.init(params)
     # ctor broadcast parity: rank 0's initial params win (here all ranks
     # init identically from the same seed; sync_params makes it explicit)
     leaves, tree = jax.tree_util.tree_flatten(params)
@@ -82,7 +89,13 @@ def main_worker(rank, world_size, argv=None):
                                "preds": jnp.argmax(logits, -1)}
 
     step_fn = make_train_step(loss_fn, optimizer,
-                              grad_reduce=args.grad_reduce)
+                              grad_reduce=args.grad_reduce,
+                              weight_update=args.weight_update)
+    # a sharded step owns its state layout (flat 1/world slices) — ask
+    # it; the replicated step keeps the classic optimizer.init
+    opt_state = (step_fn.init_opt_state(params)
+                 if hasattr(step_fn, "init_opt_state")
+                 else optimizer.init(params))
 
     print("Run epochs") if rank == 0 else None
     for epoch in range(args.epochs):

@@ -34,6 +34,12 @@
 //     ~4x less traffic than the f32 ring (int8 + one f32 scale per
 //     block); numerics are LOSSY (bounded by one quantization step per
 //     hop) and mirrored bit-for-bit by comm/wire.py:simulate_quant_ring.
+//   * reduce_scatter_q8 / allgather_q8 (f32): the two legs of the
+//     quantized ring exported standalone, so a ZeRO-style sharded
+//     optimizer (optim/sharded/) can run its local weight update between
+//     them — reduce-scatter grads, update the owned 1/W slice, all-gather
+//     the updated params. Composed back to back they are dpx_allreduce_q8
+//     bit for bit; each leg moves half the allreduce's wire bytes.
 //   * reduce (to 0), gather (to 0), broadcast (from src), barrier: hub.
 //     Rooted ops stay reference-exact full-width — the quantized format
 //     is never applied to them.
@@ -861,14 +867,18 @@ int q8_hop(Comm* c, const QGrid& g, float* data, int chunk_blocks,
 
 }  // namespace
 
-// Quantized ring allreduce (sum) on f32 data, in place. `block` elements
-// share one f32 scale; `chunk_blocks` blocks form one pipelined wire
-// chunk. Result is bit-identical on every rank (all-gather leg decodes
-// identical forwarded bytes) and bit-identical to
-// comm/wire.py:simulate_quant_ring.
-int dpx_allreduce_q8(void* handle, float* data, int64_t n, int block,
-                     int chunk_blocks) {
-  Comm* c = static_cast<Comm*>(handle);
+// The quantized ring's two legs, selectable. ``do_rs`` runs the
+// reduce-scatter leg (after it rank r's span of segment (r+1)%w holds
+// the full lossily-accumulated SUM; the other spans hold partial
+// accumulations — callers treat them as undefined). ``do_ag`` runs the
+// byte-forwarding all-gather leg (each segment owner quantizes its span
+// ONCE, adopts the dequantized value itself, and every rank decodes the
+// identical forwarded bytes). Running both back to back under one
+// deadline is exactly dpx_allreduce_q8, bit for bit — the standalone
+// legs exist so a sharded optimizer can run its local update between
+// them (optim/sharded/).
+static int q8_collective(Comm* c, float* data, int64_t n, int block,
+                         int chunk_blocks, bool do_rs, bool do_ag) {
   if (c->aborted) return kErr;  // contract: aborted beats the no-op path
   if (c->world == 1 || n == 0) return 0;
   if (block <= 0 || chunk_blocks <= 0) return kErr;
@@ -895,14 +905,17 @@ int dpx_allreduce_q8(void* handle, float* data, int64_t n, int block,
   // reduce-scatter: quantize the f32 partial of the outgoing segment
   // each hop; receiver dequantize-accumulates. After w-1 steps rank r
   // holds the full (lossily accumulated) sum of segment (r+1)%w.
-  for (int step = 0; step < w - 1; step++) {
-    int send_seg = (c->rank - step + w) % w;
-    int recv_seg = (c->rank - step - 1 + w) % w;
-    int rc = q8_hop(c, g, data, static_cast<int>(cb), send_seg, nullptr,
-                    recv_seg, /*assign=*/false, sbuf.data(), rbuf.data(),
-                    nullptr, deadline);
-    if (rc != kOk) return rc;
+  if (do_rs) {
+    for (int step = 0; step < w - 1; step++) {
+      int send_seg = (c->rank - step + w) % w;
+      int recv_seg = (c->rank - step - 1 + w) % w;
+      int rc = q8_hop(c, g, data, static_cast<int>(cb), send_seg, nullptr,
+                      recv_seg, /*assign=*/false, sbuf.data(), rbuf.data(),
+                      nullptr, deadline);
+      if (rc != kOk) return rc;
+    }
   }
+  if (!do_ag) return kOk;
 
   // all-gather: owner quantizes its reduced segment ONCE, replaces its
   // own f32 copy with the dequantized value, and the bytes are forwarded
@@ -953,6 +966,42 @@ int dpx_allreduce_q8(void* handle, float* data, int64_t n, int block,
     fwd.swap(keep);
   }
   return kOk;
+}
+
+// Quantized ring allreduce (sum) on f32 data, in place. `block` elements
+// share one f32 scale; `chunk_blocks` blocks form one pipelined wire
+// chunk. Result is bit-identical on every rank (all-gather leg decodes
+// identical forwarded bytes) and bit-identical to
+// comm/wire.py:simulate_quant_ring.
+int dpx_allreduce_q8(void* handle, float* data, int64_t n, int block,
+                     int chunk_blocks) {
+  return q8_collective(static_cast<Comm*>(handle), data, n, block,
+                       chunk_blocks, /*do_rs=*/true, /*do_ag=*/true);
+}
+
+// Quantized ring reduce-scatter (sum) on f32 data, in place: the first
+// leg of dpx_allreduce_q8 alone. On return, rank r's span of segment
+// (r+1)%w (comm/wire.py:segment_blocks grid) holds the reduced sum;
+// every other span holds a partial accumulation and must be treated as
+// undefined. Half the wire bytes of the full allreduce.
+int dpx_reduce_scatter_q8(void* handle, float* data, int64_t n, int block,
+                          int chunk_blocks) {
+  return q8_collective(static_cast<Comm*>(handle), data, n, block,
+                       chunk_blocks, /*do_rs=*/true, /*do_ag=*/false);
+}
+
+// Quantized ring all-gather on f32 data, in place: the second leg of
+// dpx_allreduce_q8 alone. Rank r contributes its span of segment
+// (r+1)%w; after the w-1 forwarding hops every rank holds the identical
+// full buffer (each span is the dequantized grid of its owner's bytes —
+// the owner adopts the same grid value, so ranks are bit-identical by
+// construction). World==1 is a no-op (the exact local value beats a
+// gratuitous grid snap — callers that need grid parity quantize
+// explicitly).
+int dpx_allgather_q8(void* handle, float* data, int64_t n, int block,
+                     int chunk_blocks) {
+  return q8_collective(static_cast<Comm*>(handle), data, n, block,
+                       chunk_blocks, /*do_rs=*/false, /*do_ag=*/true);
 }
 
 // Rooted reduce (sum) to rank 0 via the hub. Non-root buffers unchanged

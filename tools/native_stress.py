@@ -66,6 +66,12 @@ def load(lib_path: str):
     lib.dpx_allreduce_q8.argtypes = [ctypes.c_void_p, f32p,
                                      ctypes.c_int64, ctypes.c_int,
                                      ctypes.c_int]
+    lib.dpx_reduce_scatter_q8.argtypes = [ctypes.c_void_p, f32p,
+                                          ctypes.c_int64, ctypes.c_int,
+                                          ctypes.c_int]
+    lib.dpx_allgather_q8.argtypes = [ctypes.c_void_p, f32p,
+                                     ctypes.c_int64, ctypes.c_int,
+                                     ctypes.c_int]
     lib.dpx_reduce_f32.argtypes = [ctypes.c_void_p, f32p, ctypes.c_int64]
     lib.dpx_gather.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                ctypes.c_int64, ctypes.c_char_p]
@@ -75,7 +81,8 @@ def load(lib_path: str):
     lib.dpx_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.dpx_crc32c.restype = ctypes.c_uint32
     for f in ("dpx_allreduce_f32_op", "dpx_allreduce_f64_op",
-              "dpx_allreduce_q8", "dpx_reduce_f32", "dpx_gather",
+              "dpx_allreduce_q8", "dpx_reduce_scatter_q8",
+              "dpx_allgather_q8", "dpx_reduce_f32", "dpx_gather",
               "dpx_broadcast", "dpx_barrier"):
         getattr(lib, f).restype = ctypes.c_int
     return lib
@@ -143,6 +150,16 @@ def worker(lib_path: str, base_port: int, rank: int, world: int,
             if rank == 0:
                 check(len(set(rbuf.tolist())) == 1,
                       f"q8 results not bit-identical: {rbuf}")
+
+            # the ring's two legs standalone (sharded-update dataflow):
+            # composed they must equal dpx_allreduce_q8 bit for bit
+            s2 = base[rank].copy()
+            check(lib.dpx_reduce_scatter_q8(h, f32ptr(s2), n, 64, 4)
+                  == 0, "reduce_scatter_q8 rc")
+            check(lib.dpx_allgather_q8(h, f32ptr(s2), n, 64, 4) == 0,
+                  "allgather_q8 rc")
+            check(np.array_equal(s2, q),
+                  f"rs+ag != allreduce_q8 at n={n}")
 
             # rooted reduce + broadcast round trip
             r = np.full(n, float(rank), np.float32)
