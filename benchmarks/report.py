@@ -236,6 +236,41 @@ def render(rows) -> str:
         lines += render_gated(sr)
         lines.append("")
 
+    sv = res("serve_shared")
+    sh = (sv.get("arms") or {}).get("engine_paged_shared") or {}
+    un = (sv.get("arms") or {}).get("engine_unshared_open") or {}
+    if sh:
+        pages = sh.get("pages", {})
+        lines += ["", "Shared-prefix serving (paged KV, stage "
+                  "serve_shared; gated medians — docs/serving.md):", "",
+                  "| arm | TTFT p50 (ms) | TTFT p99 (ms) | tokens/s |",
+                  "|---|---|---|---|",
+                  f"| paged+shared | {_fmt(sh.get('ttft_ms_p50', 0))} | "
+                  f"{_fmt(sh.get('ttft_ms_p99', 0))} | "
+                  f"{_fmt(sh.get('tokens_per_sec', 0))} |"]
+        if un:
+            lines.append(
+                f"| unshared | {_fmt(un.get('ttft_ms_p50', 0))} | "
+                f"{_fmt(un.get('ttft_ms_p99', 0))} | "
+                f"{_fmt(un.get('tokens_per_sec', 0))} |")
+        lines.append("")
+        hr = pages.get("prefix_hit_rate")
+        lines.append(
+            f"Prefix hit rate {_fmt(hr, 3) if hr is not None else 'n/a'}"
+            f" ({pages.get('prefix_hit_pages', 0)} pages), "
+            f"prefill tokens saved "
+            f"{_fmt(sh.get('prefill_tokens_saved', 0), 0)}, pool "
+            f"occupancy {_fmt(pages.get('pool_occupancy', 0), 3)} "
+            f"({pages.get('evictions', 0)} evictions).")
+        if "vs_unshared_ttft_p50_x" in sv:
+            lines.append(f"vs_unshared TTFT p50: "
+                         f"**{_fmt(float(sv['vs_unshared_ttft_p50_x']))}x**"
+                         " (both sides passed the spread gate).")
+        elif "vs_unshared_ttft_p50_withheld" in sv:
+            lines.append(f"vs_unshared TTFT p50 **withheld**: "
+                         f"{_truncate_words(sv['vs_unshared_ttft_p50_withheld'])}")
+        lines.append("")
+
     smoke = res("mfu_smoke")
     if smoke.get("step_ms_median") is not None:
         lines.append(

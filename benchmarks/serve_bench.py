@@ -24,14 +24,26 @@ warmup-discarded repeated trials, tokens/s is the median with IQR and
 the hard spread gate attached, and the engine-vs-static throughput
 ratio is structurally withheld (with the gate's reason) when either
 side comes back untrusted. The printed line is a schema-valid
-``dpx.bench.record`` (perfbench/record.py). ``--smoke`` shrinks
-everything to a seconds-scale CPU run AND asserts engine streams equal
-standalone ``generate()`` — the CI job that keeps the engine loop from
+``dpx.bench.record`` (perfbench/record.py).
+
+The **shared-prefix arm** (serve/pages/, docs/serving.md) runs the same
+seeded Poisson open loop over K "system prompts" round-robined across
+N requests, paged+prefix-shared vs the unshared engine: TTFT p50/p99 as
+gated medians, ``prefill_tokens_saved``, pool occupancy and hit rate,
+and a ``vs_unshared_ttft_p50_x`` ratio withheld-or-printed per the
+spread-gate policy; non-smoke runs append the record to
+``benchmarks/tpu_results.jsonl`` (stage ``serve_shared``).
+
+``--smoke`` shrinks everything to a seconds-scale CPU run AND asserts
+engine streams equal standalone ``generate()`` (both engines), that the
+shared arm's hit rate is > 0 with ``prefill_tokens_saved`` exactly the
+analytic count for the synthetic population, and that the paged engine
+kept ONE decode program — the CI job that keeps the engine loop from
 rotting (tier1.yml).
 
 Usage: python benchmarks/serve_bench.py [--smoke] [--slots N]
            [--requests N] [--rate R] [--max-new N] [--seed S]
-           [--trials N] [--warmup N]
+           [--trials N] [--warmup N] [--prefixes K] [--prefix-len N]
 """
 
 from __future__ import annotations
@@ -77,13 +89,39 @@ def make_requests(n, vocab, max_new, seed, uniform=False):
     return out
 
 
-def run_engine(model, params, reqs, n_slots, max_len, rate=None, seed=0):
+def make_shared_requests(n, vocab, max_new, seed, k_prefixes, prefix_len,
+                         tail_max):
+    """The shared-prefix serving population: ``k_prefixes`` "system
+    prompts" of ``prefix_len`` tokens round-robined over ``n`` requests,
+    each with a private random tail — the consumer-traffic shape the
+    paged prefix cache exists for (the first occurrence of each prefix
+    is cold, every later one shares its full pages)."""
+    import jax
+    from distributed_pytorch_tpu.serve import SamplingParams
+    rng = np.random.default_rng(seed + 7)
+    prefixes = [rng.integers(0, vocab, (prefix_len,)).astype(np.int32)
+                for _ in range(k_prefixes)]
+    out = []
+    for i in range(n):
+        t = int(rng.integers(1, tail_max + 1))
+        prompt = np.concatenate(
+            [prefixes[i % k_prefixes],
+             rng.integers(0, vocab, (t,))]).astype(np.int32)
+        out.append((prompt, SamplingParams(max_new_tokens=max_new),
+                    jax.random.PRNGKey(2000 + i)))
+    return out
+
+
+def run_engine(model, params, reqs, n_slots, max_len, rate=None, seed=0,
+               paged=False, page_len=None, prefix_share=True):
     """Submit ``reqs`` (closed loop, or Poisson open loop at ``rate``)
     and aggregate per-request SLO records."""
     from distributed_pytorch_tpu.serve import (EngineConfig,
                                                InferenceEngine, aggregate)
     eng = InferenceEngine(model, params,
-                          EngineConfig(n_slots=n_slots, max_len=max_len))
+                          EngineConfig(n_slots=n_slots, max_len=max_len,
+                                       paged=paged, page_len=page_len,
+                                       prefix_share=prefix_share))
     rng = np.random.default_rng(seed)
     handles = []
     t0 = time.monotonic()
@@ -95,9 +133,12 @@ def run_engine(model, params, reqs, n_slots, max_len, rate=None, seed=0):
         outs = [h.result(timeout=600) for h in handles]
     wall = time.monotonic() - t0
     rep = aggregate([h.metrics for h in handles], wall_s=wall)
-    rep["stats"] = {k: v for k, v in eng.stats().items()
+    st = eng.stats()
+    rep["stats"] = {k: v for k, v in st.items()
                     if k in ("iterations", "decode_compiles",
                              "prefill_compiles", "sample_compiles")}
+    if paged:
+        rep["pages"] = st["pages"]
     return rep, outs
 
 
@@ -137,20 +178,53 @@ def run_static(model, params, reqs, n_slots, max_len):
     return aggregate(records, wall_s=time.monotonic() - t0)
 
 
+def measured_stats(run_once, keys, *, warmup, trials,
+                   absent_as_zero=("prefill_tokens_saved",)):
+    """``measured_arm`` generalized to several scalar keys — the
+    shared-prefix latency arms gate TTFT p50/p99 medians (and the
+    deterministic prefill-savings count), not tokens/s.
+
+    A key missing from a trial rep is a HARD error (KeyError), never a
+    silent 0 — for a lower-is-better latency a fabricated 0 would be a
+    perfect trusted median, exactly the null-laundering the perfbench
+    schema forbids.  The one exception is ``absent_as_zero``:
+    ``aggregate()`` legitimately omits ``prefill_tokens_saved`` when
+    nothing was saved, and 0 is its honest (direction=higher,
+    pessimistic) value."""
+    from distributed_pytorch_tpu.perfbench import stats as pbstats
+    reps = [run_once() for _ in range(warmup + trials)]
+    sts = {}
+    for k in keys:
+        vals = []
+        for i, r in enumerate(reps):
+            v = r.get(k)
+            if v is None:
+                if k in absent_as_zero:
+                    v = 0
+                else:
+                    raise KeyError(
+                        f"metric {k!r} absent from trial {i}'s aggregate "
+                        f"— refusing to launder a missing measurement "
+                        f"into a 0")
+            vals.append(v)
+        sts[k] = pbstats.summarize(vals, warmup=warmup)
+    rep = dict(reps[-1])
+    for k, st in sts.items():
+        rep[k] = round(st.median, 2)
+        rep[k + "_trials"] = st.to_dict(nd=2)
+    return rep, sts
+
+
 def measured_arm(run_once, *, warmup, trials):
     """Repeated-trial wrapper for one throughput arm: runs ``run_once``
     (returning an aggregate rep with ``tokens_per_sec``) ``warmup +
     trials`` times under the perfbench policy.  The first trial pays the
     arm's jit compiles — exactly the cold-start artifact the warmup
     discard exists for.  Returns ``(last rep + trials detail, stats)``."""
-    from distributed_pytorch_tpu.perfbench import stats as pbstats
-    reps = [run_once() for _ in range(warmup + trials)]
-    st = pbstats.summarize([r["tokens_per_sec"] for r in reps],
-                           warmup=warmup)
-    rep = dict(reps[-1])
-    rep["tokens_per_sec"] = round(st.median, 2)
-    rep["tokens_per_sec_trials"] = st.to_dict(nd=2)
-    return rep, st
+    rep, sts = measured_stats(run_once, ("tokens_per_sec",),
+                              warmup=warmup, trials=trials,
+                              absent_as_zero=())
+    return rep, sts["tokens_per_sec"]
 
 
 def main(argv):
@@ -262,12 +336,115 @@ def main(argv):
         rec["engine_vs_static_ttft_p50_x"] = round(
             st["ttft_ms_p50"] / en["ttft_ms_p50"], 2)
 
+    # ---- shared-prefix paged arm (serve/pages/, ROADMAP item 4) ----
+    # K "system prompts" round-robined over N requests, seeded Poisson
+    # open loop: the paged+prefix-shared engine vs the unshared engine
+    # on the SAME population/arrivals. TTFT p50/p99 go through the
+    # spread-gate policy; vs_unshared is withheld with the gate's
+    # reason when either side comes back untrusted.
+    k_prefixes = flag("--prefixes", 3 if smoke else 8)
+    prefix_len = flag("--prefix-len", 16 if smoke else 128)
+    page_len = 8 if smoke else 16
+    tail_max = 7 if smoke else 32
+    shared_reqs = make_shared_requests(n_req, model.vocab, max_new, seed,
+                                       k_prefixes, prefix_len, tail_max)
+    rec["config"].update({"k_prefixes": k_prefixes,
+                          "prefix_len": prefix_len,
+                          "page_len": page_len, "tail_max": tail_max})
+    first_shared = {}
+
+    def shared_once():
+        rep, outs = run_engine(model, params, shared_reqs, n_slots,
+                               max_len, rate=rate, seed=seed + 2,
+                               paged=True, page_len=page_len)
+        first_shared.setdefault("outs", outs)
+        first_shared.setdefault("rep", rep)
+        return rep
+
+    shared_rep, shared_st = measured_stats(
+        shared_once,
+        ("ttft_ms_p50", "ttft_ms_p99", "prefill_tokens_saved"),
+        warmup=warmup, trials=trials)
+    rec["arms"]["engine_paged_shared"] = shared_rep
+    unshared_rep, unshared_st = measured_stats(
+        lambda: run_engine(model, params, shared_reqs, n_slots, max_len,
+                           rate=rate, seed=seed + 2)[0],
+        ("ttft_ms_p50", "ttft_ms_p99"), warmup=warmup, trials=trials)
+    rec["arms"]["engine_unshared_open"] = unshared_rep
+    for name, stx in (
+            ("serve_shared_ttft_ms_p50", shared_st["ttft_ms_p50"]),
+            ("serve_shared_ttft_ms_p99", shared_st["ttft_ms_p99"]),
+            ("serve_unshared_ttft_ms_p50", unshared_st["ttft_ms_p50"]),
+            ("serve_unshared_ttft_ms_p99", unshared_st["ttft_ms_p99"]),
+            ("serve_prefill_tokens_saved",
+             shared_st["prefill_tokens_saved"])):
+        rec["metrics"][name] = pbrecord.make_metric(
+            None, "ms" if "ttft" in name else "tokens", stats=stx,
+            direction="lower" if "ttft" in name else "higher")
+    pages = first_shared["rep"]["pages"]
+    rec["metrics"]["serve_paged_pool_occupancy"] = pbrecord.make_metric(
+        round(pages["pool_occupancy"], 4), "frac")
+    rec["metrics"]["serve_paged_prefix_hit_rate"] = pbrecord.make_metric(
+        round(pages["prefix_hit_rate"] or 0.0, 4), "frac")
+    # TTFT is lower-better, so the speedup ratio is unshared/shared
+    vs, why = pbstats.gated_ratio(unshared_st["ttft_ms_p50"],
+                                  shared_st["ttft_ms_p50"])
+    if vs is not None:
+        rec["vs_unshared_ttft_p50_x"] = round(vs, 2)
+    else:
+        rec["vs_unshared_ttft_p50_withheld"] = why
+
+    if smoke:
+        # the shared-prefix CI gates (tier1.yml): sharing must actually
+        # happen, save EXACTLY the analytic token count for this
+        # synthetic population ((n-k) repeats x prefix_len — smoke
+        # tails are < one page so nothing else can be indexed), keep
+        # the one-decode-program discipline, and stay bit-exact
+        problems = []
+        hit_rate = pages["prefix_hit_rate"] or 0.0
+        if not hit_rate > 0:
+            problems.append(f"prefix hit rate {hit_rate} not > 0")
+        analytic = (n_req - k_prefixes) * prefix_len
+        got_saved = first_shared["rep"].get("prefill_tokens_saved", 0)
+        if got_saved != analytic:
+            problems.append(f"prefill_tokens_saved {got_saved} != "
+                            f"analytic {analytic}")
+        if first_shared["rep"]["stats"]["decode_compiles"] != 1:
+            problems.append(
+                f"paged decode_compiles "
+                f"{first_shared['rep']['stats']['decode_compiles']} != 1")
+        import jax
+        import jax.numpy as jnp
+        from distributed_pytorch_tpu.models.generate import make_generate_fn
+        for i in (0, k_prefixes, n_req - 1):   # cold + shared samples
+            prompt, sp, key = shared_reqs[i]
+            ref = np.asarray(jax.jit(make_generate_fn(
+                model, sp.max_new_tokens, max_len=max_len))(
+                params, jnp.asarray(prompt[None]), key))[0]
+            if not np.array_equal(first_shared["outs"][i], ref):
+                problems.append(f"shared request {i} diverged from "
+                                f"standalone generate()")
+        if problems:
+            print(json.dumps({"bench": "serve", "error":
+                              "; ".join(problems)}))
+            return 1
+        rec["shared_prefix_gates"] = {
+            "prefix_hit_rate": round(hit_rate, 4),
+            "prefill_tokens_saved": got_saved, "analytic": analytic,
+            "engine_matches_generate": True}
+
     issues = pbrecord.validate_record(rec, strict=False)
     if issues:
         rec["schema_issues"] = issues
         print(f"# WARNING: serve record failed schema self-validation: "
               f"{'; '.join(issues[:3])}", file=sys.stderr)
     print(json.dumps(rec))
+    if not smoke and dpxenv.get("DPX_BENCH_SELFLOG"):
+        # real (non-CI) runs land in the trajectory store so the
+        # shared-prefix TTFT numbers join the BENCH record trail
+        pbrecord.append_row(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tpu_results.jsonl"), "serve_shared", rec)
     return 0
 
 

@@ -81,6 +81,8 @@ TYPED_ERRORS: Dict[str, Tuple[str, ...]] = {
     "RequestDeadlineExceeded": ("request_id", "iteration", "deadline_ms",
                                 "stage"),
     "EngineStopped": ("request_id", "iteration"),
+    "PagePoolExhausted": ("request_id", "iteration", "needed",
+                          "free_pages"),
     "WorkerFailure": ("rank", "exitcode", "op", "kind"),
 }
 

@@ -303,6 +303,190 @@ def decode_step_slots(model: TransformerLM, params: Params, ks, vs,
     return model.project_vocab(params, x)[:, 0], new_k, new_v
 
 
+def _gather_pages(pool, tables):
+    """Gather a slot batch's pages into contiguous rows.
+
+    pool: (n_pages, Hkv, page_len, Dh); tables: (B, P) int32 page ids
+    (unallocated entries may hold any valid id — the caller's position
+    mask hides them). Returns (B, Hkv, P*page_len, Dh)."""
+    g = pool[tables]                       # (B, P, Hkv, page_len, Dh)
+    b, p, h, l, d = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, h, p * l, d)
+
+
+def decode_step_slots_paged(model: TransformerLM, params: Params,
+                            k_pages, v_pages, tables, lengths, tokens,
+                            active, *, page_len: int
+                            ) -> Tuple[jnp.ndarray, list, list]:
+    """One decode step over a PAGED slot pool (``serve/pages/``).
+
+    The paged counterpart of :func:`decode_step_slots`: instead of each
+    slot owning a contiguous (max_len) cache row, K/V live in a shared
+    block pool — per layer ``(n_pages, Hkv, page_len, Dh)`` — and each
+    slot addresses its pages through ``tables`` (B, P) int32. Slots can
+    therefore SHARE full pages (a refcounted common prefix is resident
+    once); sharing is safe because shared pages are immutable — decode
+    only ever writes each slot's private tail page.
+
+    Per-row math is exactly :func:`decode_step_slots`'s: the row's
+    logical cache is the page gather (positions ``j`` at page
+    ``tables[b, j // page_len]`` offset ``j % page_len``), the new K/V
+    is written at ``lengths[b]`` (a pool scatter into the slot's tail
+    page; ``active=False`` rows scatter out of bounds and are dropped,
+    so a freed slot's stale table cannot be corrupted), and the position
+    mask exposes ``<= lengths[b]``. ``tables``/``lengths``/``tokens``/
+    ``active`` are all traced — ONE compiled program serves every
+    request mix and every page-table state.
+
+    Returns ``(logits (B, vocab), new_k_pages, new_v_pages)``; host-side
+    page allocation (growing a table at page boundaries) and length
+    bookkeeping belong to the caller."""
+    idx = lengths
+    n_pages = k_pages[0].shape[0]
+    width = tables.shape[1] * page_len
+    x = model.tok.apply(params["tok"], tokens[:, None])       # (B,1,D)
+    if getattr(model, "pos", None) is not None:
+        x = x + model.pos.apply(params["pos"], idx[:, None])
+    scale = 1.0 / math.sqrt(model.dim // model.n_heads)
+    pos_mask = jnp.arange(width)[None, :] <= idx[:, None]
+    write_mask = (jnp.arange(width)[None, :]
+                  == idx[:, None])[:, None, :, None]          # (B,1,W,1)
+    # pool write target: the slot's page holding position idx. Inactive
+    # rows are routed out of bounds (index n_pages) and dropped.
+    wp = jnp.take_along_axis(tables, (idx // page_len)[:, None],
+                             axis=1)[:, 0]
+    wo = idx % page_len
+    dest = jnp.where(active, wp, n_pages)
+
+    new_kp, new_vp = [], []
+    for i, blk in enumerate(model.blocks):
+        p = params["blocks"][i]
+        hq, hk, hv = blk.attn.project_qkv(p["attn"],
+                                          blk.ln1.apply(p["ln1"], x))
+        hq, hk = blk.attn.maybe_rope(hq, hk, idx[:, None, None])
+        kp = k_pages[i].at[dest, :, wo].set(
+            hk[:, :, 0, :].astype(k_pages[i].dtype), mode="drop")
+        vp = v_pages[i].at[dest, :, wo].set(
+            hv[:, :, 0, :].astype(v_pages[i].dtype), mode="drop")
+        new_kp.append(kp)
+        new_vp.append(vp)
+        # logical rows: gather the updated pool, then re-select the new
+        # key at the write position — identity for active rows (already
+        # scattered), and gives inactive rows decode_step_slots' exact
+        # value semantics (their discarded logits still see "their" key)
+        k = jnp.where(write_mask, hk.astype(kp.dtype),
+                      _gather_pages(kp, tables))
+        v = jnp.where(write_mask, hv.astype(vp.dtype),
+                      _gather_pages(vp, tables))
+        bq, hh, _, dd = hq.shape
+        hkv = k.shape[1]
+        hq_g = hq.reshape(bq, hkv, hh // hkv, 1, dd)
+        logits = jnp.einsum("bngqd,bnkd->bngqk", hq_g, k).astype(
+            jnp.float32) * scale                        # (B,Hkv,g,1,W)
+        logits = jnp.where(pos_mask[:, None, None, None, :], logits,
+                           -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        o = jnp.einsum("bngqk,bnkd->bngqd", probs, v) \
+            .reshape(bq, hh, 1, dd)
+        x = x + blk.attn.project_out(p["attn"], o)
+        x = x + blk.mlp(p, x)
+
+    x = model.ln_f.apply(params["ln_f"], x)
+    return model.project_vocab(params, x)[:, 0], new_kp, new_vp
+
+
+def prefill_partial_paged(model: TransformerLM, params: Params,
+                          k_pages, v_pages, table_row, tokens, offset,
+                          true_len, *, page_len: int
+                          ) -> Tuple[jnp.ndarray, list, list]:
+    """Prefill the TAIL of a prompt into pool pages, attending over a
+    page-resident shared prefix (``serve/pages/``).
+
+    ``tokens`` (1, S) is the right-padded tail — the prompt MINUS its
+    ``offset`` prefix tokens whose K/V are already resident in the pages
+    ``table_row`` (P,) names (``offset`` is page-aligned: only FULL
+    pages are ever shared, so the tail always starts at a page
+    boundary). ``offset`` and ``true_len`` (the real tail length, >= 1)
+    are both TRACED — one compile per padded tail bucket serves cold
+    (``offset == 0``), partially shared, and fully shared admissions
+    alike.
+
+    Tail queries run at global positions ``offset + i`` (rope/learned
+    positions included) and attend over [shared prefix pages | tail]:
+    prefix keys are gathered from the pool and masked to positions
+    ``< offset``; the tail is causal, so its pad columns are inert
+    exactly as in :func:`prefill_partial`. Tail K/V are scattered into
+    the slot's own pages (pad positions route out of bounds and drop);
+    the shared prefix pages are never written.
+
+    Returns ``(logits (1, vocab) at the last real position,
+    new_k_pages, new_v_pages)``."""
+    b, s = tokens.shape
+    n_pages = k_pages[0].shape[0]
+    width = table_row.shape[0] * page_len
+    offset = jnp.asarray(offset, jnp.int32)
+    true_len = jnp.asarray(true_len, jnp.int32)
+    positions = offset + jnp.arange(s)
+    x = model.tok.apply(params["tok"], tokens)
+    if getattr(model, "pos", None) is not None:
+        x = x + model.pos.apply(params["pos"], positions)
+    scale = 1.0 / math.sqrt(model.dim // model.n_heads)
+    # attention mask over [prefix pages | tail]: prefix columns valid
+    # below offset, tail columns causal (pad tail is causally inert)
+    prefix_mask = jnp.broadcast_to((jnp.arange(width) < offset)[None, :],
+                                   (s, width))
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+    mask = jnp.concatenate([prefix_mask, causal], axis=1)   # (S, W+S)
+    # tail scatter destinations: position offset+i lives in the slot's
+    # page (offset+i)//page_len at offset (offset+i)%page_len; pad
+    # positions (i >= true_len) route out of bounds and are dropped
+    dest_page = table_row[jnp.clip(positions // page_len, 0,
+                                   table_row.shape[0] - 1)]
+    dest_off = positions % page_len
+    dest = jnp.where(jnp.arange(s) < true_len, dest_page, n_pages)
+
+    new_kp, new_vp = [], []
+    for i, blk in enumerate(model.blocks):
+        p = params["blocks"][i]
+        hq, hk, hv = blk.attn.project_qkv(p["attn"],
+                                          blk.ln1.apply(p["ln1"], x))
+        hq, hk = blk.attn.maybe_rope(hq, hk, positions)
+        kp = k_pages[i].at[dest, :, dest_off].set(
+            jnp.moveaxis(hk[0], 1, 0).astype(k_pages[i].dtype),
+            mode="drop")
+        vp = v_pages[i].at[dest, :, dest_off].set(
+            jnp.moveaxis(hv[0], 1, 0).astype(v_pages[i].dtype),
+            mode="drop")
+        new_kp.append(kp)
+        new_vp.append(vp)
+        # prefix keys from the (updated) pool; tail keys inline — the
+        # tail pages were just written, but using the in-register tail
+        # avoids a second gather and keeps the math identical to
+        # prefill_partial's [real | pad] layout
+        pref_k = kp[table_row].transpose(1, 0, 2, 3) \
+            .reshape(1, -1, width, kp.shape[-1]).astype(hk.dtype)
+        pref_v = vp[table_row].transpose(1, 0, 2, 3) \
+            .reshape(1, -1, width, vp.shape[-1]).astype(hv.dtype)
+        k_all = jnp.concatenate([pref_k, hk], axis=2)   # (1,Hkv,W+S,Dh)
+        v_all = jnp.concatenate([pref_v, hv], axis=2)
+        bq, hh, _, dd = hq.shape
+        hkv = k_all.shape[1]
+        hq_g = hq.reshape(bq, hkv, hh // hkv, s, dd)
+        logits = jnp.einsum("bngqd,bnkd->bngqk", hq_g, k_all).astype(
+            jnp.float32) * scale                     # (1,Hkv,g,S,W+S)
+        logits = jnp.where(mask[None, None, None, :, :], logits,
+                           -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(v_all.dtype)
+        o = jnp.einsum("bngqk,bnkd->bngqd", probs, v_all) \
+            .reshape(bq, hh, s, dd)
+        x = x + blk.attn.project_out(p["attn"], o)
+        x = x + blk.mlp(p, x)
+
+    x_last = jax.lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)
+    x_last = model.ln_f.apply(params["ln_f"], x_last)
+    return model.project_vocab(params, x_last)[:, 0], new_kp, new_vp
+
+
 def _sample(logits, rng, temperature: float, top_k: Optional[int],
             top_p: Optional[float] = None):
     if temperature == 0.0:

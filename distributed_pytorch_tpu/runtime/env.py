@@ -279,6 +279,20 @@ register("DPX_ELASTIC_TEST_LEAK", "str", None,
          "Test-only canary asserting elastic child env never leaks into "
          "the supervisor (tests/test_elastic.py).")
 
+# -- serving ----------------------------------------------------------------
+register("DPX_SERVE_PAGE_LEN", "int", 16,
+         "Tokens per KV page of the paged serving cache "
+         "(serve/pages/; only full pages are prefix-shared — "
+         "docs/serving.md).")
+register("DPX_SERVE_N_PAGES", "int", 0,
+         "Total pages of the paged serving KV pool (0 = derive "
+         "n_slots*ceil(max_len/page_len), the same KV budget the "
+         "contiguous slot pool would preallocate).")
+register("DPX_SERVE_PREFIX_SHARE", "bool", True,
+         "Enable radix prefix sharing in the paged serving cache "
+         "(refcounted reuse of resident full prompt pages; 0 = paged "
+         "layout without sharing).")
+
 # -- torch front door / benches --------------------------------------------
 register("DPX_WEIGHT_UPDATE", "str", "replicated",
          "Default weight-update mode of `parallel.make_train_step`: "

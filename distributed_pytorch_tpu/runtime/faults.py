@@ -43,6 +43,11 @@ everything):
   so ``kill@op=ckpt_commit_window`` dies at the exact byte where only
   the renamed-aside ``.old`` copy is complete (the atomicity chaos test
   in tests/test_ckpt_sharded.py; ``delay@op=ckpt,ms=...`` stalls saves).
+  The paged serving cache (``serve/pages/``) fires ``op=page_admit`` at
+  every page-allocation attempt (admission tail AND mid-decode growth)
+  and ``op=page_evict`` at each LRU eviction of a refcount-zero page —
+  ``delay@op=page_admit,ms=...`` models a slow allocator under eviction
+  pressure (the chaos case in tests/test_serve_pages.py).
 - ``call``    — the Nth (1-based) invocation of that op in this process.
 - ``step``    — the training step; specs *without* ``op`` fire from
   :func:`on_step` (train loops call it once per step); specs *with*
@@ -105,7 +110,8 @@ _INT_KEYS = ("step", "rank", "call", "ms", "attempt")
 #: and ``serve_step`` from the serving engine's iteration hook.
 COMM_OPS = ("allreduce", "allreduce_q8", "reduce_scatter", "allgather",
             "reduce", "gather", "broadcast", "barrier",
-            "ckpt", "ckpt_commit", "ckpt_commit_window", "serve_step")
+            "ckpt", "ckpt_commit", "ckpt_commit_window", "serve_step",
+            "page_admit", "page_evict")
 
 
 @dataclass

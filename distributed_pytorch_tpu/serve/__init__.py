@@ -3,23 +3,27 @@
 The ROADMAP's "serves heavy traffic" leg: an Orca-style engine that
 runs many concurrent, independently-arriving requests through ONE
 accelerator with iteration-level scheduling — a slot-pooled, fixed-
-shape KV cache (``cache``), an admission scheduler with bounded queue +
-priorities + per-request deadlines (``scheduler``), the engine loop and
-threaded front door (``engine``), and per-request SLO metrics
+shape KV cache (``cache``), a paged + prefix-shared variant with a
+refcounted block pool and radix index (``pages``,
+``EngineConfig(paged=True)``), an admission scheduler with bounded
+queue + priorities + per-request deadlines (``scheduler``), the engine
+loop and threaded front door (``engine``), and per-request SLO metrics
 (``metrics``). Architecture and failure grammar: docs/serving.md.
 """
 
 from .cache import CompileCounts, SlotPool  # noqa: F401
 from .engine import EngineConfig, InferenceEngine  # noqa: F401
 from .metrics import aggregate, percentile, request_record  # noqa: F401
+from .pages import PagedSlotPool, PagePool, PrefixIndex  # noqa: F401
 from .scheduler import AdmissionScheduler  # noqa: F401
-from .types import (AdmissionRejected, EngineStopped, Request,  # noqa: F401
-                    RequestDeadlineExceeded, RequestHandle, SamplingParams,
-                    ServeError)
+from .types import (AdmissionRejected, EngineStopped,  # noqa: F401
+                    PagePoolExhausted, Request, RequestDeadlineExceeded,
+                    RequestHandle, SamplingParams, ServeError)
 
 __all__ = [
     "AdmissionRejected", "AdmissionScheduler", "CompileCounts",
-    "EngineConfig", "EngineStopped", "InferenceEngine", "Request",
+    "EngineConfig", "EngineStopped", "InferenceEngine", "PagePool",
+    "PagePoolExhausted", "PagedSlotPool", "PrefixIndex", "Request",
     "RequestDeadlineExceeded", "RequestHandle", "SamplingParams",
     "ServeError", "SlotPool", "aggregate", "percentile", "request_record",
 ]

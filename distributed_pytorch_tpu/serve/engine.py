@@ -38,14 +38,16 @@ import numpy as np
 
 from ..models.generate import (_check_attn_compatible, _model_window,
                                _sample)
+from ..runtime import env as dpxenv
 from ..runtime import faults
 from ..utils.logging import MetricsLogger
 from .cache import SlotPool
 from .metrics import request_record
+from .pages import PagedSlotPool
 from .scheduler import AdmissionScheduler
-from .types import (FAILED, FINISHED, RUNNING, AdmissionRejected,
-                    EngineStopped, Request, RequestDeadlineExceeded,
-                    RequestHandle, SamplingParams)
+from .types import (FAILED, FINISHED, QUEUED, RUNNING, AdmissionRejected,
+                    EngineStopped, PagePoolExhausted, Request,
+                    RequestDeadlineExceeded, RequestHandle, SamplingParams)
 
 
 def _default_buckets(cap: int) -> Tuple[int, ...]:
@@ -75,6 +77,15 @@ class EngineConfig:
     metrics: Optional[MetricsLogger] = None
     log_every: int = 16
     allow_custom_attn: bool = False
+    # paged KV + prefix sharing (serve/pages/; docs/serving.md). With
+    # ``paged=True`` the slot cache becomes a refcounted block pool and
+    # identical prompt prefixes are computed once; the None knobs
+    # default from the typed env registry (DPX_SERVE_PAGE_LEN /
+    # DPX_SERVE_N_PAGES / DPX_SERVE_PREFIX_SHARE).
+    paged: bool = False
+    page_len: Optional[int] = None
+    n_pages: Optional[int] = None
+    prefix_share: Optional[bool] = None
 
 
 class InferenceEngine:
@@ -107,8 +118,29 @@ class InferenceEngine:
             raise ValueError(
                 f"largest prefill bucket ({max(self.buckets)}) exceeds "
                 f"max_len ({cfg.max_len}) — the slot row cannot hold it")
-        self.pool = SlotPool(model, cfg.n_slots, cfg.max_len,
-                             window=self.window)
+        self._paged = cfg.paged
+        if cfg.paged:
+            if self.window is not None:
+                raise ValueError(
+                    "paged KV (serve/pages) does not support "
+                    "sliding-window models — the rolling O(window) "
+                    "SlotPool already bounds their memory (paged=False)")
+            page_len = (cfg.page_len if cfg.page_len is not None
+                        else dpxenv.get("DPX_SERVE_PAGE_LEN"))
+            n_pages = (cfg.n_pages if cfg.n_pages is not None
+                       else dpxenv.get("DPX_SERVE_N_PAGES"))
+            if not n_pages:
+                # unshared-equivalent budget: the same KV bytes the
+                # contiguous SlotPool would have preallocated
+                n_pages = cfg.n_slots * (-(-cfg.max_len // page_len))
+            share = (cfg.prefix_share if cfg.prefix_share is not None
+                     else dpxenv.get("DPX_SERVE_PREFIX_SHARE"))
+            self.pool = PagedSlotPool(model, cfg.n_slots, cfg.max_len,
+                                      page_len=page_len, n_pages=n_pages,
+                                      prefix_share=bool(share))
+        else:
+            self.pool = SlotPool(model, cfg.n_slots, cfg.max_len,
+                                 window=self.window)
         self.metrics = cfg.metrics
         self._scheduler = AdmissionScheduler(cfg.max_queue)
         self._samplers: Dict[tuple, callable] = {}
@@ -192,6 +224,20 @@ class InferenceEngine:
                 f"request {rid}: learned position embeddings cannot "
                 f"extrapolate past max_seq ({self.model.max_seq})",
                 reason="too_long", request_id=rid)
+        if self._paged:
+            # the LAST sampled token retires without a KV write (decode
+            # writes positions s .. s+max_new-2), so the true worst
+            # case is ceil((s + max_new - 1) / page_len) pages
+            worst = -(-(s + sp.max_new_tokens - 1) // self.pool.page_len)
+            if worst > self.pool.n_pages:
+                # the request could NEVER hold its pages even with the
+                # whole pool to itself — reject synchronously rather
+                # than let it starve in the queue
+                raise AdmissionRejected(
+                    f"request {rid}: worst-case page need ({worst}) "
+                    f"exceeds the page pool ({self.pool.n_pages} pages "
+                    f"of {self.pool.page_len})",
+                    reason="no_free_pages", request_id=rid)
 
     def start(self) -> "InferenceEngine":
         if self._thread is not None:
@@ -218,16 +264,20 @@ class InferenceEngine:
 
     def stats(self) -> Dict:
         c = self.pool.compiles
-        return {"iterations": self._iteration,
-                "completed": self._completed, "failed": self._failed,
-                "tokens_emitted": self._tokens_emitted,
-                "queue_depth": len(self._scheduler),
-                "active_slots": len(self._running),
-                "n_slots": self.config.n_slots,
-                "decode_compiles": c.decode,
-                "prefill_compiles": dict(c.prefill),
-                "sample_compiles": c.sample,
-                "buckets": self.buckets}
+        out = {"iterations": self._iteration,
+               "completed": self._completed, "failed": self._failed,
+               "tokens_emitted": self._tokens_emitted,
+               "queue_depth": len(self._scheduler),
+               "active_slots": len(self._running),
+               "n_slots": self.config.n_slots,
+               "decode_compiles": c.decode,
+               "prefill_compiles": dict(c.prefill),
+               "sample_compiles": c.sample,
+               "buckets": self.buckets,
+               "paged": self._paged}
+        if self._paged:
+            out["pages"] = self.pool.page_stats()
+        return out
 
     # -- engine loop -------------------------------------------------------
 
@@ -262,12 +312,19 @@ class InferenceEngine:
                 break
             if (self.metrics is not None
                     and self._iteration % self.config.log_every == 0):
+                extra = {}
+                if self._paged:
+                    ps = self.pool.page_stats()
+                    extra = {"pool_occupancy": ps["pool_occupancy"],
+                             "free_pages": ps["free_pages"],
+                             "prefix_hit_rate": ps["prefix_hit_rate"],
+                             "page_evictions": ps["evictions"]}
                 self.metrics.log(
                     step=self._iteration, kind="serve_engine",
                     queue_depth=len(self._scheduler),
                     active_slots=len(self._running),
                     slot_occupancy=len(self._running) / self.config.n_slots,
-                    tokens_emitted=self._tokens_emitted)
+                    tokens_emitted=self._tokens_emitted, **extra)
         self._drain_on_stop()
 
     def _sweep_deadlines(self, now: float) -> None:
@@ -301,17 +358,67 @@ class InferenceEngine:
             req.slot = slot
             self._running[slot] = req
             s = int(req.prompt.shape[0])
-            bucket = next(b for b in self.buckets if b >= s)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :s] = req.prompt
-            logits = self.pool.admit(self.params, jnp.asarray(padded), s,
-                                     slot)
+            if self._paged:
+                try:
+                    logits, n_hit, offset = self.pool.admit(
+                        self.params, req.prompt, slot, self.buckets)
+                except PagePoolExhausted as e:
+                    # typed back-pressure into the scheduler: unwind the
+                    # slot claim and retry after a retirement frees
+                    # pages — or fail NOW when no running request could
+                    # ever free them (permanent exhaustion)
+                    self._running.pop(slot, None)
+                    self._free.append(slot)
+                    req.slot = None
+                    if self._running:
+                        req.state = QUEUED
+                        self._scheduler.requeue(req)
+                        return
+                    exc = AdmissionRejected(
+                        f"request {req.request_id}: page pool exhausted "
+                        f"at admission ({e.needed} page(s) needed, "
+                        f"{e.free_pages} free) with no running request "
+                        f"to release pages", reason="no_free_pages",
+                        request_id=req.request_id,
+                        iteration=self._iteration)
+                    exc.__cause__ = e
+                    self._fail(req, exc, outcome="no_free_pages")
+                    continue
+                req.prefix_hit_pages = n_hit
+                req.prefill_tokens_saved = offset
+            else:
+                bucket = next(b for b in self.buckets if b >= s)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :s] = req.prompt
+                logits = self.pool.admit(self.params, jnp.asarray(padded),
+                                         s, slot)
             req.admit_t = time.monotonic()
             req.admit_iteration = self._iteration
             tok = self._sample_for(req, logits)
             self._emit(req, tok)
 
     def _decode_all(self) -> None:
+        if self._paged:
+            # grow page tables at page boundaries BEFORE the decode
+            # write; an exhausted pool fails the victim request typed
+            # (request + iteration attributed) and frees its pages —
+            # co-resident slots decode on, untouched
+            for slot in sorted(self._running):
+                req = self._running[slot]
+                try:
+                    self.pool.ensure_decode_capacity(slot)
+                except PagePoolExhausted as e:
+                    self._fail(req, PagePoolExhausted(
+                        f"request {req.request_id}: page pool exhausted "
+                        f"mid-decode after {len(req.out_tokens)} tokens "
+                        f"({e.needed} page(s) needed, {e.free_pages} "
+                        f"free — every page held by a live reader)",
+                        needed=e.needed, free_pages=e.free_pages,
+                        request_id=req.request_id,
+                        iteration=self._iteration),
+                        outcome="no_free_pages")
+            if not self._running:
+                return
         active = np.zeros(self.config.n_slots, bool)
         active[list(self._running)] = True
         logits = self.pool.decode(self.params,
@@ -357,6 +464,12 @@ class InferenceEngine:
 
     def _free_slot(self, req: Request) -> None:
         if req.slot is not None:
+            if self._paged:
+                # every exit path (retire, deadline, crash drain) runs
+                # through here, so page refcounts can never leak:
+                # private pages free immediately, indexed prompt pages
+                # stay resident for future prefix hits
+                self.pool.release(req.slot)
             self._running.pop(req.slot, None)
             self._free.append(req.slot)
             req.slot = None

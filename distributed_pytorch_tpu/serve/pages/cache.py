@@ -1,0 +1,233 @@
+"""PagedSlotPool: the paged, prefix-shared drop-in for ``serve.cache.SlotPool``.
+
+KV memory is a block pool — per layer ONE ``(n_pages, Hkv, page_len,
+Dh)`` buffer for K and V — and each slot addresses its cache through a
+page table row instead of owning a contiguous stripe. Three things fall
+out of that indirection:
+
+- **prefix sharing**: full pages of a prompt are keyed in a radix index
+  (:mod:`.prefix`); an admitted request reuses every resident page of
+  its longest matching prefix (refcount++, ZERO prefill compute for the
+  covered tokens) and only prefills the tail;
+- **memory elasticity**: a retired request's private pages return to
+  the free list immediately, while its indexed prompt pages stay
+  RESIDENT at refcount zero until LRU eviction actually needs them;
+- **typed back-pressure**: when every page has a live reader,
+  allocation raises :class:`~..types.PagePoolExhausted` instead of
+  corrupting anything (:mod:`.pool`).
+
+The one-program discipline of ``SlotPool`` is preserved exactly: page
+tables, lengths, offsets and true lengths are all TRACED, so the whole
+serving life is still ONE jitted decode program
+(``models.generate.decode_step_slots_paged``) plus one jitted admit per
+tail-length bucket (``prefill_partial_paged``), counted by the same
+``CompileCounts`` the tests assert on.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.generate import (decode_step_slots_paged,
+                                prefill_partial_paged)
+from ...runtime import faults
+from ..cache import CompileCounts
+from .pool import PagePool
+from .prefix import PrefixIndex
+
+
+class PagedSlotPool:
+    """Owns the page-pool arrays, the page tables, and the jitted paged
+    programs; all allocation/refcount/eviction policy is host-side."""
+
+    def __init__(self, model, n_slots: int, max_len: int, *,
+                 page_len: int, n_pages: int, prefix_share: bool = True):
+        if max_len < 1:
+            raise ValueError(f"max_len must be >= 1, got {max_len}")
+        self.model = model
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.page_len = page_len
+        self.n_pages = n_pages
+        self.prefix_share = prefix_share
+        self.pages_per_slot = -(-max_len // page_len)   # ceil
+        dh = model.dim // model.n_heads
+        h_kv = getattr(model, "n_kv_heads", model.n_heads)
+        shape = (n_pages, h_kv, page_len, dh)
+        self.k_pages: List[jax.Array] = [jnp.zeros(shape, model.dtype)
+                                         for _ in range(model.n_layers)]
+        self.v_pages: List[jax.Array] = [jnp.zeros(shape, model.dtype)
+                                         for _ in range(model.n_layers)]
+        # host-side state: page tables / lengths mirror the traced args
+        # (tiny int32 uploads per call), policy state never leaves host
+        self.tables = np.zeros((n_slots, self.pages_per_slot), np.int32)
+        self.lengths = np.zeros((n_slots,), np.int32)
+        self.owned: List[List[int]] = [[] for _ in range(n_slots)]
+        self.pool = PagePool(n_pages, page_len)
+        self.index = PrefixIndex(page_len)
+        self.compiles = CompileCounts()
+        self._admit_fns: Dict[int, callable] = {}
+        self._decode_fn = jax.jit(self._decode, donate_argnums=(1, 2))
+        # cumulative sharing counters (engine metrics / bench)
+        self.prefix_lookups = 0
+        self.prefix_hit_pages_total = 0
+        self.prefill_tokens_saved_total = 0
+        self.prompt_tokens_total = 0
+
+    # -- jitted programs ---------------------------------------------------
+
+    def _decode(self, params, k_pages, v_pages, tables, lengths, tokens,
+                active):
+        self.compiles.decode += 1          # trace-time only
+        return decode_step_slots_paged(self.model, params, k_pages,
+                                       v_pages, tables, lengths, tokens,
+                                       active, page_len=self.page_len)
+
+    def _admit(self, params, k_pages, v_pages, table_row, tokens,
+               offset, true_len, *, bucket: int):
+        self.compiles.bump_prefill(bucket)  # trace-time only
+        return prefill_partial_paged(self.model, params, k_pages,
+                                     v_pages, table_row, tokens, offset,
+                                     true_len, page_len=self.page_len)
+
+    # -- allocation --------------------------------------------------------
+
+    def _alloc(self, n: int) -> List[int]:
+        """``n`` pages: free list first, then LRU eviction of
+        refcount-zero indexed pages; all-or-nothing (a partial grab is
+        rolled back before the typed exhaustion raise)."""
+        faults.on_comm_op("page_admit")
+        out: List[int] = []
+        while len(out) < n:
+            pid = self.pool.take_free()
+            if pid is None:
+                evicted = self.index.evict_lru(self.pool)
+                if evicted is None:
+                    for p in out:
+                        self.pool.release_to_free(p)
+                    raise self.pool.exhausted(n)
+                self.pool.reclaim(evicted)
+                pid = evicted
+            out.append(pid)
+        return out
+
+    # -- host front ends ---------------------------------------------------
+
+    def admit(self, params, prompt: np.ndarray, slot: int,
+              buckets: Tuple[int, ...]):
+        """Admit ``prompt`` ((S,) np int32) into ``slot``: radix prefix
+        lookup → refcount the matched full pages → allocate + prefill
+        only the tail → index the prompt's full pages for future
+        admissions. Returns ``(last-position logits (1, vocab), n_hit
+        pages, offset tokens)``. Raises :class:`PagePoolExhausted`
+        (pool-attributed, no slot state changed) when the tail cannot
+        be allocated."""
+        s = int(prompt.shape[0])
+        L = self.page_len
+        hits: List[int] = []
+        if self.prefix_share:
+            # cap at (s-1)//L: at least one real token must remain for
+            # the tail prefill — the last prompt position's logits have
+            # to be computed even when every full page is resident
+            hits = self.index.match(prompt, (s - 1) // L, self.pool)
+        self.prefix_lookups += 1
+        n_hit = len(hits)
+        offset = n_hit * L
+        tail_len = s - offset
+        n_fresh = -(-s // L) - n_hit
+        # incref matched pages BEFORE allocating: eviction only ever
+        # considers refcount-zero pages, so a matched page cannot be
+        # stolen to satisfy this very request's tail
+        for pid in hits:
+            self.pool.incref(pid)
+        try:
+            fresh = self._alloc(n_fresh)
+        except Exception:
+            for pid in hits:
+                self.pool.decref(pid)
+            raise
+        row = hits + fresh
+        self.tables[slot, :len(row)] = row
+        self.tables[slot, len(row):] = 0
+        self.owned[slot] = row
+        bucket = next(b for b in buckets if b >= tail_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :tail_len] = prompt[offset:]
+        fn = self._admit_fns.get(bucket)
+        if fn is None:
+            fn = jax.jit(partial(self._admit, bucket=bucket),
+                         donate_argnums=(1, 2))
+            self._admit_fns[bucket] = fn
+        logits, self.k_pages, self.v_pages = fn(
+            params, self.k_pages, self.v_pages,
+            jnp.asarray(self.tables[slot]), jnp.asarray(padded),
+            jnp.asarray(offset, jnp.int32),
+            jnp.asarray(tail_len, jnp.int32))
+        self.lengths[slot] = s
+        if self.prefix_share:
+            self.index.insert(prompt, s // L, row, self.pool)
+        self.prefix_hit_pages_total += n_hit
+        self.prefill_tokens_saved_total += offset
+        self.prompt_tokens_total += s
+        return logits, n_hit, offset
+
+    def ensure_decode_capacity(self, slot: int) -> None:
+        """Grow ``slot``'s page table if its next decode write crosses a
+        page boundary. Raises :class:`PagePoolExhausted` (slot state
+        unchanged) when no page can be supplied — the engine turns that
+        into a typed per-request failure."""
+        need_idx = int(self.lengths[slot]) // self.page_len
+        row = self.owned[slot]
+        if need_idx < len(row):
+            return
+        pid = self._alloc(1)[0]
+        row.append(pid)
+        self.tables[slot, need_idx] = pid
+
+    def decode(self, params, tokens: np.ndarray, active: np.ndarray):
+        """Advance every slot one position through the ONE jitted paged
+        decode program (inactive rows neither write the pool nor
+        advance). Returns (n_slots, vocab) logits."""
+        logits, self.k_pages, self.v_pages = self._decode_fn(
+            params, self.k_pages, self.v_pages,
+            jnp.asarray(self.tables), jnp.asarray(self.lengths),
+            jnp.asarray(tokens), jnp.asarray(active))
+        self.lengths[np.asarray(active)] += 1
+        return logits
+
+    def release(self, slot: int) -> None:
+        """Drop the slot's references (retirement, failure, or engine
+        drain): private pages go straight back to the free list, indexed
+        pages stay resident for future prefix hits until LRU-evicted."""
+        for pid in self.owned[slot]:
+            self.pool.decref(pid)
+        self.owned[slot] = []
+        self.tables[slot, :] = 0
+        self.lengths[slot] = 0
+
+    # -- introspection -----------------------------------------------------
+
+    def prefix_hit_rate(self) -> Optional[float]:
+        """Cumulative share of prompt tokens served from resident pages
+        (None before the first admission)."""
+        if self.prompt_tokens_total == 0:
+            return None
+        return self.prefill_tokens_saved_total / self.prompt_tokens_total
+
+    def page_stats(self) -> Dict:
+        return {"n_pages": self.n_pages,
+                "page_len": self.page_len,
+                "free_pages": self.pool.free_pages,
+                "pages_in_use": self.pool.pages_in_use,
+                "pool_occupancy": self.pool.occupancy(),
+                "indexed_pages": len(self.index),
+                "evictions": self.pool.evictions,
+                "prefix_lookups": self.prefix_lookups,
+                "prefix_hit_pages": self.prefix_hit_pages_total,
+                "prefill_tokens_saved": self.prefill_tokens_saved_total,
+                "prefix_hit_rate": self.prefix_hit_rate()}

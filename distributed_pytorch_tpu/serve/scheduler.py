@@ -28,6 +28,7 @@ class AdmissionScheduler:
         self.max_queue = max_queue
         self._heap: List[tuple] = []    # (priority, seq, Request)
         self._seq = 0
+        self._front = 0                 # decreasing: requeue-at-front seqs
         self._lock = threading.Lock()
 
     def __len__(self) -> int:
@@ -45,6 +46,18 @@ class AdmissionScheduler:
             heapq.heappush(self._heap,
                            (req.params.priority, self._seq, req))
             self._seq += 1
+
+    def requeue(self, req: Request) -> None:
+        """Put a just-popped request back at the FRONT of its priority
+        class — the paged-KV back-pressure path: admission could not get
+        pages this iteration, so the request retries (FCFS-stable) after
+        a retirement frees some. Bypasses the ``max_queue`` bound: the
+        request already passed admission once and must not be re-judged
+        against newer arrivals."""
+        with self._lock:
+            self._front -= 1
+            heapq.heappush(self._heap,
+                           (req.params.priority, self._front, req))
 
     def pop(self) -> Optional[Request]:
         """Highest-priority (then oldest) request, or None."""

@@ -84,6 +84,26 @@ class EngineStopped(ServeError):
     """The engine shut down while the request was still in flight."""
 
 
+class PagePoolExhausted(ServeError):
+    """The paged KV pool (``serve/pages/``) could not supply a page:
+    every page is either free-list-empty or held by a live reader
+    (refcount > 0), and nothing refcount-zero is LRU-evictable. Raised
+    by the pool with ``needed``/``free_pages`` attribution; the engine
+    re-raises with the victim request and iteration attached (a
+    mid-decode growth failure fails THAT request only — co-resident
+    streams are untouched). At admission the same condition surfaces as
+    back-pressure instead: the request stays queued while other
+    requests hold pages, or fails typed
+    ``AdmissionRejected(reason="no_free_pages")`` when the exhaustion
+    is permanent."""
+
+    def __init__(self, msg: str, *, needed: int = 0, free_pages: int = 0,
+                 **kw):
+        super().__init__(msg, **kw)
+        self.needed = needed
+        self.free_pages = free_pages
+
+
 #: Request lifecycle states (host-side bookkeeping only).
 QUEUED, RUNNING, FINISHED, FAILED = "queued", "running", "finished", "failed"
 
@@ -105,6 +125,11 @@ class Request:
     out_tokens: List[int] = field(default_factory=list)
     admit_t: Optional[float] = None
     admit_iteration: Optional[int] = None
+    # paged-KV accounting (serve/pages/): how many full prefix pages the
+    # radix index supplied at admission, and the prefill tokens that
+    # reuse saved (0/0 for cold or unpaged requests)
+    prefix_hit_pages: int = 0
+    prefill_tokens_saved: int = 0
     retire_iteration: Optional[int] = None
     first_token_t: Optional[float] = None
     last_token_t: Optional[float] = None

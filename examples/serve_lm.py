@@ -8,10 +8,15 @@ engine's compile/occupancy stats. Runs on CPU in seconds:
 
     python examples/serve_lm.py [--requests N] [--max-new N]
         [--slots N] [--temperature T] [--metrics-log FILE]
+        [--paged] [--shared-prefix N]
 
 With --metrics-log, per-request TTFT/TPOT events and periodic engine
 records are appended as line-JSON (the same stream training metrics
-use — utils/logging.MetricsLogger).
+use — utils/logging.MetricsLogger). With --paged the engine runs the
+paged, prefix-shared KV cache (serve/pages/); --shared-prefix N gives
+every request the same N-token "system prompt", so the printed
+per-request records show the prefix pages being computed once and hit
+thereafter (prefix_hit_pages / prefill_tokens_saved).
 """
 
 from __future__ import annotations
@@ -39,6 +44,11 @@ def parse_args(argv=None):
     p.add_argument("--max-len", type=int, default=128)
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--metrics-log", type=str, default=None)
+    p.add_argument("--paged", action="store_true",
+                   help="paged, prefix-shared KV cache (serve/pages/)")
+    p.add_argument("--shared-prefix", type=int, default=0,
+                   help="give every request the same N-token system "
+                        "prompt (shows prefix sharing with --paged)")
     return p.parse_args(argv)
 
 
@@ -50,8 +60,10 @@ def main(argv=None):
     logger = MetricsLogger(path=args.metrics_log) if args.metrics_log \
         else None
     cfg = EngineConfig(n_slots=args.slots, max_len=args.max_len,
-                       metrics=logger, log_every=8)
+                       metrics=logger, log_every=8, paged=args.paged)
     rng = np.random.default_rng(0)
+    shared = rng.integers(0, 61, (args.shared_prefix,)).astype(np.int32) \
+        if args.shared_prefix else None
 
     def stream(rid):
         def cb(tok, i):
@@ -64,6 +76,8 @@ def main(argv=None):
             prompt = rng.integers(0, 61,
                                   (int(rng.integers(4, 20)),)).astype(
                 np.int32)
+            if shared is not None:
+                prompt = np.concatenate([shared, prompt])
             sp = SamplingParams(
                 max_new_tokens=args.max_new,
                 # mix greedy and sampled requests (distinct sampler
@@ -81,16 +95,27 @@ def main(argv=None):
         for h in handles:
             toks = h.result(timeout=300)
             m = h.metrics
-            print(f"req {h.request_id} done: {len(toks)} tokens, "
-                  f"TTFT {m['ttft_ms']:.1f} ms, "
-                  f"TPOT {m['tpot_ms']:.2f} ms" if m["tpot_ms"] else
-                  f"req {h.request_id} done: {len(toks)} tokens, "
-                  f"TTFT {m['ttft_ms']:.1f} ms")
+            line = (f"req {h.request_id} done: {len(toks)} tokens, "
+                    f"TTFT {m['ttft_ms']:.1f} ms")
+            if m["tpot_ms"]:
+                line += f", TPOT {m['tpot_ms']:.2f} ms"
+            if args.paged:
+                line += (f", prefix hit {m['prefix_hit_pages']} pages "
+                         f"({m['prefill_tokens_saved']} prefill tokens "
+                         f"saved)")
+            print(line)
         st = eng.stats()
         print(f"engine: {st['iterations']} iterations, "
               f"{st['tokens_emitted']} tokens, decode compiles "
               f"{st['decode_compiles']}, prefill compiles "
               f"{st['prefill_compiles']}, samplers {st['sample_compiles']}")
+        if args.paged:
+            ps = st["pages"]
+            hr = ps["prefix_hit_rate"]
+            print(f"pages: {ps['pages_in_use']}/{ps['n_pages']} in use "
+                  f"(page_len {ps['page_len']}), hit rate "
+                  f"{hr if hr is None else round(hr, 3)}, "
+                  f"{ps['evictions']} evictions")
     if logger is not None:
         logger.close()
         print(f"metrics -> {args.metrics_log}")
