@@ -271,6 +271,61 @@ def render(rows) -> str:
                          f"{_truncate_words(sv['vs_unshared_ttft_p50_withheld'])}")
         lines.append("")
 
+    hr = res("bench_dp8_hier")
+    if hr.get("hier_steps_per_sec") is not None:
+        lines += ["", f"Adaptive/hierarchical comm (stage bench_dp8_hier"
+                  f", {hr.get('hier_bucket_mb', '?')} MiB bucket, world "
+                  f"{hr.get('hier_world', '?')} as "
+                  f"{hr.get('hier_world', 0) // max(hr.get('hier_local_world', 1), 1)}"
+                  f"x{hr.get('hier_local_world', '?')} hosts — "
+                  "docs/comms.md):", "",
+                  "| arm | steps/s | wire bytes/rank/step |",
+                  "|---|---|---|",
+                  f"| flat q8 | {_fmt(hr.get('q8_steps_per_sec', 0))} | "
+                  f"{hr.get('q8_wire_bytes', '?')} |",
+                  f"| flat q4 | {_fmt(hr.get('q4_steps_per_sec', 0))} | "
+                  f"{hr.get('q4_wire_bytes', '?')} |",
+                  f"| hier adaptive | "
+                  f"{_fmt(hr.get('hier_steps_per_sec', 0))} | "
+                  f"slow-hop {hr.get('hier_slow_hop_bytes_per_step', '?')} |"]
+        if hr.get("f32_wire_bytes") and hr.get("q4_wire_bytes"):
+            lines.append(
+                f"q4 wire {_fmt(hr['f32_wire_bytes'] / hr['q4_wire_bytes'])}"
+                f"x smaller than f32 (CommStats accounting == wire.py "
+                f"formula); adaptive widths {hr.get('hier_width_hist')}.")
+        if hr.get("hier_slow_hop_bytes_total"):
+            parts = []
+            if hr.get("flat_slow_hop_bytes_matched_width"):
+                parts.append(
+                    f"{_fmt(hr['flat_slow_hop_bytes_matched_width'] / hr['hier_slow_hop_bytes_total'])}"
+                    "x below the same-width flat ring (topology)")
+            if hr.get("flat_slow_hop_bytes_q8"):
+                parts.append(
+                    f"{_fmt(hr['flat_slow_hop_bytes_q8'] / hr['hier_slow_hop_bytes_total'])}"
+                    "x below the flat q8 ring (topology x width)")
+            if parts:
+                lines.append("Two-level ring slow-hop total "
+                             + "; ".join(parts) + ".")
+        ov = hr.get("overlap") or {}
+        if ov.get("on") and ov.get("off"):
+            line = (f"Comm overlap (bucketed host step): exposed "
+                    f"{_fmt(ov['off'].get('exposed_ms', 0))} -> "
+                    f"{_fmt(ov['on'].get('exposed_ms', 0))} ms/step "
+                    f"({_fmt(ov['on'].get('overlapped_ms', 0))} ms "
+                    "measured hidden behind async bucket updates")
+            if ov["on"].get("step_ms") is not None:
+                line += (f"; wall {_fmt(ov['off'].get('step_ms', 0))}"
+                         f" -> {_fmt(ov['on'].get('step_ms', 0))} "
+                         "ms/step")
+            lines.append(line + ").")
+        if "vs_q8" in hr:
+            lines.append(f"vs_q8: **{_fmt(float(hr['vs_q8']))}x** (both "
+                         "sides passed the spread gate).")
+        elif "vs_q8_withheld" in hr:
+            lines.append(f"vs_q8 **withheld**: "
+                         f"{_truncate_words(hr['vs_q8_withheld'])}")
+        lines.append("")
+
     smoke = res("mfu_smoke")
     if smoke.get("step_ms_median") is not None:
         lines.append(

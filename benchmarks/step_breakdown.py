@@ -241,6 +241,8 @@ def run_comm(world=8, hidden=1024, in_dim=256, batch_per_rank=8,
         x, y = batch
         return cross_entropy(model.apply(p, x), y), {}
 
+    hists = {}
+
     def arm(world_size, grad_reduce):
         dist.cleanup()
         dist.init_process_group(rank=0, world_size=world_size)
@@ -251,13 +253,22 @@ def run_comm(world=8, hidden=1024, in_dim=256, batch_per_rank=8,
         y = dist.shard_batch((np.arange(gb) % 16).astype(np.int32))
         step = make_train_step(loss_fn, opt, donate=False,
                                grad_reduce=grad_reduce)
-        return _time_step(step, params, opt.init(params), (x, y), steps)
+        t = _time_step(step, params, opt.init(params), (x, y), steps)
+        chooser = getattr(step, "width_chooser", None)
+        if chooser is not None:
+            # the adaptive-width histogram: which wire the chooser
+            # actually picked, step by step (hysteresis included)
+            hists[grad_reduce] = {str(k): v for k, v
+                                  in chooser.histogram().items()}
+        return t
 
     n_grad = sum(x.size for x in jax.tree_util.tree_leaves(
         model.init(jax.random.PRNGKey(0))))
     base_s = arm(1, "mean")          # compute-only floor (no dp axis)
     mean_s = arm(world, "mean")
     quant_s = arm(world, "quant")
+    q4_s = arm(world, "q4")
+    adaptive_s = arm(world, "adaptive")
     dist.cleanup()
     f32_bytes = prim.ring_allreduce_wire_bytes(n_grad, world)
     return {
@@ -265,9 +276,16 @@ def run_comm(world=8, hidden=1024, in_dim=256, batch_per_rank=8,
         "grad_elems": n_grad,
         "step_ms": {"world1": round(base_s * 1e3, 3),
                     "mean": round(mean_s * 1e3, 3),
-                    "quant": round(quant_s * 1e3, 3)},
+                    "quant": round(quant_s * 1e3, 3),
+                    "q4": round(q4_s * 1e3, 3),
+                    "adaptive": round(adaptive_s * 1e3, 3)},
         "comm_ms": {"mean": round((mean_s - base_s) * 1e3, 3),
-                    "quant": round((quant_s - base_s) * 1e3, 3)},
+                    "quant": round((quant_s - base_s) * 1e3, 3),
+                    "q4": round((q4_s - base_s) * 1e3, 3),
+                    # the adaptive arm pays a per-step scalar fetch for
+                    # the chooser statistic — part of its honest cost
+                    "adaptive": round((adaptive_s - base_s) * 1e3, 3)},
+        "adaptive_width_hist": hists.get("adaptive"),
         "wire_bytes_per_step": {
             "mean_f32": f32_bytes,
             "quant": prim.quantized_pmean_wire_bytes(n_grad, world)},

@@ -54,8 +54,14 @@ def _envreg():
 
 #: Native collective vocabulary — the ops `HostComm` can issue (what the
 #: runtime recorder sees). `_pre_op` names, not Python method names.
-NATIVE_OPS = ("allreduce", "allreduce_q8", "reduce", "gather", "broadcast",
-              "barrier")
+#: `allreduce_q4` is the 4-bit adaptive wire (the width is part of the
+#: recorded op name, so ranks disagreeing on a bucket's width diverge
+#: HERE instead of deadlocking on mismatched frame sizes);
+#: `hier_reduce`/`hier_gather` are the two-level ring's phases,
+#: recorded on the PARENT comm's schedule by comm/hier.py.
+NATIVE_OPS = ("allreduce", "allreduce_q8", "allreduce_q4",
+              "hier_reduce", "hier_gather",
+              "reduce", "gather", "broadcast", "barrier")
 
 #: HostComm methods composed FROM native ops: calling one issues the
 #: listed primitive sequence (what the runtime recorder will see).

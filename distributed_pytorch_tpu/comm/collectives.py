@@ -85,11 +85,13 @@ def all_reduce(tensor, op: str = "sum", wire: str = "exact"):
     raises ``ValueError`` like the reference (``distributed.py:131``); as
     there, validation happens only on the distributed path.
 
-    ``wire="quant"`` opts the HOST front door's sum/avg into the
-    block-int8 ring (:mod:`.wire`, ~4x less TCP traffic, lossy). The
-    single-controller path has no wire to compress — XLA moves exact
-    bytes over ICI — so it ignores the hint and stays exact (the flag is
-    accepted for cross-front-door call-site parity).
+    ``wire="quant"``/``"q4"``/``"adaptive"`` opts the HOST front door's
+    sum/avg into the block-quantized ring (:mod:`.wire`; ~4x/~7.9x less
+    TCP traffic, lossy; adaptive width with hysteresis; two-level under
+    ``DPX_HIER_RING``). The single-controller path has no wire to
+    compress — XLA moves exact bytes over ICI — so it ignores the hint
+    and stays exact (the flag is accepted for cross-front-door
+    call-site parity).
     """
     comm = context.get_host_comm()
     if comm is not None:

@@ -15,9 +15,15 @@ arrays are converted in, which is exactly what torch's gloo path does with
 CPU staging).
 
 Wire formats: every collective defaults to the exact full-width wire.
-``all_reduce``/``sync_params`` additionally accept ``wire="quant"`` — the
-block-scaled int8 format of :mod:`.wire` (~4x less TCP traffic, lossy,
-bit-identical across ranks). The REFERENCE-EXACT contracts are never
+``all_reduce``/``sync_params`` additionally accept ``wire="quant"`` —
+the block-scaled quantized format of :mod:`.wire` (~4x less TCP traffic
+at the default 8-bit width, lossy, bit-identical across ranks) — plus
+``wire="q4"`` (nibble-packed, ~7.9x) and ``wire="adaptive"`` (width per
+bucket from observed dynamic range, hysteresis across steps; the
+``quant`` default width itself comes from ``DPX_WIRE_WIDTH``). With
+``DPX_HIER_RING=L`` the quantized reduce runs the two-level ring
+(:mod:`.hier`): exact intra-host to one leader per host, quantized ring
+between leaders only. The REFERENCE-EXACT contracts are never
 quantized: ``reduce`` (non-root buffers untouched) and ``gather``
 (zeros-on-non-primary) always move exact full-width bytes, as does any
 integer payload (f64 ring keeps integer sums exact).
@@ -37,18 +43,96 @@ from typing import List, Sequence
 
 import numpy as np
 
+from ..runtime import env as _env
 from ..runtime.native import (CommCorrupt, CommError,  # noqa: F401
                               CommPeerDied, CommTimeout)
 from . import wire as _wire
 
-#: Wire formats a lossy-tolerant collective accepts.
-WIRE_FORMATS = ("exact", "quant")
+#: Wire formats a lossy-tolerant collective accepts. ``quant`` is the
+#: historical opt-in (width from the typed ``DPX_WIRE_WIDTH`` knob,
+#: default 8-bit); ``q4`` forces the nibble-packed 4-bit wire;
+#: ``adaptive`` picks the width per bucket from observed dynamic range
+#: (:class:`..comm.wire.WidthChooser`, hysteresis across steps).
+WIRE_FORMATS = ("exact", "quant", "q4", "adaptive")
 
 
 def _check_wire(wire: str) -> str:
     if wire not in WIRE_FORMATS:
         raise ValueError(f"wire must be one of {WIRE_FORMATS}, got {wire!r}")
     return wire
+
+
+_warned_widths = set()
+
+
+def resolve_wire_width(wire: str):
+    """Map a wire format onto a width: ``None`` (exact), 8, 4, or the
+    string ``"adaptive"``. ``wire="quant"`` defers to the typed
+    ``DPX_WIRE_WIDTH`` registry knob so a deployment can move every
+    ``quant`` call site to q4/adaptive without touching code. An
+    unrecognized knob value degrades to the q8 default — the registry's
+    malformed-falls-back contract; env garbage must not crash a job at
+    its first collective — but LOUDLY, once per value."""
+    _check_wire(wire)
+    if wire == "exact":
+        return None
+    if wire == "q4":
+        return 4
+    if wire == "adaptive":
+        return "adaptive"
+    w = str(_env.get("DPX_WIRE_WIDTH") or "8").strip().lower()
+    if w == "adaptive":
+        return "adaptive"
+    if w in ("4", "8"):
+        return int(w)
+    if w not in _warned_widths:
+        _warned_widths.add(w)
+        import sys
+        print(f"# DPX_WIRE_WIDTH={w!r} not one of 8|4|adaptive — "
+              f"falling back to the q8 wire", file=sys.stderr)
+    return 8
+
+
+def _chooser_for(comm, size: int) -> "_wire.WidthChooser":
+    """The comm's cached adaptive width chooser for buckets of ``size``
+    elements — keyed per bucket size so call sites reducing DIFFERENT
+    tensors through one comm don't interleave a single hysteresis state
+    machine (a q4-friendly gradient bucket alternating with a
+    q4-hostile metric tensor would otherwise pin each other's width).
+    Size is the bucket identity the eager front door can observe; the
+    train step keeps its own chooser per step function. Every chooser
+    is fed the bit-identical reduced bucket after its reduce, so all
+    ranks' machines agree (comm/wire.py)."""
+    chs = getattr(comm, "_width_choosers", None)
+    if chs is None:
+        chs = comm._width_choosers = {}
+    ch = chs.get(size)
+    if ch is None:
+        ch = chs[size] = _wire.WidthChooser()
+    return ch
+
+
+def _quant_allreduce(comm, work: np.ndarray, wire: str) -> np.ndarray:
+    """Ship a flat f32 sum bucket over the quantized ring: width from
+    the wire format (adaptive = per-bucket-size chooser), two-level
+    when ``DPX_HIER_RING`` names a local world that divides this one."""
+    width = resolve_wire_width(wire)
+    chooser = _chooser_for(comm, work.size) \
+        if width == "adaptive" else None
+    bits = chooser.width if chooser is not None else width
+    local_world = int(_env.get("DPX_HIER_RING"))
+    if local_world > 1 and comm.world % local_world == 0:
+        from .hier import hier_ring
+        hier_ring(comm, local_world).allreduce(work, bits=bits)
+    elif bits == 4:
+        comm.allreduce_q4(work)
+    else:
+        comm.allreduce_q8(work)
+    if chooser is not None:
+        # observe the REDUCED bucket (bit-identical on every rank) so
+        # every rank's chooser steps the same state machine
+        chooser.observe(work)
+    return work
 
 
 def _to_np(tensor) -> np.ndarray:
@@ -59,11 +143,12 @@ def all_reduce(comm, tensor, op: str = "sum", wire: str = "exact"):
     """Reference distributed.py:119-133: sum or sum/world, in every rank.
     (max/min supported too, matching the SPMD front door's extension.)
 
-    ``wire="quant"`` ships sum/avg over the chunk-pipelined int8 ring
-    (:meth:`..runtime.native.HostComm.allreduce_q8`) — opt-in and only
-    where lossy is safe: float data under sum/avg. max/min and integer
-    payloads always use the exact ring (an int8 max would corrupt the
-    winner's exact value; integers must sum exactly)."""
+    ``wire="quant"``/``"q4"``/``"adaptive"`` ships sum/avg over the
+    chunk-pipelined quantized ring (:meth:`..runtime.native.HostComm.
+    allreduce_quant`; two-level under ``DPX_HIER_RING``) — opt-in and
+    only where lossy is safe: float data under sum/avg. max/min and
+    integer payloads always use the exact ring (a quantized max would
+    corrupt the winner's exact value; integers must sum exactly)."""
     x = _to_np(tensor)
     if op not in ("sum", "avg", "max", "min"):
         raise ValueError(f'"{op}" is an invalid reduce operation!')
@@ -76,9 +161,10 @@ def all_reduce(comm, tensor, op: str = "sum", wire: str = "exact"):
             return comm.allreduce(x.copy(), op=op)
         work = comm.allreduce(x.astype(np.float64), op=op)
         return work.astype(orig_dtype) if x.dtype != np.float64 else work
-    if (wire == "quant" and x.dtype.kind not in "iub"
+    if (wire != "exact" and x.dtype.kind not in "iub"
             and comm.world > 1):
-        work = comm.allreduce_q8(x.astype(np.float32, copy=True))
+        work = _quant_allreduce(comm, x.astype(np.float32, copy=True),
+                                wire)
         if op == "avg":
             work = work / comm.world
         return work.astype(orig_dtype) if orig_dtype != np.float32 else work
@@ -128,37 +214,70 @@ def broadcast(comm, tensor, src: int = 0):
     return comm.broadcast(x, src=src)
 
 
+def _broadcast_quant(comm, x: np.ndarray, bits: int) -> np.ndarray:
+    """Broadcast one f32 tensor from rank 0 in the quantized frame form
+    (``[scales][payload]``, nibble-packed at q4). EVERY rank — rank 0
+    included — adopts the dequantized value, so results stay
+    bit-identical across ranks."""
+    n = x.size
+    nb = _wire.num_blocks(n)
+    frame = np.empty(_wire.quant_wire_bytes(n, bits=bits), np.uint8)
+    if comm.rank == 0:
+        q, scales = _wire.quantize_blocks(
+            x.astype(np.float32).ravel(), bits=bits)
+        frame[:4 * nb] = scales.view(np.uint8)
+        frame[4 * nb:] = (_wire.pack_nibbles(q) if bits == 4
+                          else q.view(np.uint8))
+    comm.broadcast(frame, src=0)
+    scales = frame[:4 * nb].view(np.float32)
+    q = (_wire.unpack_nibbles(frame[4 * nb:], n) if bits == 4
+         else frame[4 * nb:].view(np.int8))
+    return _wire.dequantize_blocks(q, scales).reshape(x.shape) \
+        .astype(x.dtype)
+
+
 def sync_params(comm, params: Sequence, wire: str = "exact") -> list:
     """Reference distributed.py:163-170: broadcast each tensor from 0.
 
-    ``wire="quant"``: rank 0 block-quantizes each FLOAT32 tensor
-    (:mod:`.wire` format) and broadcasts the int8+scales frame instead of
-    full-width bytes (~4x less traffic for big param syncs). EVERY rank —
-    rank 0 included — adopts the dequantized value, so params stay
-    bit-identical across ranks (the only guarantee sync_params makes;
-    the absolute values move by at most one quantization step). All
-    other dtypes (integers, f16, f64) always broadcast exact."""
-    _check_wire(wire)
+    ``wire="quant"``/``"q4"``: rank 0 block-quantizes each FLOAT32
+    tensor (:mod:`.wire` format) and broadcasts the payload+scales frame
+    instead of full-width bytes (~4x / ~7.9x less traffic for big param
+    syncs). ``wire="adaptive"``: rank 0 picks the width per tensor from
+    its dynamic range and ships the one-byte verdict ahead of the frame
+    (receivers must know the frame size before the bytes arrive). EVERY
+    rank — rank 0 included — adopts the dequantized value, so params
+    stay bit-identical across ranks (the only guarantee sync_params
+    makes; the absolute values move by at most one quantization step).
+    All other dtypes (integers, f16, f64) always broadcast exact."""
+    width = resolve_wire_width(wire)
+    xs = [_to_np(p) for p in params]
+    # quantize f32 only: f64 would silently lose precision through the
+    # f32 cast beyond the one-step bound, and f16 is already half-width
+    # — both broadcast exact, as do integers
+    quantizable = [i for i, x in enumerate(xs)
+                   if width is not None and x.dtype == np.float32
+                   and comm.world > 1]
+    widths = {}
+    if quantizable and width == "adaptive":
+        # ONE verdict broadcast for the whole tree: rank 0 sees every
+        # tensor up front, so per-tensor verdict round trips would pay
+        # N extra rooted broadcasts for nothing (each is a full round
+        # trip on a high-latency link — the big-param-sync use case)
+        verdicts = np.zeros(len(quantizable), np.uint8)
+        if comm.rank == 0:
+            for j, i in enumerate(quantizable):
+                frac = _wire.block_outlier_frac(xs[i])
+                verdicts[j] = (4 if frac <= _wire.Q4_MAX_OUTLIER_FRAC
+                               else 8)
+        comm.broadcast(verdicts, src=0)
+        widths = {i: int(verdicts[j])
+                  for j, i in enumerate(quantizable)}
+    elif quantizable:
+        widths = {i: width for i in quantizable}
     out = []
-    for p in params:
-        x = _to_np(p)
-        # quantize f32 only: f64 would silently lose precision through
-        # the f32 cast beyond the one-step bound, and f16 is already
-        # half-width — both broadcast exact, as do integers
-        if wire == "quant" and x.dtype == np.float32 and comm.world > 1:
-            n = x.size
-            nb = _wire.num_blocks(n)
-            frame = np.empty(_wire.quant_wire_bytes(n), np.uint8)
-            if comm.rank == 0:
-                q, scales = _wire.quantize_blocks(
-                    x.astype(np.float32).ravel())
-                frame[:4 * nb] = scales.view(np.uint8)
-                frame[4 * nb:] = q.view(np.uint8)
-            comm.broadcast(frame, src=0)
-            scales = frame[:4 * nb].view(np.float32)
-            q = frame[4 * nb:].view(np.int8)
-            out.append(_wire.dequantize_blocks(q, scales)
-                       .reshape(x.shape).astype(x.dtype))
+    for i, x in enumerate(xs):
+        if i in widths:
+            out.append(_broadcast_quant(comm, x, widths[i]))
         else:
             out.append(comm.broadcast(x.copy(), src=0))
     return out

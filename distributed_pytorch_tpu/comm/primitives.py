@@ -106,8 +106,14 @@ def quantized_pmean_wire_bytes(n: int, world: int,
     return 2 * world * (world - 1) * chunk
 
 
-def quantized_pmean(x, axis_name: str, *, block: int = QUANT_BLOCK):
+def quantized_pmean(x, axis_name: str, *, block: int = QUANT_BLOCK,
+                    bits: int = 8):
     """Bandwidth-compressed (int8) mean over a mesh axis — LOSSY.
+
+    ``bits`` selects the wire grid (8 or 4 — comm/wire.py's widths):
+    the q4 grid quantizes to 15 levels per block, the compiled twin of
+    the host ring's nibble-packed wire, chosen per bucket by the
+    adaptive policy in ``parallel.make_train_step``.
 
     The EQuARX recipe (arxiv 2506.17615) mapped onto XLA collectives:
     each device symmetrically int8-quantizes its 1/n chunk-row of the
@@ -139,17 +145,17 @@ def quantized_pmean(x, axis_name: str, *, block: int = QUANT_BLOCK):
     nb = flat.shape[0] // (n * block)
 
     # the shared block codec (ops/quant.py == comm/wire.py rule: clip to
-    # [-127,127] — round(amax/scale) can land on 128 and wrap int8 —
-    # plus the integer-exact snap for small integer payloads)
+    # [-levels,levels] — round(amax/scale) can land past the top level
+    # and wrap — plus the integer-exact snap for small integer payloads)
     from ..ops.quant import dequantize_grad_blocks, quantize_grad_blocks
 
-    q, scale = quantize_grad_blocks(flat.reshape(n, nb, block))
+    q, scale = quantize_grad_blocks(flat.reshape(n, nb, block), bits)
     # row i of the result = device i's row <my_index>: every device
     # ends up holding all n quantized versions of ITS chunk
     rq = all_to_all(q, axis_name, split_axis=0, concat_axis=0)
     rs = all_to_all(scale, axis_name, split_axis=0, concat_axis=0)
     partial = jnp.sum(dequantize_grad_blocks(rq, rs), axis=0) / n  # (nb,blk)
-    q2, scale2 = quantize_grad_blocks(partial)
+    q2, scale2 = quantize_grad_blocks(partial, bits)
     gq = all_gather(q2[None], axis_name, axis=0, tiled=True)
     gs = all_gather(scale2[None], axis_name, axis=0, tiled=True)
     out = dequantize_grad_blocks(gq, gs).ravel()

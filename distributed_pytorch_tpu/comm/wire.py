@@ -3,26 +3,43 @@
 Both comm front doors speak this format when a caller opts into
 ``wire="quant"`` / ``grad_reduce="quant"``:
 
-* the native TCP ring (``native/dpxhost.cpp:dpx_allreduce_q8``) encodes
-  and decodes it in C++ on the host-process front door, and
+* the native TCP ring (``native/dpxhost.cpp:dpx_allreduce_q8`` and the
+  width-parameterized ``dpx_*_qn`` family) encodes and decodes it in
+  C++ on the host-process front door, and
 * the SPMD front door's :func:`..comm.primitives.quantized_pmean` uses
   the same block rule in jnp (via :mod:`..ops.quant`).
 
 **Block codec** (EQuARX-style, arxiv 2506.17615): the flat f32 payload is
 cut into blocks of :data:`QUANT_BLOCK` elements (last block ragged). Per
-block: ``amax = max|v|``; ``scale = 1`` if ``amax == 0``; ``scale = 1``
-if every value is an integer with ``amax <= 127`` (small-magnitude
+block, with ``levels`` = 127 for the 8-bit wire and 7 for the 4-bit
+wire: ``amax = max|v|``; ``scale = 1`` if ``amax == 0``; ``scale = 1``
+if every value is an integer with ``amax <= levels`` (small-magnitude
 integer payloads — step counters, one-hot count buckets — transfer
-EXACTLY); else ``scale = amax/127``. ``q = clip(rint(v * (127/amax)),
--127, 127)`` as int8 (quantization multiplies by the f32 inverse — the
-vectorizable form all three implementations share). One f32 scale per
-block keeps LOCAL dynamic range: a tiny layernorm grad never shares a
-scale with an embedding grad.
+EXACTLY); else ``scale = amax/levels``. ``q = clip(rint(v *
+(levels/amax)), -levels, levels)`` (quantization multiplies by the f32
+inverse — the vectorizable form all three implementations share). One
+f32 scale per block keeps LOCAL dynamic range: a tiny layernorm grad
+never shares a scale with an embedding grad.
+
+**Width selection**: the 8-bit wire is the default. The 4-bit wire packs
+two sign-extended nibbles per byte (:func:`pack_nibbles`) — ~7.9x less
+traffic than f32 — at ~18x the per-hop rounding error of q8, so it is
+chosen PER BUCKET from observed dynamic range: :class:`WidthChooser`
+computes the fraction of blocks whose ``amax/rms`` exceeds
+:data:`DYNRANGE_THRESH` on the (bit-identical-across-ranks) REDUCED
+bucket of the previous step, and flips the width only after
+:data:`WIDTH_HYSTERESIS` consecutive identical verdicts — so the
+compiled-program count stays bounded and all ranks always agree
+(deciding from per-rank raw gradients would diverge).
 
 **Chunk framing**: a contiguous run of blocks is framed as
-``[f32 scales x nblocks][int8 q x nelems]`` — scatter-gather friendly
-(two plain memcpys each side, no per-chunk header; both peers derive
-every length from ``(n, block, chunk_blocks, step)``).
+``[f32 scales x nblocks][payload]`` where the payload is one int8 per
+element (q8) or one packed nibble pair per two elements (q4) —
+scatter-gather friendly (two plain memcpys each side, no per-chunk
+header; both peers derive every length from ``(n, block, chunk_blocks,
+bits, step)``). :data:`QUANT_BLOCK` is even, so every chunk boundary
+falls on an even element offset and per-chunk nibble packing equals the
+packing of the whole span.
 
 **Ring schedule** (:func:`simulate_quant_ring` is the executable spec;
 the C++ implements it chunk-pipelined): reduce-scatter leg — each hop
@@ -60,52 +77,103 @@ QUANT_CHUNK_BLOCKS = 256
 
 SCALE_BYTES = 4  # one f32 scale per block
 
+#: Wire widths the quantized collectives speak (bits per element).
+WIRE_WIDTHS = (8, 4)
+
+
+def quant_levels(bits: int) -> int:
+    """Symmetric integer levels of a wire width: |q| <= levels."""
+    if bits == 8:
+        return 127
+    if bits == 4:
+        return 7
+    raise ValueError(f"wire width must be one of {WIRE_WIDTHS}, got {bits}")
+
+
+def payload_bytes(elems: int, bits: int = 8) -> int:
+    """Wire payload bytes of ``elems`` quantized values (excluding
+    scales): one byte per element at q8, two packed nibbles per byte at
+    q4 (odd tails pad a zero nibble)."""
+    quant_levels(bits)
+    return elems if bits == 8 else (elems + 1) // 2
+
 
 # ---------------------------------------------------------------------------
 # block codec (numpy reference; C++ and jnp mirror it)
 # ---------------------------------------------------------------------------
 
 
-def _block_codec(x: np.ndarray,
-                 block: int = QUANT_BLOCK) -> Tuple[np.ndarray, np.ndarray]:
+def _block_codec(x: np.ndarray, block: int = QUANT_BLOCK,
+                 bits: int = 8) -> Tuple[np.ndarray, np.ndarray]:
     """Per-block (dequant scales, quant inverses) for a flat f32 array.
 
-    Quantization MULTIPLIES by the f32 inverse ``127/amax`` rather than
-    dividing by ``amax/127`` — the native codec does the same (a
+    Quantization MULTIPLIES by the f32 inverse ``levels/amax`` rather
+    than dividing by ``amax/levels`` — the native codec does the same (a
     vectorized multiply), and grids must agree bit for bit. Fully
     vectorized: this runs per training step on the error-feedback path,
     so a per-block Python loop would sit on the hot path the quantized
     ring exists to speed up (zero-padding the ragged tail changes
     neither amax nor the all-integer test)."""
+    levels = np.float32(quant_levels(bits))
     x = np.ascontiguousarray(x, dtype=np.float32).ravel()
     nb = num_blocks(x.size, block)
     pad = nb * block - x.size
     v = (np.pad(x, (0, pad)) if pad else x).reshape(nb, block)
     amax = np.abs(v).max(axis=1)
     # integer-exact snap: small-magnitude integer payloads round-trip
-    # exactly (scale 1, |q| <= 127)
-    unit = (amax == 0.0) | ((amax <= 127.0)
+    # exactly (scale 1, |q| <= levels)
+    unit = (amax == 0.0) | ((amax <= levels)
                             & (v == np.rint(v)).all(axis=1))
     safe = np.where(unit, np.float32(1.0), amax)  # no 0-div warnings
     one = np.float32(1.0)
-    scales = np.where(unit, one, safe / np.float32(127.0))
-    invs = np.where(unit, one, np.float32(127.0) / safe)
+    scales = np.where(unit, one, safe / levels)
+    invs = np.where(unit, one, levels / safe)
     return scales.astype(np.float32), invs.astype(np.float32)
 
 
-def block_scales(x: np.ndarray, block: int = QUANT_BLOCK) -> np.ndarray:
+def block_scales(x: np.ndarray, block: int = QUANT_BLOCK,
+                 bits: int = 8) -> np.ndarray:
     """Per-block dequantization scales for a flat f32 array."""
-    return _block_codec(x, block)[0]
+    return _block_codec(x, block, bits)[0]
 
 
-def quantize_blocks(x: np.ndarray,
-                    block: int = QUANT_BLOCK) -> Tuple[np.ndarray, np.ndarray]:
-    """Flat f32 -> (int8 q of same length, f32 scales per block)."""
+def quantize_blocks(x: np.ndarray, block: int = QUANT_BLOCK,
+                    bits: int = 8) -> Tuple[np.ndarray, np.ndarray]:
+    """Flat f32 -> (int8 q of same length, f32 scales per block).
+
+    ``q`` is UNPACKED (one int8 per element, |q| <= levels) regardless
+    of ``bits`` — the in-memory form the simulations accumulate on;
+    :func:`pack_nibbles` produces the q4 wire bytes."""
     x = np.ascontiguousarray(x, dtype=np.float32).ravel()
-    scales, invs = _block_codec(x, block)
+    levels = quant_levels(bits)
+    scales, invs = _block_codec(x, block, bits)
     per_elem = np.repeat(invs, block)[:x.size]
-    q = np.clip(np.rint(x * per_elem), -127, 127).astype(np.int8)
+    q = np.clip(np.rint(x * per_elem), -levels, levels).astype(np.int8)
     return q, scales
+
+
+def pack_nibbles(q: np.ndarray) -> np.ndarray:
+    """int8 values in [-8, 7] -> packed uint8 wire bytes (two
+    two's-complement nibbles per byte, low nibble first; an odd tail
+    leaves the final high nibble zero). The q4 wire payload form —
+    ``native/dpxhost.cpp`` packs identically."""
+    q = np.ascontiguousarray(q, dtype=np.int8)
+    n = q.size
+    u = (q.astype(np.uint8) & 0x0F)
+    if n % 2:
+        u = np.append(u, np.uint8(0))
+    return (u[0::2] | (u[1::2] << 4)).astype(np.uint8)
+
+
+def unpack_nibbles(packed: np.ndarray, n: int) -> np.ndarray:
+    """Inverse of :func:`pack_nibbles`: ``n`` sign-extended int8 values."""
+    packed = np.ascontiguousarray(packed, dtype=np.uint8)
+    lo = packed & 0x0F
+    hi = packed >> 4
+    u = np.empty(packed.size * 2, np.uint8)
+    u[0::2] = lo
+    u[1::2] = hi
+    return ((u[:n] ^ 8).astype(np.int8) - np.int8(8))
 
 
 def dequantize_blocks(q: np.ndarray, scales: np.ndarray,
@@ -151,10 +219,10 @@ def block_span_elems(start_block: int, nblocks: int, n: int,
 
 
 def span_wire_bytes(start_block: int, nblocks: int, n: int,
-                    block: int = QUANT_BLOCK) -> int:
-    """Wire bytes of a framed run of blocks: scales + int8 payload."""
+                    block: int = QUANT_BLOCK, bits: int = 8) -> int:
+    """Wire bytes of a framed run of blocks: scales + quantized payload."""
     _, elems = block_span_elems(start_block, nblocks, n, block)
-    return SCALE_BYTES * nblocks + elems
+    return SCALE_BYTES * nblocks + payload_bytes(elems, bits)
 
 
 # ---------------------------------------------------------------------------
@@ -162,9 +230,10 @@ def span_wire_bytes(start_block: int, nblocks: int, n: int,
 # ---------------------------------------------------------------------------
 
 
-def quant_wire_bytes(n: int, block: int = QUANT_BLOCK) -> int:
+def quant_wire_bytes(n: int, block: int = QUANT_BLOCK,
+                     bits: int = 8) -> int:
     """Bytes for ONE quantized copy of an n-element payload."""
-    return n + SCALE_BYTES * num_blocks(n, block)
+    return payload_bytes(n, bits) + SCALE_BYTES * num_blocks(n, block)
 
 
 def ring_allreduce_wire_bytes(n: int, world: int, elem_size: int = 4) -> int:
@@ -182,19 +251,22 @@ def ring_allreduce_wire_bytes(n: int, world: int, elem_size: int = 4) -> int:
 
 
 def quant_ring_allreduce_wire_bytes(n: int, world: int,
-                                    block: int = QUANT_BLOCK) -> int:
+                                    block: int = QUANT_BLOCK,
+                                    bits: int = 8) -> int:
     """Total wire bytes (all ranks, both legs) of the quantized ring
-    (``dpx_allreduce_q8``): each segment travels world-1 hops per leg in
-    framed int8+scales form."""
+    (``dpx_allreduce_q8`` / ``dpx_allreduce_qn``): each segment travels
+    world-1 hops per leg in framed payload+scales form."""
     if world <= 1:
         return 0
     total = 0
     for start, cnt in segment_blocks(n, world, block):
-        total += 2 * (world - 1) * span_wire_bytes(start, cnt, n, block)
+        total += 2 * (world - 1) * span_wire_bytes(start, cnt, n, block,
+                                                  bits)
     return total
 
 
-def quant_leg_wire_bytes(n: int, world: int, block: int = QUANT_BLOCK) -> int:
+def quant_leg_wire_bytes(n: int, world: int, block: int = QUANT_BLOCK,
+                         bits: int = 8) -> int:
     """Total wire bytes (all ranks) of ONE leg of the quantized ring —
     ``dpx_reduce_scatter_q8`` or ``dpx_allgather_q8`` each move exactly
     half of :func:`quant_ring_allreduce_wire_bytes` (every segment
@@ -203,7 +275,7 @@ def quant_leg_wire_bytes(n: int, world: int, block: int = QUANT_BLOCK) -> int:
         return 0
     total = 0
     for start, cnt in segment_blocks(n, world, block):
-        total += (world - 1) * span_wire_bytes(start, cnt, n, block)
+        total += (world - 1) * span_wire_bytes(start, cnt, n, block, bits)
     return total
 
 
@@ -234,7 +306,8 @@ def _seg_spans(n: int, w: int, block: int) -> List[slice]:
 
 
 def simulate_quant_reduce_scatter(per_rank: Sequence[np.ndarray],
-                                  block: int = QUANT_BLOCK
+                                  block: int = QUANT_BLOCK,
+                                  bits: int = 8
                                   ) -> Tuple[List[np.ndarray], int]:
     """The reduce-scatter LEG of the quantized ring, simulated.
 
@@ -259,9 +332,10 @@ def simulate_quant_reduce_scatter(per_rank: Sequence[np.ndarray],
         sends = {}
         for r in range(w):
             send_seg = (r - step) % w
-            q, s = quantize_blocks(data[r][spans[send_seg]], block)
+            q, s = quantize_blocks(data[r][spans[send_seg]], block, bits)
             sends[r] = (q, s)
-            bytes_moved += q.size + SCALE_BYTES * s.size
+            bytes_moved += payload_bytes(q.size, bits) \
+                + SCALE_BYTES * s.size
         for r in range(w):
             recv_seg = (r - step - 1) % w
             q, s = sends[(r - 1) % w]
@@ -270,7 +344,8 @@ def simulate_quant_reduce_scatter(per_rank: Sequence[np.ndarray],
 
 
 def simulate_quant_allgather(per_rank: Sequence[np.ndarray],
-                             block: int = QUANT_BLOCK
+                             block: int = QUANT_BLOCK,
+                             bits: int = 8
                              ) -> Tuple[List[np.ndarray], int]:
     """The byte-forwarding all-gather LEG of the quantized ring,
     simulated. Rank r contributes the span :func:`ring_owned_span`
@@ -288,7 +363,7 @@ def simulate_quant_allgather(per_rank: Sequence[np.ndarray],
     wires = {}
     for r in range(w):
         own = (r + 1) % w
-        q, s = quantize_blocks(data[r][spans[own]], block)
+        q, s = quantize_blocks(data[r][spans[own]], block, bits)
         wires[own] = (q, s)
         data[r][spans[own]] = dequantize_blocks(q, s, block)
     for step in range(w - 1):
@@ -296,12 +371,14 @@ def simulate_quant_allgather(per_rank: Sequence[np.ndarray],
             recv_seg = (r - step) % w
             q, s = wires[recv_seg]
             data[r][spans[recv_seg]] = dequantize_blocks(q, s, block)
-            bytes_moved += q.size + SCALE_BYTES * s.size
+            bytes_moved += payload_bytes(q.size, bits) \
+                + SCALE_BYTES * s.size
     return data, bytes_moved
 
 
 def simulate_quant_ring(per_rank: Sequence[np.ndarray],
-                        block: int = QUANT_BLOCK
+                        block: int = QUANT_BLOCK,
+                        bits: int = 8
                         ) -> Tuple[List[np.ndarray], int]:
     """Run the quantized ring schedule on in-memory "ranks".
 
@@ -309,15 +386,169 @@ def simulate_quant_ring(per_rank: Sequence[np.ndarray],
     wire_bytes)`` where ``results[r]`` is rank r's reduced SUM (callers
     divide by world for a mean) and ``wire_bytes`` is the total bytes
     that would cross the wire. The arithmetic (op kind and order) is
-    bit-identical to ``dpx_allreduce_q8``, so this doubles as the parity
-    oracle for the native path — and all results are bit-identical
-    across ranks by construction of the byte-forwarding all-gather leg.
-    Composed from the two standalone leg simulations, exactly like the
-    native op is (``dpx_allreduce_q8`` == reduce-scatter + all-gather)."""
+    bit-identical to ``dpx_allreduce_q8`` (``dpx_allreduce_qn`` at
+    ``bits=4``), so this doubles as the parity oracle for the native
+    path — and all results are bit-identical across ranks by
+    construction of the byte-forwarding all-gather leg. Composed from
+    the two standalone leg simulations, exactly like the native op is
+    (``dpx_allreduce_q8`` == reduce-scatter + all-gather)."""
     shape = per_rank[0].shape
     if len(per_rank) == 1:
         return [np.ascontiguousarray(per_rank[0], dtype=np.float32)
                 .reshape(shape).copy()], 0
-    data, rs_bytes = simulate_quant_reduce_scatter(per_rank, block)
-    data, ag_bytes = simulate_quant_allgather(data, block)
+    data, rs_bytes = simulate_quant_reduce_scatter(per_rank, block, bits)
+    data, ag_bytes = simulate_quant_allgather(data, block, bits)
     return [d.reshape(shape) for d in data], rs_bytes + ag_bytes
+
+
+def simulate_hier_ring(per_rank: Sequence[np.ndarray],
+                       local_world: int,
+                       block: int = QUANT_BLOCK,
+                       bits: int = 8
+                       ) -> Tuple[List[np.ndarray], int]:
+    """The two-level hierarchical ring, simulated — the executable spec
+    of :class:`..comm.hier.HierRing`.
+
+    Ranks are grouped into hosts of ``local_world`` consecutive ranks.
+    Per host the FAST hop runs exact f32: the leader (first rank of the
+    host) accumulates its members' buffers in local-rank order — the
+    same op order as the native rooted ``dpx_reduce_f32`` hub, so the
+    sim stays bit-identical to the real thing. The SLOW hop is the
+    quantized ring (:func:`simulate_quant_ring`) over the per-host
+    partial sums, one designated leader per host; the result broadcasts
+    back exactly. Returns ``(results, slow_hop_bytes)``: results are
+    bit-identical on EVERY rank (leader ring bit-identity + exact
+    broadcast), and ``slow_hop_bytes`` counts only the inter-host
+    (leader-ring) traffic — each gradient byte crosses the slow hop
+    exactly once per leg, ``1/local_world`` of a flat all-ranks ring's
+    slow-hop bytes."""
+    w = len(per_rank)
+    if local_world < 1 or w % local_world:
+        raise ValueError(
+            f"local_world {local_world} must divide world {w}")
+    shape = per_rank[0].shape
+    nh = w // local_world
+    leaders = []
+    for h in range(nh):
+        acc = np.ascontiguousarray(per_rank[h * local_world],
+                                   dtype=np.float32).ravel().copy()
+        for lr in range(1, local_world):
+            acc += np.ascontiguousarray(
+                per_rank[h * local_world + lr],
+                dtype=np.float32).ravel()
+        leaders.append(acc)
+    reduced, slow_bytes = simulate_quant_ring(leaders, block, bits)
+    return ([reduced[r // local_world].reshape(shape).copy()
+             for r in range(w)], slow_bytes)
+
+
+# ---------------------------------------------------------------------------
+# adaptive width selection (EQuARX-style dynamic block-wise width)
+# ---------------------------------------------------------------------------
+
+#: Per-block ``amax/rms`` above this marks the block q4-hostile: one
+#: outlier would claim the whole nibble range and flush its block-mates
+#: to zero. A Gaussian block of 1024 sits near sqrt(2*ln 1024) ~ 3.7.
+DYNRANGE_THRESH = 6.0
+
+#: Fraction of q4-hostile blocks above which the bucket stays on q8.
+Q4_MAX_OUTLIER_FRAC = 0.05
+
+#: Consecutive identical width verdicts required before the wire width
+#: flips — bounds the compiled-program churn on the SPMD front door and
+#: keeps a borderline bucket from flapping 8<->4 every step.
+WIDTH_HYSTERESIS = 2
+
+
+def block_outlier_frac(x: np.ndarray, block: int = QUANT_BLOCK,
+                       thresh: float = DYNRANGE_THRESH) -> float:
+    """Fraction of (nonzero) blocks whose ``amax/rms`` exceeds
+    ``thresh`` — the chooser's dynamic-range statistic, computed on a
+    flat f32 bucket. All-zero blocks are neither counted nor hostile.
+    The ragged tail block's rms divides by its REAL element count — the
+    zero padding this function adds must not read as dynamic range."""
+    x = np.ascontiguousarray(x, dtype=np.float32).ravel()
+    if x.size == 0:
+        return 0.0
+    nb = num_blocks(x.size, block)
+    pad = nb * block - x.size
+    v = (np.pad(x, (0, pad)) if pad else x).reshape(nb, block)
+    amax = np.abs(v).max(axis=1)
+    counts = np.full(nb, block, np.float64)
+    counts[-1] = x.size - (nb - 1) * block
+    rms = np.sqrt(np.square(v, dtype=np.float64).sum(axis=1) / counts)
+    valid = rms > 0.0
+    if not valid.any():
+        return 0.0
+    hostile = valid & (amax > thresh * rms)
+    return float(hostile.sum()) / float(valid.sum())
+
+
+class WidthChooser:
+    """Deterministic per-bucket wire-width policy with hysteresis.
+
+    Feed it the REDUCED bucket after each quantized collective
+    (:meth:`observe`) — that bucket is bit-identical on every rank by
+    the all-gather leg's byte-forwarding construction, so every rank's
+    chooser walks the identical state machine and the next step's width
+    agrees world-wide with zero extra communication. (Deciding from the
+    per-rank RAW gradient would diverge; the schedule recorder would
+    then flag the mismatched op signatures.) The SPMD front door feeds
+    the precomputed statistic instead (:meth:`observe_frac`) so the
+    compiled step only ships one scalar to the host.
+
+    Starts at q8 (safe); drops to q4 only after ``hysteresis``
+    consecutive low-dynamic-range verdicts, and climbs back the same
+    way. ``widths`` records the width used per observed step — the
+    bench's adaptive-width histogram."""
+
+    def __init__(self, *, thresh: float = DYNRANGE_THRESH,
+                 max_frac: float = Q4_MAX_OUTLIER_FRAC,
+                 hysteresis: int = WIDTH_HYSTERESIS,
+                 block: int = QUANT_BLOCK, initial: int = 8):
+        quant_levels(initial)
+        self.thresh = float(thresh)
+        self.max_frac = float(max_frac)
+        self.hysteresis = max(int(hysteresis), 1)
+        self.block = block
+        self._width = initial
+        self._pending_width = initial
+        self._pending_count = 0
+        self.widths: List[int] = []
+
+    @property
+    def width(self) -> int:
+        """The wire width to use for the NEXT quantized collective."""
+        return self._width
+
+    def observe_frac(self, frac: float) -> int:
+        """Fold one bucket's outlier fraction into the state machine;
+        returns the width for the next step."""
+        self.widths.append(self._width)
+        verdict = 4 if float(frac) <= self.max_frac else 8
+        if verdict == self._width:
+            self._pending_count = 0
+            self._pending_width = self._width
+        else:
+            if verdict == self._pending_width:
+                self._pending_count += 1
+            else:
+                self._pending_width = verdict
+                self._pending_count = 1
+            if self._pending_count >= self.hysteresis:
+                self._width = verdict
+                self._pending_count = 0
+        return self._width
+
+    def observe(self, reduced: np.ndarray) -> int:
+        """Observe a reduced bucket (bit-identical across ranks) and
+        return the width for the next step."""
+        return self.observe_frac(
+            block_outlier_frac(reduced, self.block, self.thresh))
+
+    def histogram(self) -> dict:
+        """{width: steps used} over every observed step."""
+        out: dict = {}
+        for b in self.widths:
+            out[b] = out.get(b, 0) + 1
+        return out

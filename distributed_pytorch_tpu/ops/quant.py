@@ -125,28 +125,61 @@ def quantized_bytes(params: Any) -> int:
 # ---------------------------------------------------------------------------
 
 
-def quantize_grad_blocks(v: jnp.ndarray):
-    """Symmetric per-block int8 gradient quantizer.
+def quantize_grad_blocks(v: jnp.ndarray, bits: int = 8):
+    """Symmetric per-block quantizer at a selectable wire width.
 
     ``v``: f32 ``(..., block)`` — the trailing axis is one quantization
-    block. Per block: ``scale = amax/127`` with two snaps matching
-    ``comm/wire.py``: all-zero blocks get scale 1 (exact zeros), and
-    blocks of INTEGERS with ``amax <= 127`` get scale 1 (small-magnitude
-    integer payloads — counters, token tallies — transfer exactly).
-    Returns ``(q int8, scale f32 (..., 1))``.
+    block. Per block, with ``levels`` = 127 (q8) or 7 (q4):
+    ``scale = amax/levels`` with two snaps matching ``comm/wire.py``:
+    all-zero blocks get scale 1 (exact zeros), and blocks of INTEGERS
+    with ``amax <= levels`` get scale 1 (small-magnitude integer
+    payloads — counters, token tallies — transfer exactly).
+    Returns ``(q int8, scale f32 (..., 1))`` — ``q`` stays one int8 per
+    element even at q4 (|q| <= 7): nibble PACKING is a host/wire-framing
+    concern (``comm/wire.py:pack_nibbles``); inside a compiled step the
+    int8 tensor is what the collective moves either way, so the q4 win
+    on the SPMD front door is the coarser grid's role as the adaptive
+    policy's compiled-program twin, not ICI bytes.
     """
+    from ..comm.wire import quant_levels
+    levels = jnp.float32(quant_levels(bits))
     v = v.astype(jnp.float32)
     amax = jnp.max(jnp.abs(v), axis=-1, keepdims=True)
     int_exact = jnp.logical_and(
-        amax <= 127.0,
+        amax <= levels,
         jnp.all(v == jnp.round(v), axis=-1, keepdims=True))
     unit = jnp.logical_or(amax == 0.0, int_exact)
-    scale = jnp.where(unit, jnp.float32(1.0), amax / jnp.float32(127.0))
+    scale = jnp.where(unit, jnp.float32(1.0), amax / levels)
     # quantize by the f32 INVERSE (multiply) — same grid as the native
     # codec and comm/wire.py, which vectorize the multiply
-    inv = jnp.where(unit, jnp.float32(1.0), jnp.float32(127.0) / amax)
-    q = jnp.clip(jnp.round(v * inv), -127, 127).astype(jnp.int8)
+    inv = jnp.where(unit, jnp.float32(1.0), levels / amax)
+    q = jnp.clip(jnp.round(v * inv), -levels, levels).astype(jnp.int8)
     return q, scale
+
+
+def block_outlier_frac_jnp(flat: jnp.ndarray, block: int,
+                           thresh: float) -> jnp.ndarray:
+    """jnp twin of ``comm/wire.py:block_outlier_frac`` — the adaptive
+    width chooser's dynamic-range statistic, computed INSIDE the
+    compiled step on the reduced bucket so only one scalar crosses to
+    the host. All-zero blocks are neither counted nor hostile; the
+    ragged tail's rms divides by its REAL element count (the zero
+    padding added here must not read as dynamic range)."""
+    flat = flat.astype(jnp.float32).ravel()
+    n = flat.shape[0]
+    pad = (-n) % block
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    v = flat.reshape(-1, block)
+    nb = v.shape[0]
+    amax = jnp.max(jnp.abs(v), axis=-1)
+    counts = jnp.full((nb,), block, jnp.float32)
+    if pad:
+        counts = counts.at[-1].set(block - pad)
+    rms = jnp.sqrt(jnp.square(v).sum(axis=-1) / counts)
+    valid = rms > 0.0
+    hostile = jnp.logical_and(valid, amax > jnp.float32(thresh) * rms)
+    return hostile.sum() / jnp.maximum(valid.sum(), 1)
 
 
 def dequantize_grad_blocks(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
@@ -169,26 +202,32 @@ class ErrorFeedback:
         ... lossy all-reduce of `compensated` ...
 
     ``compensate`` adds the carried residual, rounds the result onto the
-    int8 grid it will be transmitted on (so the FIRST wire hop is
+    wire grid it will be transmitted on (so the FIRST wire hop is
     exact), and stores the new residual. Host-resident (numpy) state —
     this wraps the eager per-rank-process reduce path, not the compiled
-    SPMD step.
+    SPMD step. Width-aware: pass ``bits=4`` to round onto the q4 grid —
+    the residual then carries the (larger) q4 rounding error into the
+    next step, so the coarser adaptive wire stays non-compounding
+    exactly like q8; the residual survives width flips unchanged (it is
+    just the un-transmitted remainder, grid-agnostic by construction).
     """
 
-    def __init__(self, block: int = None):
+    def __init__(self, block: int = None, bits: int = 8):
         from ..comm import wire
         self._wire = wire
         self.block = block or wire.QUANT_BLOCK
+        self.bits = bits
         self.residual = None
 
-    def compensate(self, flat):
+    def compensate(self, flat, bits: int = None):
         import numpy as np
 
+        bits = self.bits if bits is None else bits
         flat = np.ascontiguousarray(flat, dtype=np.float32).ravel()
         if self.residual is None or self.residual.size != flat.size:
             self.residual = np.zeros(flat.size, np.float32)
         e = flat + self.residual
-        q, s = self._wire.quantize_blocks(e, self.block)
+        q, s = self._wire.quantize_blocks(e, self.block, bits)
         grid = self._wire.dequantize_blocks(q, s, self.block)
         self.residual = e - grid
         return grid

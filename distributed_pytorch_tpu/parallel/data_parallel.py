@@ -54,10 +54,34 @@ class StatefulStepOutput(NamedTuple):
     metrics: Any
 
 
+#: grad_reduce spellings accepted by :func:`make_train_step`.
+GRAD_REDUCE_MODES = ("mean", "int8", "quant", "q4", "adaptive")
+
+
+def _leaf_offsets(leaves, block: int):
+    """Start offset of each leaf inside the block-padded flat bucket."""
+    offs, off = [], 0
+    for g in leaves:
+        offs.append(off)
+        off += g.size + ((-g.size) % block)
+    return offs
+
+
+def _wire_format(grad_reduce: str) -> str:
+    """Map a grad_reduce spelling onto the front doors' wire-format
+    vocabulary (comm/host_backend.WIRE_FORMATS)."""
+    if grad_reduce in ("quant", "int8"):
+        return "quant"
+    return grad_reduce  # "q4" / "adaptive" pass through
+
+
 def make_train_step(loss_fn: Callable, optimizer: Optimizer,
                     donate: bool = True,
                     grad_reduce: str = "mean",
-                    weight_update: Optional[str] = None) -> Callable:
+                    weight_update: Optional[str] = None,
+                    overlap: Optional[bool] = None,
+                    comm_buckets: Optional[int] = None,
+                    on_bucket_ready: Optional[Callable] = None) -> Callable:
     """Compile a data-parallel training step.
 
     ``loss_fn(params, batch) -> (loss, metrics)`` where ``loss`` is the
@@ -69,16 +93,35 @@ def make_train_step(loss_fn: Callable, optimizer: Optimizer,
     reference's graceful-degradation contract (``distributed.py:54-58``).
 
     ``grad_reduce``: ``"mean"`` (exact all-reduce, the reference's DDP
-    semantics) or ``"quant"`` (alias ``"int8"``) — the
-    bandwidth-compressed lossy mean, ~4x less gradient traffic for
-    bandwidth-bound interconnects where SGD noise dwarfs the bounded
-    quantization error. Both front doors honor it: the SPMD path
-    quantizes the stacked-leaf bucket before the ``dp``-axis reduce
-    (:func:`..comm.primitives.quantized_pmean`); the host front door
-    ships the flat bucket over the native chunk-pipelined int8 ring
-    (``dpx_allreduce_q8``) with an error-feedback residual
-    (:class:`..ops.quant.ErrorFeedback`) carrying each step's
-    quantization error into the next step's bucket.
+    semantics), ``"quant"`` (alias ``"int8"``; wire width from the
+    typed ``DPX_WIRE_WIDTH`` knob, default 8-bit), ``"q4"`` (force the
+    nibble-packed 4-bit wire, ~7.9x less gradient traffic than f32), or
+    ``"adaptive"`` (per-bucket width from observed dynamic range with
+    hysteresis — :class:`..comm.wire.WidthChooser`; the chooser state
+    is exposed as ``step.width_chooser``). Both front doors honor every
+    mode: the SPMD path quantizes the stacked-leaf bucket before the
+    ``dp``-axis reduce (:func:`..comm.primitives.quantized_pmean`; the
+    adaptive mode compiles ONE program per width — bounded by the
+    chooser's hysteresis — and ships one scalar dynamic-range statistic
+    to the host per step); the host front door ships the flat bucket
+    over the native chunk-pipelined quantized ring with an
+    error-feedback residual (:class:`..ops.quant.ErrorFeedback`)
+    carrying each step's quantization error — q4's larger one included
+    — into the next step's bucket. Under ``DPX_HIER_RING=L`` the host
+    bucket rides the two-level hierarchical ring (:mod:`..comm.hier`).
+
+    ``overlap`` (host front door; default from ``DPX_COMM_OVERLAP``):
+    split the gradient tree into ``comm_buckets`` buckets
+    (``DPX_COMM_BUCKETS`` default) and issue each bucket's ring traffic
+    as soon as its leaves materialize — while later buckets' backward
+    is still executing on the device — instead of one blocking reduce
+    after the full backward. Non-final buckets' comm time lands in
+    CommStats ``overlapped_s``; only the final bucket's is ``exposed_s``
+    (docs/comms.md has the accounting contract). ``on_bucket_ready(b,
+    n_buckets, nbytes)`` is called as each bucket becomes host-visible
+    — the hook a custom trainer uses to interleave its own work. The
+    compiled SPMD path ignores these (XLA already schedules the fused
+    reduce against compute).
 
     ``weight_update``: ``"replicated"`` (every rank runs the full
     optimizer step — DDP/torch semantics) or ``"sharded"`` (ZeRO-1,
@@ -88,11 +131,14 @@ def make_train_step(loss_fn: Callable, optimizer: Optimizer,
     typed env knob ``DPX_WEIGHT_UPDATE``. The sharded step's
     ``opt_state`` comes from the returned step's
     ``init_opt_state(params)``, not ``optimizer.init`` — the moments
-    live on flat 1/world slices.
+    live on flat 1/world slices. The sharded path speaks the fixed q8
+    wire only (its gather leg's error feedback owns the exact master
+    copy); combine q4/adaptive with ``weight_update="replicated"``.
     """
-    if grad_reduce not in ("mean", "int8", "quant"):
-        raise ValueError(f"grad_reduce must be mean|quant|int8, "
-                         f"got {grad_reduce!r}")
+    if grad_reduce not in GRAD_REDUCE_MODES:
+        raise ValueError(
+            f"grad_reduce must be one of {'|'.join(GRAD_REDUCE_MODES)}, "
+            f"got {grad_reduce!r}")
     if weight_update is None:
         from ..runtime import env as _env
         weight_update = _env.get("DPX_WEIGHT_UPDATE")
@@ -100,17 +146,26 @@ def make_train_step(loss_fn: Callable, optimizer: Optimizer,
         raise ValueError(f"weight_update must be replicated|sharded, "
                          f"got {weight_update!r}")
     if weight_update == "sharded":
+        if grad_reduce in ("q4", "adaptive"):
+            raise ValueError(
+                "weight_update='sharded' supports grad_reduce mean|"
+                "quant|int8 only (the sharded gather leg pins the q8 "
+                "grid its exact-master error feedback assumes); use "
+                "weight_update='replicated' with q4/adaptive")
         from ..optim.sharded import make_sharded_train_step
         return make_sharded_train_step(loss_fn, optimizer, donate=donate,
                                        grad_reduce=grad_reduce)
     world = context.get_world_size()
     if context.get_host_comm() is not None:
         return _make_host_train_step(loss_fn, optimizer,
-                                     grad_reduce=grad_reduce)
+                                     grad_reduce=grad_reduce,
+                                     overlap=overlap,
+                                     comm_buckets=comm_buckets,
+                                     on_bucket_ready=on_bucket_ready)
 
-    def _reduce_grads(grads):
+    def _reduce_grads(grads, bits=8, want_flat=False):
         if grad_reduce == "mean":
-            return prim.pmean(grads, DATA_AXIS)
+            return prim.pmean(grads, DATA_AXIS), None
         # ONE compressed collective pair for the whole tree: flatten
         # every leaf into a single f32 bucket, reduce, unflatten —
         # dozens of per-leaf all-to-alls would pay per-collective
@@ -127,94 +182,330 @@ def make_train_step(loss_fn: Callable, optimizer: Optimizer,
             f = jnp.ravel(g).astype(jnp.float32)
             pad = (-f.shape[0]) % bs
             padded.append(jnp.pad(f, (0, pad)) if pad else f)
-        red = prim.quantized_pmean(jnp.concatenate(padded), DATA_AXIS)
+        red = prim.quantized_pmean(jnp.concatenate(padded), DATA_AXIS,
+                                   bits=bits)
         out, off = [], 0
         for g in leaves:
             out.append(red[off:off + g.size].reshape(g.shape)
                        .astype(g.dtype))
             off += g.size + ((-g.size) % bs)
-        return jax.tree_util.tree_unflatten(treedef, out)
+        # the chooser statistic runs on the UNPADDED concatenation —
+        # the per-leaf pad zeros above would deflate their blocks' rms
+        # and read as dynamic range, pinning the adaptive width at q8
+        # for any model with many small leaves; dropping them also
+        # matches the host front door's chooser input (raw ravel
+        # concat), so both front doors walk the same policy
+        flat = jnp.concatenate(
+            [red[o:o + g.size] for o, g in
+             zip(_leaf_offsets(leaves, bs), leaves)]) \
+            if want_flat else None
+        return jax.tree_util.tree_unflatten(treedef, out), flat
 
-    def local_step(params, opt_state, batch):
-        (loss, metrics), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params, batch)
-        if world > 1:
-            grads = _reduce_grads(grads)
-        params, opt_state = optimizer.update(grads, opt_state, params)
-        return params, opt_state, loss[None], metrics
+    adaptive = grad_reduce == "adaptive" and world > 1
+    fixed_bits = 8
+    if grad_reduce in ("quant", "int8", "q4") and world > 1:
+        from ..comm import host_backend as _hb
+        resolved = _hb.resolve_wire_width(_wire_format(grad_reduce))
+        if resolved == "adaptive":      # DPX_WIRE_WIDTH=adaptive
+            adaptive = True
+        else:
+            fixed_bits = resolved
+
+    def make_local_step(bits, want_stat):
+        def local_step(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            stat = jnp.float32(0.0)
+            if world > 1:
+                grads, red = _reduce_grads(grads, bits,
+                                           want_flat=want_stat)
+                if want_stat and red is not None:
+                    from ..comm.wire import DYNRANGE_THRESH
+                    from ..ops.quant import block_outlier_frac_jnp
+                    stat = block_outlier_frac_jnp(
+                        red, prim.QUANT_BLOCK, DYNRANGE_THRESH)
+            params, opt_state = optimizer.update(grads, opt_state, params)
+            return params, opt_state, loss[None], metrics, stat
+        return local_step
 
     if world == 1:
+        inner = make_local_step(8, False)
+
         def step(params, opt_state, batch):
-            return StepOutput(*local_step(params, opt_state, batch))
+            return StepOutput(*inner(params, opt_state, batch)[:4])
         return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
     mesh = context.get_mesh()
-    sharded = shard_map(
-        local_step, mesh=mesh,
-        in_specs=(P(), P(), P(DATA_AXIS)),
-        out_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
-        check_vma=False,
-    )
+
+    def compile_width(bits, want_stat):
+        sharded = shard_map(
+            make_local_step(bits, want_stat), mesh=mesh,
+            in_specs=(P(), P(), P(DATA_AXIS)),
+            out_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+
+    if not adaptive:
+        prog = compile_width(fixed_bits, False)
+
+        def step(params, opt_state, batch):
+            return StepOutput(*prog(params, opt_state, batch)[:4])
+        return step
+
+    # adaptive: one compiled program per width (the chooser's hysteresis
+    # bounds the flapping, so at most two programs ever exist); the
+    # dynamic-range statistic is computed INSIDE the step on the reduced
+    # bucket — bit-identical across devices — and only that scalar
+    # crosses to the host, where the chooser (shared policy with the
+    # host front door) picks the next step's program.
+    from ..comm.wire import WidthChooser
+    chooser = WidthChooser()
+    progs = {8: compile_width(8, True), 4: compile_width(4, True)}
 
     def step(params, opt_state, batch):
-        return StepOutput(*sharded(params, opt_state, batch))
+        p, o, loss, metrics, stat = progs[chooser.width](
+            params, opt_state, batch)
+        chooser.observe_frac(float(stat))
+        return StepOutput(p, o, loss, metrics)
 
-    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    step.width_chooser = chooser
+    return step
+
+
+def _partition_contiguous(sizes, k: int):
+    """Split leaf indices into <= k contiguous groups balanced by
+    element count (greedy by the running target). Deterministic in the
+    sizes alone, so every rank partitions identically."""
+    k = max(1, min(int(k), len(sizes)))
+    if k == 1:
+        return [list(range(len(sizes)))]
+    total = sum(sizes)
+    groups, cur, acc = [], [], 0
+    for i, s in enumerate(sizes):
+        cur.append(i)
+        acc += s
+        # close the group once the cumulative count crosses the next
+        # k-quantile of the total (k is a cap — tiny trees yield fewer)
+        if acc * k >= total * (len(groups) + 1) \
+                and len(groups) < k - 1:
+            groups.append(cur)
+            cur = []
+    if cur:
+        groups.append(cur)
+    return groups
 
 
 def _make_host_train_step(loss_fn: Callable, optimizer: Optimizer,
-                          grad_reduce: str = "mean") -> Callable:
+                          grad_reduce: str = "mean",
+                          overlap: Optional[bool] = None,
+                          comm_buckets: Optional[int] = None,
+                          on_bucket_ready: Optional[Callable] = None
+                          ) -> Callable:
     """Per-rank-process DDP step (host front door): compiled local
-    forward/backward, then ONE native ring allreduce over a single flat
-    gradient bucket (the reference DDP reducer's bucketed gradient
-    averaging, SURVEY.md §2.3 row 4), then compiled optimizer update.
+    forward/backward, then native ring allreduce(s) over flat gradient
+    bucket(s) (the reference DDP reducer's bucketed gradient averaging,
+    SURVEY.md §2.3 row 4), then compiled optimizer update.
 
     Same ``step(params, opt_state, batch) -> StepOutput`` signature as the
     SPMD path, but ``batch`` is this rank's LOCAL batch and ``loss`` has
     shape (1,) (this rank's mean loss) — each process holds only its own
     view, exactly like the reference's workers.
 
-    ``grad_reduce="quant"``/``"int8"``: the bucket rides the native
-    chunk-pipelined int8 ring (~4x less TCP traffic). An
-    :class:`..ops.quant.ErrorFeedback` residual (per process, carried
-    across steps) pre-rounds the bucket onto its wire grid, so the first
-    hop transmits exactly and systematic rounding bias cancels over
+    ``grad_reduce="quant"``/``"int8"``/``"q4"``/``"adaptive"``: the
+    bucket rides the native chunk-pipelined quantized ring (width per
+    the mode / ``DPX_WIRE_WIDTH``; two-level under ``DPX_HIER_RING``).
+    A per-bucket :class:`..ops.quant.ErrorFeedback` residual (per
+    process, carried across steps) pre-rounds the bucket onto its
+    CURRENT wire grid, so the first hop transmits exactly and
+    systematic rounding bias — q4's larger step included — cancels over
     steps. The reduced bucket is bit-identical on every rank, so ranks
-    cannot drift apart.
+    cannot drift apart, and the adaptive chooser feeding on it steps
+    identically world-wide (asserted via the schedule recorder).
+
+    ``overlap``: split the gradient tree into buckets and pipeline each
+    bucket's ring traffic against the PREVIOUS bucket's optimizer
+    update, which is dispatched asynchronously on the device and left
+    unfenced while the next bucket's comm blocks the control thread.
+    (With one fused backward, XLA delivers ALL gradients atomically —
+    there is no later-layer backward left to hide behind once the first
+    leaf is host-visible; the genuinely overlappable device work on
+    this front door is the replicated optimizer update, which the
+    dp8_sharded bench showed DOMINATES the replicated step.) Accounting
+    is MEASURED, not positional: comm counts as ``overlapped_s`` only
+    when a previously dispatched bucket update was genuinely still
+    executing at issue time (``jax.Array.is_ready``), else
+    ``exposed_s``. The overlapped step keeps per-bucket optimizer
+    states — take ``opt_state`` from the exposed
+    ``step.init_opt_state(params)`` (the PR 7 convention the examples
+    already follow); per-bucket updates are numerically identical for
+    elementwise optimizers (each bucket keeps its own identical step
+    counter) — wrappers that reduce ACROSS leaves (global-norm
+    clipping) are unsupported under overlap, same restriction as the
+    sharded update.
     """
     import numpy as np
 
+    from ..comm import host_backend as _hb
     from ..ops.quant import ErrorFeedback
+    from ..runtime import env as _envmod
 
     comm = context.get_host_comm()
     world = comm.world
-    quant = grad_reduce in ("quant", "int8")
-    ef = ErrorFeedback() if quant else None
+    quant = grad_reduce != "mean"
+    width = _hb.resolve_wire_width(_wire_format(grad_reduce)) \
+        if quant else None
+    chooser = None
+    if width == "adaptive":
+        from ..comm.wire import WidthChooser
+        chooser = WidthChooser()
+    local_world = int(_envmod.get("DPX_HIER_RING"))
+    use_hier = quant and local_world > 1 and world % local_world == 0
+    if overlap is None:
+        overlap = bool(_envmod.get("DPX_COMM_OVERLAP"))
+    n_buckets = comm_buckets if comm_buckets is not None \
+        else int(_envmod.get("DPX_COMM_BUCKETS"))
+    if not overlap:
+        n_buckets = 1
 
     vg = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
     upd = jax.jit(optimizer.update)
+    efs = {}  # bucket index -> ErrorFeedback (sizes differ per bucket)
+
+    def _ring(flat, bits, hidden):
+        if use_hier:
+            from ..comm.hier import hier_ring
+            hier_ring(comm, local_world).allreduce(flat, bits=bits,
+                                                   hidden=hidden)
+        elif bits == 4:
+            comm.allreduce_q4(flat, hidden=hidden)
+        else:
+            comm.allreduce_q8(flat, hidden=hidden)
+
+    def _reduce_bucket(b, flat, bits, hidden):
+        if quant:
+            ef = efs.setdefault(b, ErrorFeedback())
+            flat = ef.compensate(flat, bits=bits)
+            _ring(flat, bits, hidden)
+        else:
+            comm.allreduce(flat, hidden=hidden)
+        flat /= world  # DDP averages gradients
+        return flat
+
+    def _observe(reduced):
+        if chooser is not None:
+            # the chooser feeds on the reduced MEAN bucket — identical
+            # bits on every rank (quant ring bit-identity), so the
+            # width state machine cannot diverge across ranks
+            chooser.observe(np.concatenate(reduced)
+                            if len(reduced) > 1 else reduced[0])
+
+    if not overlap:
+        def step(params, opt_state, batch):
+            (loss, metrics), grads = vg(params, batch)
+            leaves, tree = jax.tree_util.tree_flatten(grads)
+            bits = chooser.width if chooser is not None else (width or 8)
+            flat = np.concatenate(
+                [np.asarray(l, dtype=np.float32).ravel()
+                 for l in leaves])
+            if on_bucket_ready is not None:
+                on_bucket_ready(0, 1, flat.nbytes)
+            flat = _reduce_bucket(0, flat, bits, False)
+            _observe([flat])
+            outs, off = [], 0
+            for l in leaves:
+                outs.append(jnp.asarray(
+                    flat[off:off + l.size].reshape(l.shape),
+                    dtype=l.dtype))
+                off += l.size
+            grads = jax.tree_util.tree_unflatten(tree, outs)
+            params, opt_state = upd(grads, opt_state, params)
+            return StepOutput(params, opt_state,
+                              jnp.asarray(loss)[None], metrics)
+
+        step.width_chooser = chooser
+        return step
+
+    # -- overlapped path: per-bucket states + interleaved async updates
+
+    def _groups_for(tree_like):
+        return _partition_contiguous(
+            [l.size for l in jax.tree_util.tree_leaves(tree_like)],
+            n_buckets)
+
+    def init_opt_state(params):
+        leaves = jax.tree_util.tree_leaves(params)
+        return [optimizer.init([leaves[i] for i in idx])
+                for idx in _groups_for(params)]
+
+    def _outstanding(pending):
+        # MEASURED overlap: a dispatched update counts as outstanding
+        # only while the device genuinely hasn't finished it (is_ready
+        # is False). Backends without is_ready fall back to "dispatched
+        # and unfenced = outstanding".
+        for leaf in pending:
+            ready = getattr(leaf, "is_ready", None)
+            if ready is None:
+                return True
+            if not ready():
+                return True
+        return False
 
     def step(params, opt_state, batch):
         (loss, metrics), grads = vg(params, batch)
-        leaves, tree = jax.tree_util.tree_flatten(grads)
-        flat = np.concatenate(
-            [np.asarray(l, dtype=np.float32).ravel() for l in leaves])
-        if quant:
-            flat = ef.compensate(flat)
-            comm.allreduce_q8(flat)
-        else:
-            comm.allreduce(flat)
-        flat /= world  # DDP averages gradients
-        out, off = [], 0
-        for l in leaves:
-            n = l.size
-            out.append(jnp.asarray(flat[off:off + n].reshape(l.shape),
-                                   dtype=l.dtype))
-            off += n
-        grads = jax.tree_util.tree_unflatten(tree, out)
-        params, opt_state = upd(grads, opt_state, params)
-        return StepOutput(params, opt_state, jnp.asarray(loss)[None], metrics)
+        gleaves, gtree = jax.tree_util.tree_flatten(grads)
+        pleaves = jax.tree_util.tree_leaves(params)
+        groups = _partition_contiguous([l.size for l in gleaves],
+                                       n_buckets)
+        # a LIST specifically: optimizer states are NamedTuples/dicts/
+        # bare tuples, so requiring the exact container init_opt_state
+        # returns keeps a full-tree state from ever being indexed as
+        # per-bucket states (an AdamWState IS a 3-tuple — a len check
+        # alone can collide with a 3-bucket partition)
+        if not isinstance(opt_state, list) \
+                or len(opt_state) != len(groups):
+            raise TypeError(
+                "the overlapped host step keeps PER-BUCKET optimizer "
+                "states — build opt_state with step.init_opt_state("
+                "params), not optimizer.init")
+        bits = chooser.width if chooser is not None else (width or 8)
+        new_p = [None] * len(gleaves)
+        new_states = [None] * len(groups)
+        pending = []   # dispatched, unfenced update outputs
+        reduced = []
+        for b, idx in enumerate(groups):
+            flat = np.concatenate(
+                [np.asarray(gleaves[i], dtype=np.float32).ravel()
+                 for i in idx])
+            if on_bucket_ready is not None:
+                on_bucket_ready(b, len(groups), flat.nbytes)
+            hidden = _outstanding(pending)
+            flat = _reduce_bucket(b, flat, bits, hidden)
+            reduced.append(flat)
+            g_sub, off = [], 0
+            for i in idx:
+                n = gleaves[i].size
+                g_sub.append(jnp.asarray(
+                    flat[off:off + n].reshape(gleaves[i].shape),
+                    dtype=gleaves[i].dtype))
+                off += n
+            # dispatch this bucket's update and DON'T fence it: the
+            # device chews on it while the next bucket's ring traffic
+            # blocks the control thread — that concurrency is what the
+            # is_ready probe above measures into overlapped_s
+            out_p, out_state = upd(g_sub, opt_state[b],
+                                   [pleaves[i] for i in idx])
+            pending.extend(out_p)
+            for j, i in enumerate(idx):
+                new_p[i] = out_p[j]
+            new_states[b] = out_state
+        _observe(reduced)
+        params = jax.tree_util.tree_unflatten(gtree, new_p)
+        return StepOutput(params, new_states,
+                          jnp.asarray(loss)[None], metrics)
 
+    step.width_chooser = chooser
+    step.init_opt_state = init_opt_state
     return step
 
 

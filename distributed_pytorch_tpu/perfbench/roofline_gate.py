@@ -27,9 +27,12 @@ from typing import Optional
 
 __all__ = ["attach_flagship", "ROOFLINE_KEYS"]
 
-#: The analyze()/attach_measured() fields that travel with the record.
+#: The analyze()/attach_measured()/comm_ceilings() fields that travel
+#: with the record (comm_* appear on distributed arms only).
 ROOFLINE_KEYS = ("compute_floor_ms", "hbm_floor_ms", "bound",
                  "mfu_ceiling", "mfu_ceiling_no_overlap",
+                 "comm_floor_ms", "comm_wire_bits", "comm_dp_world",
+                 "mfu_ceiling_comm_overlap", "mfu_ceiling_comm_exposed",
                  "measured_step_ms", "efficiency_gap_x")
 
 
@@ -59,6 +62,14 @@ def attach_flagship(rec: dict, *, announce: bool = True) -> dict:
             and no_overlap:
         achieved = round(float(value) / no_overlap, 4)
         out["achieved_over_ceiling_no_overlap"] = achieved
+        if ceiling:
+            # the record reports achieved against BOTH extremes: the
+            # no-overlap floor (real executions should beat it once
+            # comm/memory hide behind compute) and the perfectly
+            # overlapped ceiling (nothing real exceeds it — which is
+            # exactly why the plausibility gate below uses THIS one)
+            out["achieved_over_ceiling_overlapped"] = round(
+                float(value) / ceiling, 4)
         if ceiling is not None and float(value) > ceiling:
             # an MFU above the overlapped ceiling cannot have been a real
             # chip measurement — poison it structurally, keep the value

@@ -251,6 +251,26 @@ register("DPX_SCHEDULE_WINDOW", "int", 64,
          "How many recent per-rank collective records the runtime "
          "schedule verifier keeps for divergence reports (0 disables "
          "recording; docs/analysis.md).")
+register("DPX_WIRE_WIDTH", "str", "8",
+         "Default wire width of the quantized collectives under "
+         "`wire=\"quant\"`/`grad_reduce=\"quant\"`: `8` (block int8), "
+         "`4` (nibble-packed, ~7.9x less traffic than f32), or "
+         "`adaptive` (per-bucket WidthChooser with hysteresis; "
+         "docs/comms.md).")
+register("DPX_HIER_RING", "int", 0,
+         "Ranks per host of the two-level hierarchical ring (0/1 = "
+         "flat). When it divides the world, the quantized gradient "
+         "reduce runs exact intra-host to one leader per host and the "
+         "quantized ring only between leaders — each gradient byte "
+         "crosses the slow hop once (comm/hier.py, docs/comms.md).")
+register("DPX_COMM_OVERLAP", "bool", False,
+         "Overlap gradient-bucket ring traffic with still-running "
+         "backward compute in the host train step (bucketed issue + "
+         "CommStats overlapped/exposed accounting; docs/comms.md).")
+register("DPX_COMM_BUCKETS", "int", 4,
+         "Gradient bucket count of the overlapped host train step "
+         "(clamped to the leaf count; only read when the overlap path "
+         "is active).")
 
 # -- observability ----------------------------------------------------------
 register("DPX_METRICS_LOG", "str", None,
@@ -335,6 +355,10 @@ register("DPX_BENCH_BUDGET_S", "float", 120.0,
          "stats.py; the loopback dp8 smoke runs under it).")
 register("DPX_BENCH_SHARDED_ELEMS", "int", 0,
          "Bucket elements of the dp8_sharded_adam bench arm (0 = the "
+         "full-size default; the CI smoke sets a small bucket to stay "
+         "seconds-scale — bench.py).")
+register("DPX_BENCH_HIER_ELEMS", "int", 0,
+         "Bucket elements of the dp8_hier_adaptive bench arm (0 = the "
          "full-size default; the CI smoke sets a small bucket to stay "
          "seconds-scale — bench.py).")
 register("DPX_BENCH_MIN_DROP", "float", 0.10,

@@ -105,10 +105,17 @@ _INT_KEYS = ("step", "rank", "call", "ms", "attempt")
 #: Comm-layer op names that fire op-scoped specs from :func:`on_comm_op`
 #: (the HostComm hook sites; informational — the grammar accepts any op
 #: string, this is the registry of names the runtime actually emits).
-#: ``reduce_scatter``/``allgather`` are the sharded-weight-update legs
-#: (optim/sharded/); ``ckpt*`` ops fire from the checkpoint save path
-#: and ``serve_step`` from the serving engine's iteration hook.
-COMM_OPS = ("allreduce", "allreduce_q8", "reduce_scatter", "allgather",
+#: ``allreduce_q4`` is the 4-bit adaptive-wire ring (the width is part
+#: of the op name, so a width-scoped fault targets exactly the q4
+#: steps); ``reduce_scatter``/``allgather`` are the sharded-weight-
+#: update legs (optim/sharded/); ``hier_reduce``/``hier_gather`` are
+#: the two phases of the hierarchical two-level ring (comm/hier.py —
+#: ``kill@op=hier_reduce`` dies entering the intra-host reduce +
+#: leader-ring scatter phase); ``ckpt*`` ops fire from the checkpoint
+#: save path and ``serve_step`` from the serving engine's iteration
+#: hook.
+COMM_OPS = ("allreduce", "allreduce_q8", "allreduce_q4",
+            "reduce_scatter", "allgather", "hier_reduce", "hier_gather",
             "reduce", "gather", "broadcast", "barrier",
             "ckpt", "ckpt_commit", "ckpt_commit_window", "serve_step",
             "page_admit", "page_evict")

@@ -159,6 +159,16 @@ def load_library():
                            ctypes.POINTER(ctypes.c_float),
                            ctypes.c_int64, ctypes.c_int, ctypes.c_int]
             fn.restype = ctypes.c_int
+        # width-parameterized quantized ring family (trailing int =
+        # wire bits, 8 or 4 — the adaptive wire's native face)
+        for name in ("dpx_allreduce_qn", "dpx_reduce_scatter_qn",
+                     "dpx_allgather_qn"):
+            fn = getattr(lib, name)
+            fn.argtypes = [ctypes.c_void_p,
+                           ctypes.POINTER(ctypes.c_float),
+                           ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+                           ctypes.c_int]
+            fn.restype = ctypes.c_int
         lib.dpx_reduce_f32.argtypes = [ctypes.c_void_p,
                                        ctypes.POINTER(ctypes.c_float),
                                        ctypes.c_int64]
@@ -251,9 +261,19 @@ class HostComm:
         self.op_timeout_ms = op_timeout_ms
         self.rank = rank
         self.world = world
+        # remembered so derived sub-communicators (the hierarchical
+        # ring's local/leader groups, comm/hier.py) can rendezvous on
+        # deterministic ports relative to this group's
+        self.master_addr = master_addr
+        self.base_port = base_port
+        self._hier_ring = None   # comm.hier.hier_ring() cache
         _faults.register_comm(self)
 
     def close(self):
+        ring = getattr(self, "_hier_ring", None)
+        self._hier_ring = None
+        if ring is not None:
+            ring.close()
         if self._h:
             self._lib.dpx_comm_destroy(self._h)
             self._h = None
@@ -309,12 +329,15 @@ class HostComm:
         raise CommError(f"native {what} failed {where} rc={rc}",
                         op=what, rank=self.rank, peer=peer)
 
-    def allreduce(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
+    def allreduce(self, arr: np.ndarray, op: str = "sum",
+                  hidden: bool = False) -> np.ndarray:
         """In-place ring allreduce on a float32/float64 array.
 
         ``op``: ``sum`` (the classic ring) or elementwise ``max``/``min``
         — same ring, same 2*(W-1)/W bytes per rank (the max/min path used
         to all-gather the whole tensor from every rank, W x the traffic).
+        ``hidden``: account the comm time as overlapped with
+        still-running backward compute (CommStats).
         """
         if op not in self._OPS:
             raise ValueError(f"allreduce op must be sum|max|min, got {op!r}")
@@ -324,7 +347,7 @@ class HostComm:
         code = self._OPS[op]
         nbytes = self._wire.ring_allreduce_wire_bytes(
             arr.size, self.world, arr.dtype.itemsize) // max(self.world, 1)
-        with self.stats.timed(f"allreduce_{op}", nbytes):
+        with self.stats.timed(f"allreduce_{op}", nbytes, hidden=hidden):
             if arr.dtype == np.float32:
                 rc = self._lib.dpx_allreduce_f32_op(
                     self._h,
@@ -341,72 +364,114 @@ class HostComm:
         self._check(rc, "allreduce")
         return arr
 
-    def allreduce_q8(self, arr: np.ndarray, block: int = None,
-                     chunk_blocks: int = None) -> np.ndarray:
-        """In-place QUANTIZED ring allreduce (sum) on a float32 array.
+    def allreduce_quant(self, arr: np.ndarray, bits: int = 8,
+                        block: int = None, chunk_blocks: int = None,
+                        hidden: bool = False) -> np.ndarray:
+        """In-place QUANTIZED ring allreduce (sum) on a float32 array at
+        a selectable wire width.
 
-        Block-scaled int8 wire format (comm/wire.py), chunk-pipelined;
-        LOSSY (one quantization step per hop) but bit-identical across
-        ranks. ~4x less wire traffic than :meth:`allreduce`."""
+        Block-scaled wire format (comm/wire.py), chunk-pipelined and
+        double-buffered (chunk i+1 quantizes while chunk i is on the
+        wire); LOSSY (one quantization step per hop) but bit-identical
+        across ranks. ``bits=8``: ~4x less wire traffic than
+        :meth:`allreduce`; ``bits=4``: ~7.9x (nibble-packed), at ~18x
+        the per-hop rounding error — pick per bucket with
+        :class:`~..comm.wire.WidthChooser`. The op is recorded as
+        ``allreduce_q8``/``allreduce_q4``, so a cross-rank width
+        disagreement shows up as a schedule divergence, not silent
+        corruption. ``hidden``: account the comm time as overlapped
+        with still-running backward compute (CommStats)."""
         block = block or self._wire.QUANT_BLOCK
         chunk_blocks = chunk_blocks or self._wire.QUANT_CHUNK_BLOCKS
+        self._wire.quant_levels(bits)
+        op = f"allreduce_q{bits}"
         arr = np.ascontiguousarray(arr, dtype=np.float32)
-        self._pre_op("allreduce_q8", dtype="float32", size=int(arr.size),
+        self._pre_op(op, dtype="float32", size=int(arr.size),
                      extra=f"block={block}")
         nbytes = self._wire.quant_ring_allreduce_wire_bytes(
-            arr.size, self.world, block) // max(self.world, 1)
-        with self.stats.timed("allreduce_q8", nbytes):
-            rc = self._lib.dpx_allreduce_q8(
+            arr.size, self.world, block, bits) // max(self.world, 1)
+        with self.stats.timed(op, nbytes, hidden=hidden):
+            rc = self._lib.dpx_allreduce_qn(
                 self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                arr.size, block, chunk_blocks)
-        self._check(rc, "allreduce_q8")
+                arr.size, block, chunk_blocks, bits)
+        self._check(rc, op)
         return arr
 
-    def reduce_scatter_q8(self, arr: np.ndarray, block: int = None,
-                          chunk_blocks: int = None) -> np.ndarray:
+    def allreduce_q8(self, arr: np.ndarray, block: int = None,
+                     chunk_blocks: int = None,
+                     hidden: bool = False) -> np.ndarray:
+        """:meth:`allreduce_quant` at the historical 8-bit width."""
+        return self.allreduce_quant(arr, 8, block, chunk_blocks,
+                                    hidden=hidden)
+
+    def allreduce_q4(self, arr: np.ndarray, block: int = None,
+                     chunk_blocks: int = None,
+                     hidden: bool = False) -> np.ndarray:
+        """:meth:`allreduce_quant` at the 4-bit (nibble-packed) width —
+        a named method so the static schedule extractor sees the q4 op
+        at its call sites (analysis/schedule.py NATIVE_OPS)."""
+        return self.allreduce_quant(arr, 4, block, chunk_blocks,
+                                    hidden=hidden)
+
+    def reduce_scatter_quant(self, arr: np.ndarray, bits: int = 8,
+                             block: int = None, chunk_blocks: int = None,
+                             hidden: bool = False) -> np.ndarray:
         """In-place QUANTIZED ring reduce-scatter (sum) on a float32
-        array — the first leg of :meth:`allreduce_q8` alone.
+        array — the first leg of :meth:`allreduce_quant` alone.
 
         On return, this rank's :func:`~..comm.wire.ring_owned_span`
         holds the reduced sum; every other span holds a partial
         accumulation (undefined). Half the allreduce's wire bytes. The
         weight-update half of the ZeRO-1 recipe runs between this and
-        :meth:`allgather_q8` (optim/sharded/)."""
+        :meth:`allgather_quant` (optim/sharded/)."""
         block = block or self._wire.QUANT_BLOCK
         chunk_blocks = chunk_blocks or self._wire.QUANT_CHUNK_BLOCKS
+        self._wire.quant_levels(bits)
         arr = np.ascontiguousarray(arr, dtype=np.float32)
         self._pre_op("reduce_scatter", dtype="float32",
-                     size=int(arr.size), extra=f"q8,block={block}")
+                     size=int(arr.size), extra=f"q{bits},block={block}")
         nbytes = self._wire.quant_leg_wire_bytes(
-            arr.size, self.world, block) // max(self.world, 1)
-        with self.stats.timed("reduce_scatter", nbytes):
-            rc = self._lib.dpx_reduce_scatter_q8(
+            arr.size, self.world, block, bits) // max(self.world, 1)
+        with self.stats.timed("reduce_scatter", nbytes, hidden=hidden):
+            rc = self._lib.dpx_reduce_scatter_qn(
                 self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                arr.size, block, chunk_blocks)
+                arr.size, block, chunk_blocks, bits)
         self._check(rc, "reduce_scatter")
         return arr
 
-    def allgather_q8(self, arr: np.ndarray, block: int = None,
-                     chunk_blocks: int = None) -> np.ndarray:
+    def reduce_scatter_q8(self, arr: np.ndarray, block: int = None,
+                          chunk_blocks: int = None) -> np.ndarray:
+        """:meth:`reduce_scatter_quant` at the historical 8-bit width."""
+        return self.reduce_scatter_quant(arr, 8, block, chunk_blocks)
+
+    def allgather_quant(self, arr: np.ndarray, bits: int = 8,
+                        block: int = None, chunk_blocks: int = None,
+                        hidden: bool = False) -> np.ndarray:
         """In-place QUANTIZED ring all-gather on a float32 array — the
-        byte-forwarding second leg of :meth:`allreduce_q8` alone.
+        byte-forwarding second leg of :meth:`allreduce_quant` alone.
 
         This rank contributes its :func:`~..comm.wire.ring_owned_span`;
         afterwards the full buffer is BIT-IDENTICAL on every rank (each
         span decodes its owner's forwarded bytes, owner included)."""
         block = block or self._wire.QUANT_BLOCK
         chunk_blocks = chunk_blocks or self._wire.QUANT_CHUNK_BLOCKS
+        self._wire.quant_levels(bits)
         arr = np.ascontiguousarray(arr, dtype=np.float32)
         self._pre_op("allgather", dtype="float32", size=int(arr.size),
-                     extra=f"q8,block={block}")
+                     extra=f"q{bits},block={block}")
         nbytes = self._wire.quant_leg_wire_bytes(
-            arr.size, self.world, block) // max(self.world, 1)
-        with self.stats.timed("allgather", nbytes):
-            rc = self._lib.dpx_allgather_q8(
+            arr.size, self.world, block, bits) // max(self.world, 1)
+        with self.stats.timed("allgather", nbytes, hidden=hidden):
+            rc = self._lib.dpx_allgather_qn(
                 self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                arr.size, block, chunk_blocks)
+                arr.size, block, chunk_blocks, bits)
         self._check(rc, "allgather")
         return arr
+
+    def allgather_q8(self, arr: np.ndarray, block: int = None,
+                     chunk_blocks: int = None) -> np.ndarray:
+        """:meth:`allgather_quant` at the historical 8-bit width."""
+        return self.allgather_quant(arr, 8, block, chunk_blocks)
 
     def owned_span(self, n: int, block: int = None):
         """(offset, count) of the flat span this rank owns after

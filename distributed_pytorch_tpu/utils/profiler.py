@@ -73,6 +73,15 @@ class CommStats:
     ``benchmarks/step_breakdown.py``'s comm arms). Bytes are the WIRE
     payload this rank sends (e.g. the int8+scales framing for the
     quantized ring), not the logical tensor size.
+
+    **Overlap accounting**: each op's wall seconds are additionally
+    split into ``overlapped_s`` (the call was issued with ``hidden=True``
+    — the overlapping train step had later gradient buckets' backward
+    still outstanding on the device, so this comm hid behind compute)
+    and ``exposed_s`` (comm the step actually blocked on: the final
+    bucket, and everything in non-overlapped mode). The hidden fraction
+    of comm is thereby a MEASURED number, not a claim — the dp8 bench
+    arm reports ``exposed_ms`` with and without overlap.
     """
 
     def __init__(self):
@@ -81,31 +90,40 @@ class CommStats:
     def reset(self) -> None:
         self.per_op: Dict[str, Dict[str, float]] = {}
 
-    def record(self, op: str, nbytes: int, seconds: float) -> None:
+    def record(self, op: str, nbytes: int, seconds: float,
+               hidden: bool = False) -> None:
         d = self.per_op.setdefault(
-            op, {"calls": 0, "seconds": 0.0, "bytes": 0})
+            op, {"calls": 0, "seconds": 0.0, "bytes": 0,
+                 "overlapped_s": 0.0, "exposed_s": 0.0})
         d["calls"] += 1
         d["seconds"] += seconds
         d["bytes"] += int(nbytes)
+        d["overlapped_s" if hidden else "exposed_s"] += seconds
 
     @contextlib.contextmanager
-    def timed(self, op: str, nbytes: int):
+    def timed(self, op: str, nbytes: int, hidden: bool = False):
         """Time a collective and record its wire bytes; also emits a
-        trace annotation so the op shows on XProf timelines."""
+        trace annotation so the op shows on XProf timelines. ``hidden``
+        routes the wall time into the overlapped (vs exposed) bucket."""
         t0 = time.perf_counter()
         try:
             with annotate(f"comm:{op}"):
                 yield
         finally:
-            self.record(op, nbytes, time.perf_counter() - t0)
+            self.record(op, nbytes, time.perf_counter() - t0,
+                        hidden=hidden)
 
     def snapshot(self) -> Dict[str, float]:
-        """Totals so far: {calls, seconds, bytes} summed over ops."""
-        out = {"calls": 0, "seconds": 0.0, "bytes": 0}
+        """Totals so far: {calls, seconds, bytes, overlapped_s,
+        exposed_s} summed over ops."""
+        out = {"calls": 0, "seconds": 0.0, "bytes": 0,
+               "overlapped_s": 0.0, "exposed_s": 0.0}
         for d in self.per_op.values():
             out["calls"] += d["calls"]
             out["seconds"] += d["seconds"]
             out["bytes"] += d["bytes"]
+            out["overlapped_s"] += d.get("overlapped_s", 0.0)
+            out["exposed_s"] += d.get("exposed_s", 0.0)
         return out
 
     def summary(self) -> Dict[str, Dict[str, float]]:

@@ -246,46 +246,64 @@ int64_t op_deadline(const Comm* c) {
   return c->op_timeout_ms > 0 ? now_ms() + c->op_timeout_ms : -1;
 }
 
-// Full-duplex bounded exchange: send `sn` bytes while receiving `rn` bytes,
-// interleaved via poll, so simultaneous ring sends can never deadlock on
-// full kernel buffers. Observes `deadline`; on failure returns the error
-// code and sets *blame to the offending ring direction (+1 = the send
-// peer, -1 = the recv peer).
-int send_recv(int send_fd, const char* sbuf, size_t sn, int recv_fd,
-              char* rbuf, size_t rn, int64_t deadline, int* blame) {
+// In-flight full-duplex exchange state: send `sn` bytes while receiving
+// `rn` bytes. Progress is driven by xfer_progress so a caller can
+// START a transfer (non-blocking pass that fills the kernel socket
+// buffer), do CPU work — quantize the NEXT chunk — while the bytes are
+// in flight, and only then block for completion: the compute-comm
+// overlap of the double-buffered chunk pipeline.
+struct Xfer {
+  const char* sbuf = nullptr;
+  size_t sn = 0, so = 0;
+  char* rbuf = nullptr;
+  size_t rn = 0, ro = 0;
+};
+
+constexpr int kInProgress = 1;  // xfer_progress: not done, no error
+
+// One progress pass over an Xfer. `blocking` false: poll with a zero
+// timeout and move whatever the sockets will take/give RIGHT NOW, then
+// return kInProgress (or kOk if that finished it) — never waits.
+// `blocking` true: poll-wait under `deadline` until complete. On
+// failure returns the error code and sets *blame to the offending ring
+// direction (+1 = the send peer, -1 = the recv peer).
+int xfer_progress(int send_fd, int recv_fd, Xfer* x, bool blocking,
+                  int64_t deadline, int* blame) {
   *blame = -1;
-  if (send_fd < 0 || recv_fd < 0) return kErr;
-  size_t so = 0, ro = 0;
-  while (so < sn || ro < rn) {
+  if ((x->sn && send_fd < 0) || (x->rn && recv_fd < 0)) return kErr;
+  while (x->so < x->sn || x->ro < x->rn) {
     // absolute expiry: trickling progress must not extend the deadline
     if (deadline >= 0 && now_ms() > deadline) {
-      *blame = (ro < rn) ? -1 : +1;
+      *blame = (x->ro < x->rn) ? -1 : +1;
       return kErrTimeout;
     }
     pollfd fds[2];
     int nf = 0;
     int si = -1, ri = -1;
-    if (so < sn) {
+    if (x->so < x->sn) {
       fds[nf] = {send_fd, POLLOUT, 0};
       si = nf++;
     }
-    if (ro < rn) {
+    if (x->ro < x->rn) {
       fds[nf] = {recv_fd, POLLIN, 0};
       ri = nf++;
     }
-    int pr = ::poll(fds, static_cast<nfds_t>(nf), poll_budget(deadline));
+    int pr = ::poll(fds, static_cast<nfds_t>(nf),
+                    blocking ? poll_budget(deadline) : 0);
     if (pr < 0) {
       if (errno == EINTR) continue;
       return kErr;
     }
     if (pr == 0) {
+      if (!blocking) return kInProgress;  // nothing ready right now
       // deadline: blame whichever direction is still incomplete (the
       // recv side when both are — the peer we are waiting ON)
-      *blame = (ro < rn) ? -1 : +1;
+      *blame = (x->ro < x->rn) ? -1 : +1;
       return kErrTimeout;
     }
+    bool moved = false;
     if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
-      ssize_t w = ::send(send_fd, sbuf + so, sn - so,
+      ssize_t w = ::send(send_fd, x->sbuf + x->so, x->sn - x->so,
                          MSG_DONTWAIT | MSG_NOSIGNAL);
       if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK
           && errno != EINTR) {
@@ -293,18 +311,39 @@ int send_recv(int send_fd, const char* sbuf, size_t sn, int recv_fd,
         return (errno == EPIPE || errno == ECONNRESET) ? kErrPeerClosed
                                                        : kErr;
       }
-      if (w > 0) so += static_cast<size_t>(w);
+      if (w > 0) {
+        x->so += static_cast<size_t>(w);
+        moved = true;
+      }
     }
     if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
-      ssize_t r = ::recv(recv_fd, rbuf + ro, rn - ro, MSG_DONTWAIT);
+      ssize_t r = ::recv(recv_fd, x->rbuf + x->ro, x->rn - x->ro,
+                         MSG_DONTWAIT);
       if (r == 0) return kErrPeerClosed;
       if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK
           && errno != EINTR)
         return (errno == ECONNRESET) ? kErrPeerClosed : kErr;
-      if (r > 0) ro += static_cast<size_t>(r);
+      if (r > 0) {
+        x->ro += static_cast<size_t>(r);
+        moved = true;
+      }
     }
+    if (!blocking && !moved) return kInProgress;  // sockets saturated
   }
   return kOk;
+}
+
+// Full-duplex bounded exchange, run to completion (the pre-overlap
+// behavior — the full-width ring and hub paths use it unchanged).
+int send_recv(int send_fd, const char* sbuf, size_t sn, int recv_fd,
+              char* rbuf, size_t rn, int64_t deadline, int* blame) {
+  Xfer x;
+  x.sbuf = sbuf;
+  x.sn = sn;
+  x.rbuf = rbuf;
+  x.rn = rn;
+  return xfer_progress(send_fd, recv_fd, &x, /*blocking=*/true, deadline,
+                       blame);
 }
 
 // Ring wrapper: translates a send_recv failure into err_peer (the ring
@@ -672,18 +711,30 @@ int dpx_allreduce_f64_op(void* handle, double* data, int64_t n, int op) {
 
 namespace {
 
-// q[i] = clip(rint(src[i] * inv), -127, 127) — the codec's quant rule
-// (comm/wire.py multiplies by the same f32 inverse; lrintf/cvtps2dq and
-// np.rint all round half-to-even, and the integer-domain clamp equals
-// the float-domain clip bit for bit). Precondition: |src*inv| well
-// inside int32 range — guaranteed by inv <= 127/amax.
-void quant_row(const float* src, int64_t len, float inv, int8_t* dst) {
+// levels per wire width: 127 for the 8-bit wire, 7 for the 4-bit wire.
+inline int quant_levels(int bits) { return bits == 4 ? 7 : 127; }
+
+// payload bytes of `elems` quantized values: one byte each at q8, two
+// packed nibbles per byte at q4 (odd tails pad a zero nibble). Mirrors
+// comm/wire.py:payload_bytes.
+inline int64_t payload_bytes(int64_t elems, int bits) {
+  return bits == 4 ? (elems + 1) / 2 : elems;
+}
+
+// q[i] = clip(rint(src[i] * inv), -levels, levels) — the codec's quant
+// rule (comm/wire.py multiplies by the same f32 inverse; lrintf/
+// cvtps2dq and np.rint all round half-to-even, and the integer-domain
+// clamp equals the float-domain clip bit for bit). Precondition:
+// |src*inv| well inside int32 range — guaranteed by inv <= levels/amax.
+void quant_row(const float* src, int64_t len, float inv, int levels,
+               int8_t* dst) {
 #if defined(__SSE2__)
   // hand-vectorized: the scalar loop is the quantized ring's hot spot
   // (gcc won't pick cvtps2dq for lrintf on baseline x86-64), and this
   // path is bit-identical to the scalar tail below
   const __m128 vinv = _mm_set1_ps(inv);
-  const __m128i hi = _mm_set1_epi16(127), lo = _mm_set1_epi16(-127);
+  const __m128i hi = _mm_set1_epi16(static_cast<short>(levels));
+  const __m128i lo = _mm_set1_epi16(static_cast<short>(-levels));
   int64_t i = 0;
   for (; i + 16 <= len; i += 16) {
     __m128i a = _mm_cvtps_epi32(_mm_mul_ps(_mm_loadu_ps(src + i), vinv));
@@ -701,20 +752,36 @@ void quant_row(const float* src, int64_t len, float inv, int8_t* dst) {
 #endif
   for (; i < len; i++) {
     long t = lrintf(src[i] * inv);
-    if (t > 127) t = 127;
-    if (t < -127) t = -127;
+    if (t > levels) t = levels;
+    if (t < -levels) t = -levels;
     dst[i] = static_cast<int8_t>(t);
   }
 }
 
+// Two two's-complement nibbles per byte, low nibble first; odd tails
+// leave the final high nibble zero (comm/wire.py:pack_nibbles).
+void pack_nibbles(const int8_t* q, int64_t n, uint8_t* out) {
+  int64_t i = 0, o = 0;
+  for (; i + 1 < n; i += 2)
+    out[o++] = static_cast<uint8_t>((q[i] & 0xF)
+                                    | ((q[i + 1] & 0xF) << 4));
+  if (i < n) out[o] = static_cast<uint8_t>(q[i] & 0xF);
+}
+
 // Quantize `n` f32 values into the framed wire form: scales[] gets one
-// f32 per block, q[] one int8 per element. Block rule mirrors
-// comm/wire.py exactly (same IEEE ops): scale 1 for all-zero blocks and
-// for integer blocks with amax <= 127 (exact transfer), else amax/127,
-// quantizing by the f32 INVERSE 127/amax (multiply, not divide — and the
-// numpy side does the same, so grids agree bit for bit).
-void quantize_span(const float* v, int64_t n, int block, float* scales,
-                   int8_t* q) {
+// f32 per block, payload[] gets payload_bytes(n, bits) wire bytes (one
+// int8 per element at q8; packed nibbles at q4, via `scratch` of >= n
+// int8). Block rule mirrors comm/wire.py exactly (same IEEE ops):
+// scale 1 for all-zero blocks and for integer blocks with amax <=
+// levels (exact transfer), else amax/levels, quantizing by the f32
+// INVERSE levels/amax (multiply, not divide — and the numpy side does
+// the same, so grids agree bit for bit).
+void quantize_span(const float* v, int64_t n, int block, int bits,
+                   float* scales, char* payload, int8_t* scratch) {
+  int levels = quant_levels(bits);
+  float flevels = static_cast<float>(levels);
+  int8_t* q = (bits == 4) ? scratch
+                          : reinterpret_cast<int8_t*>(payload);
   for (int64_t b = 0, lo = 0; lo < n; b++, lo += block) {
     int64_t len = (lo + block > n) ? n - lo : block;
     const float* src = v + lo;
@@ -725,10 +792,10 @@ void quantize_span(const float* v, int64_t n, int block, float* scales,
     }
     // integer-exact snap: only worth scanning when amax admits it, and
     // the scan exits at the first fractional value (one compare for
-    // typical float gradients). |v| <= 127 here, so lrintf cannot
+    // typical float gradients). |v| <= levels here, so lrintf cannot
     // overflow.
     bool allint = false;
-    if (amax != 0.0f && amax <= 127.0f) {
+    if (amax != 0.0f && amax <= flevels) {
       allint = true;
       for (int64_t i = 0; i < len; i++) {
         if (static_cast<float>(lrintf(src[i])) != src[i]) {
@@ -738,25 +805,62 @@ void quantize_span(const float* v, int64_t n, int block, float* scales,
       }
     }
     bool unit = (amax == 0.0f || allint);
-    scales[b] = unit ? 1.0f : amax / 127.0f;
-    quant_row(src, len, unit ? 1.0f : 127.0f / amax, q + lo);
+    scales[b] = unit ? 1.0f : amax / flevels;
+    quant_row(src, len, unit ? 1.0f : flevels / amax, levels, q + lo);
   }
+  if (bits == 4)
+    pack_nibbles(q, n, reinterpret_cast<uint8_t*>(payload));
 }
 
 // acc[i] (+)= q[i] * scale — `assign` overwrites (all-gather leg),
 // otherwise accumulates (reduce-scatter leg). Same op order as
-// comm/wire.py:dequantize_blocks.
-void dequant_span(const float* scales, const int8_t* q, int64_t n, int block,
-                  float* acc, bool assign) {
+// comm/wire.py:dequantize_blocks; the q4 payload is unpacked inline
+// (sign extension via (nib ^ 8) - 8, matching wire.py:unpack_nibbles).
+inline float nib_lo(uint8_t byte, float scale) {
+  return static_cast<float>(
+             static_cast<int8_t>(((byte & 0xF) ^ 8) - 8)) * scale;
+}
+inline float nib_hi(uint8_t byte, float scale) {
+  return static_cast<float>(
+             static_cast<int8_t>(((byte >> 4) ^ 8) - 8)) * scale;
+}
+
+void dequant_span(const float* scales, const char* payload, int64_t n,
+                  int block, int bits, float* acc, bool assign) {
+  const int8_t* q8 = reinterpret_cast<const int8_t*>(payload);
+  const uint8_t* q4 = reinterpret_cast<const uint8_t*>(payload);
   for (int64_t b = 0, lo = 0; lo < n; b++, lo += block) {
     int64_t len = (lo + block > n) ? n - lo : block;
     float scale = scales[b];
-    const int8_t* src = q + lo;
     float* dst = acc + lo;
-    if (assign) {
+    if (bits == 4) {
+      // block widths are even and blocks start the span byte-aligned,
+      // so each block's payload begins on a whole byte; decode two
+      // elements per byte with the assign/accumulate branch hoisted —
+      // this runs once per received element on every ring hop
+      const uint8_t* src = q4 + (lo >> 1);
+      int64_t pairs = len >> 1;
+      if (assign) {
+        for (int64_t i = 0; i < pairs; i++) {
+          uint8_t byte = src[i];
+          dst[2 * i] = nib_lo(byte, scale);
+          dst[2 * i + 1] = nib_hi(byte, scale);
+        }
+        if (len & 1) dst[len - 1] = nib_lo(src[pairs], scale);
+      } else {
+        for (int64_t i = 0; i < pairs; i++) {
+          uint8_t byte = src[i];
+          dst[2 * i] += nib_lo(byte, scale);
+          dst[2 * i + 1] += nib_hi(byte, scale);
+        }
+        if (len & 1) dst[len - 1] += nib_lo(src[pairs], scale);
+      }
+    } else if (assign) {
+      const int8_t* src = q8 + lo;
       for (int64_t i = 0; i < len; i++)
         dst[i] = static_cast<float>(src[i]) * scale;
     } else {
+      const int8_t* src = q8 + lo;
       for (int64_t i = 0; i < len; i++)
         dst[i] += static_cast<float>(src[i]) * scale;
     }
@@ -765,15 +869,18 @@ void dequant_span(const float* scales, const int8_t* q, int64_t n, int block,
 
 // Block-aligned segment grid (comm/wire.py:segment_blocks): world
 // segments of whole blocks, first `rem` segments one block larger.
+// `bits` folds the wire width into the byte math; block widths are
+// even, so chunk payload offsets always fall on whole packed bytes.
 struct QGrid {
   int64_t n;
   int block;
   int64_t nblocks;
   int world;
+  int bits;
 
-  QGrid(int64_t n_, int block_, int world_)
+  QGrid(int64_t n_, int block_, int world_, int bits_ = 8)
       : n(n_), block(block_),
-        nblocks((n_ + block_ - 1) / block_), world(world_) {}
+        nblocks((n_ + block_ - 1) / block_), world(world_), bits(bits_) {}
 
   int64_t seg_start_block(int seg) const {
     int64_t base = nblocks / world, rem = nblocks % world;
@@ -790,8 +897,11 @@ struct QGrid {
     if (hi > n) hi = n;
     return (hi > lo) ? hi - lo : 0;
   }
+  int64_t span_payload(int64_t b0, int64_t nb) const {
+    return payload_bytes(span_elems(b0, nb), bits);
+  }
   int64_t wire_bytes(int64_t b0, int64_t nb) const {
-    return 4 * nb + span_elems(b0, nb);
+    return 4 * nb + span_payload(b0, nb);
   }
 };
 
@@ -801,12 +911,23 @@ struct QGrid {
 // Receiving side CRC-verifies then dequantizes into data (accumulate or
 // assign); when `keep` != null the raw received bytes (frame + CRC) are
 // also stored for forwarding next hop (all-gather leg). Every chunk
-// frame is [scales][int8 payload][CRC32 of the preceding bytes]; the
+// frame is [scales][payload][CRC32 of the preceding bytes]; the
 // all-gather leg forwards frames byte-for-byte, so the owner's CRC
 // travels the whole ring and every hop re-verifies end to end.
-int q8_hop(Comm* c, const QGrid& g, float* data, int chunk_blocks,
+//
+// DOUBLE-BUFFERED compute-comm overlap: chunk k's transfer is STARTED
+// with a non-blocking pass (filling the kernel socket buffer, so the
+// peer's bytes are already in flight), then chunk k+1 is quantized into
+// the alternate send buffer while the wire drains, and only then does
+// the hop block for chunk k's completion. With the old
+// quantize-then-block schedule the codec and the wire strictly
+// serialized; now the codec cost of every chunk but the first hides
+// behind its predecessor's transfer. Results are bit-identical — only
+// the schedule changed.
+int qn_hop(Comm* c, const QGrid& g, float* data, int chunk_blocks,
            int send_seg, const char* fwd, int recv_seg, bool assign,
-           char* sbuf, char* rbuf, char* keep, int64_t deadline) {
+           char* sbufs[2], char* rbuf, int8_t* scratch, char* keep,
+           int64_t deadline) {
   int64_t snb_total = g.seg_nblocks(send_seg);
   int64_t rnb_total = g.seg_nblocks(recv_seg);
   int64_t sb0 = g.seg_start_block(send_seg);
@@ -815,27 +936,38 @@ int q8_hop(Comm* c, const QGrid& g, float* data, int chunk_blocks,
   int64_t nchunks_r = (rnb_total + chunk_blocks - 1) / chunk_blocks;
   int64_t nchunks = (nchunks_s > nchunks_r) ? nchunks_s : nchunks_r;
   int64_t fwd_off = 0, keep_off = 0;
-  for (int64_t k = 0; k < nchunks; k++) {
-    // sender side: frame chunk k of send_seg
-    int64_t sn = 0;
-    const char* sptr = nullptr;
-    if (k < nchunks_s) {
-      int64_t cb0 = sb0 + k * chunk_blocks;
-      int64_t cnb = (k == nchunks_s - 1) ? snb_total - k * chunk_blocks
-                                         : chunk_blocks;
-      int64_t payload = g.wire_bytes(cb0, cnb);
-      sn = payload + 4;  // + CRC32 trailer
-      if (fwd) {
-        sptr = fwd + fwd_off;  // forward pre-encoded bytes unchanged
-        fwd_off += sn;
-      } else {
-        quantize_span(data + cb0 * g.block, g.span_elems(cb0, cnb), g.block,
-                      reinterpret_cast<float*>(sbuf),
-                      reinterpret_cast<int8_t*>(sbuf + 4 * cnb));
-        crc32_append(sbuf, static_cast<size_t>(payload));
-        sptr = sbuf;
-      }
+
+  // frame send chunk k (quantize+CRC into `dst`, or point into `fwd`
+  // advancing fwd_off — called strictly in k order either way)
+  auto frame = [&](int64_t k, char* dst, const char** sptr) -> int64_t {
+    int64_t cb0 = sb0 + k * chunk_blocks;
+    int64_t cnb = (k == nchunks_s - 1) ? snb_total - k * chunk_blocks
+                                       : chunk_blocks;
+    int64_t payload = g.wire_bytes(cb0, cnb);
+    int64_t sn = payload + 4;  // + CRC32 trailer
+    if (fwd) {
+      *sptr = fwd + fwd_off;  // forward pre-encoded bytes unchanged
+      fwd_off += sn;
+    } else {
+      quantize_span(data + cb0 * g.block, g.span_elems(cb0, cnb),
+                    g.block, g.bits, reinterpret_cast<float*>(dst),
+                    dst + 4 * cnb, scratch);
+      crc32_append(dst, static_cast<size_t>(payload));
+      *sptr = dst;
     }
+    return sn;
+  };
+
+  auto fail = [&](int rc, int blame) {
+    int peer = (blame > 0) ? (c->rank + 1) % c->world
+                           : (c->rank - 1 + c->world) % c->world;
+    return comm_fail(c, rc, peer);
+  };
+
+  const char* sptr = nullptr;
+  int64_t sn = 0;
+  if (nchunks_s > 0) sn = frame(0, sbufs[0], &sptr);
+  for (int64_t k = 0; k < nchunks; k++) {
     // receiver side: chunk k of recv_seg
     int64_t rn = 0;
     int64_t cb0r = rb0 + k * chunk_blocks;
@@ -845,17 +977,36 @@ int q8_hop(Comm* c, const QGrid& g, float* data, int chunk_blocks,
                                   : chunk_blocks;
       rn = g.wire_bytes(cb0r, cnbr) + 4;
     }
-    int rc = ring_xfer(c, sptr, static_cast<size_t>(sn), rbuf,
-                       static_cast<size_t>(rn), deadline);
-    if (rc != kOk) return rc;
+    Xfer x;
+    x.sbuf = (k < nchunks_s) ? sptr : nullptr;
+    x.sn = (k < nchunks_s) ? static_cast<size_t>(sn) : 0;
+    x.rbuf = rbuf;
+    x.rn = static_cast<size_t>(rn);
+    int blame = -1;
+    // kick the transfer off without blocking...
+    int rc = xfer_progress(c->ring_send_fd, c->ring_recv_fd, &x,
+                           /*blocking=*/false, deadline, &blame);
+    if (rc != kOk && rc != kInProgress) return fail(rc, blame);
+    // ...quantize the NEXT chunk while chunk k is on the wire...
+    const char* next_sptr = nullptr;
+    int64_t next_sn = 0;
+    if (k + 1 < nchunks_s)
+      next_sn = frame(k + 1, sbufs[(k + 1) & 1], &next_sptr);
+    // ...then block for chunk k's completion.
+    if (rc == kInProgress) {
+      rc = xfer_progress(c->ring_send_fd, c->ring_recv_fd, &x,
+                         /*blocking=*/true, deadline, &blame);
+      if (rc != kOk) return fail(rc, blame);
+    }
+    sptr = next_sptr;
+    sn = next_sn;
     if (rn > 0) {
       if (!crc32_check(rbuf, static_cast<size_t>(rn - 4)))
         return comm_fail(c, kErrCorrupt,
                          (c->rank - 1 + c->world) % c->world);
       dequant_span(reinterpret_cast<const float*>(rbuf),
-                   reinterpret_cast<const int8_t*>(rbuf + 4 * cnbr),
-                   g.span_elems(cb0r, cnbr), g.block,
-                   data + cb0r * g.block, assign);
+                   rbuf + 4 * cnbr, g.span_elems(cb0r, cnbr), g.block,
+                   g.bits, data + cb0r * g.block, assign);
       if (keep) {
         memcpy(keep + keep_off, rbuf, static_cast<size_t>(rn));
         keep_off += rn;
@@ -877,18 +1028,22 @@ int q8_hop(Comm* c, const QGrid& g, float* data, int chunk_blocks,
 // deadline is exactly dpx_allreduce_q8, bit for bit — the standalone
 // legs exist so a sharded optimizer can run its local update between
 // them (optim/sharded/).
-static int q8_collective(Comm* c, float* data, int64_t n, int block,
-                         int chunk_blocks, bool do_rs, bool do_ag) {
+static int qn_collective(Comm* c, float* data, int64_t n, int block,
+                         int chunk_blocks, int bits, bool do_rs,
+                         bool do_ag) {
   if (c->aborted) return kErr;  // contract: aborted beats the no-op path
   if (c->world == 1 || n == 0) return 0;
   if (block <= 0 || chunk_blocks <= 0) return kErr;
+  if (bits != 8 && bits != 4) return kErr;
+  if (bits == 4 && (block & 1)) return kErr;  // packed pairs per block
   const int w = c->world;
   const int64_t deadline = op_deadline(c);
-  QGrid g(n, block, w);
+  QGrid g(n, block, w, bits);
 
-  // scratch: one chunk each way + two full-segment wire buffers for the
-  // byte-forwarding all-gather leg (each chunk frame carries a 4-byte
-  // CRC32 trailer on the wire)
+  // scratch: two alternating send chunks (double buffering), one recv
+  // chunk, a q4 packing scratch, and two full-segment wire buffers for
+  // the byte-forwarding all-gather leg (each chunk frame carries a
+  // 4-byte CRC32 trailer on the wire)
   int64_t max_seg_wire = 0, max_seg_nb = 0;
   for (int s = 0; s < w; s++) {
     int64_t wb = g.wire_bytes(g.seg_start_block(s), g.seg_nblocks(s));
@@ -898,9 +1053,15 @@ static int q8_collective(Comm* c, float* data, int64_t n, int block,
   int64_t cb = (chunk_blocks < max_seg_nb) ? chunk_blocks : max_seg_nb;
   if (cb < 1) cb = 1;
   int64_t max_frames = (max_seg_nb + cb - 1) / cb;
-  int64_t max_chunk_wire = 4 * cb + cb * static_cast<int64_t>(block) + 4;
-  std::vector<char> sbuf(static_cast<size_t>(max_chunk_wire));
+  int64_t max_chunk_elems = cb * static_cast<int64_t>(block);
+  int64_t max_chunk_wire = 4 * cb + payload_bytes(max_chunk_elems, bits)
+                           + 4;
+  std::vector<char> sbuf_a(static_cast<size_t>(max_chunk_wire));
+  std::vector<char> sbuf_b(static_cast<size_t>(max_chunk_wire));
+  char* sbufs[2] = {sbuf_a.data(), sbuf_b.data()};
   std::vector<char> rbuf(static_cast<size_t>(max_chunk_wire));
+  std::vector<int8_t> scratch(
+      static_cast<size_t>(bits == 4 ? max_chunk_elems : 0));
 
   // reduce-scatter: quantize the f32 partial of the outgoing segment
   // each hop; receiver dequantize-accumulates. After w-1 steps rank r
@@ -909,9 +1070,9 @@ static int q8_collective(Comm* c, float* data, int64_t n, int block,
     for (int step = 0; step < w - 1; step++) {
       int send_seg = (c->rank - step + w) % w;
       int recv_seg = (c->rank - step - 1 + w) % w;
-      int rc = q8_hop(c, g, data, static_cast<int>(cb), send_seg, nullptr,
-                      recv_seg, /*assign=*/false, sbuf.data(), rbuf.data(),
-                      nullptr, deadline);
+      int rc = qn_hop(c, g, data, static_cast<int>(cb), send_seg, nullptr,
+                      recv_seg, /*assign=*/false, sbufs, rbuf.data(),
+                      scratch.data(), nullptr, deadline);
       if (rc != kOk) return rc;
     }
   }
@@ -927,15 +1088,19 @@ static int q8_collective(Comm* c, float* data, int64_t n, int block,
     int own = (c->rank + 1) % w;
     int64_t b0 = g.seg_start_block(own), nb = g.seg_nblocks(own);
     int64_t elems = g.span_elems(b0, nb);
-    quantize_span(data + b0 * g.block, elems, g.block,
+    std::vector<int8_t> seg_scratch(
+        static_cast<size_t>(bits == 4 ? elems : 0));
+    quantize_span(data + b0 * g.block, elems, g.block, bits,
                   reinterpret_cast<float*>(fwd.data()),
-                  reinterpret_cast<int8_t*>(fwd.data() + 4 * nb));
+                  fwd.data() + 4 * nb, seg_scratch.data());
     dequant_span(reinterpret_cast<const float*>(fwd.data()),
-                 reinterpret_cast<const int8_t*>(fwd.data() + 4 * nb),
-                 elems, g.block, data + b0 * g.block, /*assign=*/true);
-    // repack to chunk framing: fwd currently holds [all scales][all q];
-    // hops send per-chunk [scales][q][CRC32] frames, so re-encode into
-    // chunk order and stamp each frame's CRC
+                 fwd.data() + 4 * nb, elems, g.block, bits,
+                 data + b0 * g.block, /*assign=*/true);
+    // repack to chunk framing: fwd currently holds [all scales][all
+    // payload]; hops send per-chunk [scales][payload][CRC32] frames, so
+    // re-encode into chunk order and stamp each frame's CRC. Chunk
+    // boundaries fall on whole blocks (even element counts), so q4
+    // payload offsets are always whole packed bytes.
     std::vector<char> frames(fwd_cap);
     int64_t off = 0;
     for (int64_t k = 0; k * cb < nb; k++) {
@@ -945,10 +1110,11 @@ static int q8_collective(Comm* c, float* data, int64_t n, int block,
       memcpy(frames.data() + off, fwd.data() + 4 * (k * cb),
              static_cast<size_t>(4 * cnb));
       off += 4 * cnb;
-      int64_t qoff = g.span_elems(b0, k * cb);
+      int64_t qoff = g.span_payload(b0, k * cb);
+      int64_t qlen = g.span_payload(cb0, cnb);
       memcpy(frames.data() + off, fwd.data() + 4 * nb + qoff,
-             static_cast<size_t>(g.span_elems(cb0, cnb)));
-      off += g.span_elems(cb0, cnb);
+             static_cast<size_t>(qlen));
+      off += qlen;
       crc32_append(frames.data() + frame0,
                    static_cast<size_t>(off - frame0));
       off += 4;
@@ -959,49 +1125,71 @@ static int q8_collective(Comm* c, float* data, int64_t n, int block,
     int send_seg = (c->rank + 1 - step + w) % w;
     int recv_seg = (c->rank - step + w) % w;
     bool last = (step == w - 2);
-    int rc = q8_hop(c, g, data, static_cast<int>(cb), send_seg, fwd.data(),
-                    recv_seg, /*assign=*/true, sbuf.data(), rbuf.data(),
-                    last ? nullptr : keep.data(), deadline);
+    int rc = qn_hop(c, g, data, static_cast<int>(cb), send_seg, fwd.data(),
+                    recv_seg, /*assign=*/true, sbufs, rbuf.data(),
+                    scratch.data(), last ? nullptr : keep.data(),
+                    deadline);
     if (rc != kOk) return rc;
     fwd.swap(keep);
   }
   return kOk;
 }
 
-// Quantized ring allreduce (sum) on f32 data, in place. `block` elements
-// share one f32 scale; `chunk_blocks` blocks form one pipelined wire
-// chunk. Result is bit-identical on every rank (all-gather leg decodes
+// Quantized ring allreduce (sum) on f32 data, in place, at a selectable
+// wire width (`bits` = 8 or 4; q4 packs two sign-extended nibbles per
+// payload byte — comm/wire.py:pack_nibbles). `block` elements share one
+// f32 scale; `chunk_blocks` blocks form one pipelined wire chunk.
+// Result is bit-identical on every rank (all-gather leg decodes
 // identical forwarded bytes) and bit-identical to
-// comm/wire.py:simulate_quant_ring.
+// comm/wire.py:simulate_quant_ring at the same width.
+int dpx_allreduce_qn(void* handle, float* data, int64_t n, int block,
+                     int chunk_blocks, int bits) {
+  return qn_collective(static_cast<Comm*>(handle), data, n, block,
+                       chunk_blocks, bits, /*do_rs=*/true, /*do_ag=*/true);
+}
+
+// The historical 8-bit entry point — dpx_allreduce_qn at bits=8, bit
+// for bit (same code path).
 int dpx_allreduce_q8(void* handle, float* data, int64_t n, int block,
                      int chunk_blocks) {
-  return q8_collective(static_cast<Comm*>(handle), data, n, block,
-                       chunk_blocks, /*do_rs=*/true, /*do_ag=*/true);
+  return dpx_allreduce_qn(handle, data, n, block, chunk_blocks, 8);
 }
 
 // Quantized ring reduce-scatter (sum) on f32 data, in place: the first
-// leg of dpx_allreduce_q8 alone. On return, rank r's span of segment
+// leg of dpx_allreduce_qn alone. On return, rank r's span of segment
 // (r+1)%w (comm/wire.py:segment_blocks grid) holds the reduced sum;
 // every other span holds a partial accumulation and must be treated as
 // undefined. Half the wire bytes of the full allreduce.
+int dpx_reduce_scatter_qn(void* handle, float* data, int64_t n, int block,
+                          int chunk_blocks, int bits) {
+  return qn_collective(static_cast<Comm*>(handle), data, n, block,
+                       chunk_blocks, bits, /*do_rs=*/true,
+                       /*do_ag=*/false);
+}
+
 int dpx_reduce_scatter_q8(void* handle, float* data, int64_t n, int block,
                           int chunk_blocks) {
-  return q8_collective(static_cast<Comm*>(handle), data, n, block,
-                       chunk_blocks, /*do_rs=*/true, /*do_ag=*/false);
+  return dpx_reduce_scatter_qn(handle, data, n, block, chunk_blocks, 8);
 }
 
 // Quantized ring all-gather on f32 data, in place: the second leg of
-// dpx_allreduce_q8 alone. Rank r contributes its span of segment
+// dpx_allreduce_qn alone. Rank r contributes its span of segment
 // (r+1)%w; after the w-1 forwarding hops every rank holds the identical
 // full buffer (each span is the dequantized grid of its owner's bytes —
 // the owner adopts the same grid value, so ranks are bit-identical by
 // construction). World==1 is a no-op (the exact local value beats a
 // gratuitous grid snap — callers that need grid parity quantize
 // explicitly).
+int dpx_allgather_qn(void* handle, float* data, int64_t n, int block,
+                     int chunk_blocks, int bits) {
+  return qn_collective(static_cast<Comm*>(handle), data, n, block,
+                       chunk_blocks, bits, /*do_rs=*/false,
+                       /*do_ag=*/true);
+}
+
 int dpx_allgather_q8(void* handle, float* data, int64_t n, int block,
                      int chunk_blocks) {
-  return q8_collective(static_cast<Comm*>(handle), data, n, block,
-                       chunk_blocks, /*do_rs=*/false, /*do_ag=*/true);
+  return dpx_allgather_qn(handle, data, n, block, chunk_blocks, 8);
 }
 
 // Rooted reduce (sum) to rank 0 via the hub. Non-root buffers unchanged

@@ -72,6 +72,12 @@ def load(lib_path: str):
     lib.dpx_allgather_q8.argtypes = [ctypes.c_void_p, f32p,
                                      ctypes.c_int64, ctypes.c_int,
                                      ctypes.c_int]
+    for name in ("dpx_allreduce_qn", "dpx_reduce_scatter_qn",
+                 "dpx_allgather_qn"):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_void_p, f32p, ctypes.c_int64,
+                       ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        fn.restype = ctypes.c_int
     lib.dpx_reduce_f32.argtypes = [ctypes.c_void_p, f32p, ctypes.c_int64]
     lib.dpx_gather.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                ctypes.c_int64, ctypes.c_char_p]
@@ -161,6 +167,38 @@ def worker(lib_path: str, base_port: int, rank: int, world: int,
             check(np.array_equal(s2, q),
                   f"rs+ag != allreduce_q8 at n={n}")
 
+            # the q8 wrapper must BE the qn family at bits=8
+            s8 = base[rank].copy()
+            check(lib.dpx_allreduce_qn(h, f32ptr(s8), n, 64, 4, 8) == 0,
+                  "allreduce_qn(8) rc")
+            check(np.array_equal(s8, q), f"qn(8) != q8 at n={n}")
+
+            # 4-bit wire: coarser grid (levels=7), same invariants —
+            # bounded error, cross-rank bit-identity, legs compose
+            q4 = base[rank].copy()
+            check(lib.dpx_allreduce_qn(h, f32ptr(q4), n, 64, 4, 4) == 0,
+                  "allreduce_qn(4) rc")
+            tol4 = 2.0 * world * world * (np.abs(base).max() / 7.0) + 1e-6
+            check(float(np.abs(q4 - want).max()) <= tol4,
+                  f"q4 error beyond bound at n={n}")
+            qc4 = np.uint32(lib.dpx_crc32c(
+                q4.ctypes.data_as(ctypes.c_void_p), q4.nbytes))
+            rbuf4 = (np.zeros(world, np.uint32) if rank == 0 else None)
+            check(lib.dpx_gather(
+                h, qc4.tobytes(), 4,
+                rbuf4.ctypes.data_as(ctypes.c_char_p)
+                if rank == 0 else None) == 0, "gather rc (q4)")
+            if rank == 0:
+                check(len(set(rbuf4.tolist())) == 1,
+                      f"q4 results not bit-identical: {rbuf4}")
+            s4 = base[rank].copy()
+            check(lib.dpx_reduce_scatter_qn(h, f32ptr(s4), n, 64, 4, 4)
+                  == 0, "reduce_scatter_qn(4) rc")
+            check(lib.dpx_allgather_qn(h, f32ptr(s4), n, 64, 4, 4) == 0,
+                  "allgather_qn(4) rc")
+            check(np.array_equal(s4, q4),
+                  f"rs+ag != allreduce_qn(4) at n={n}")
+
             # rooted reduce + broadcast round trip
             r = np.full(n, float(rank), np.float32)
             check(lib.dpx_reduce_f32(h, f32ptr(r), n) == 0, "reduce rc")
@@ -174,11 +212,65 @@ def worker(lib_path: str, base_port: int, rank: int, world: int,
                 "broadcast rc")
             check(float(b[-1]) == n - 1, "broadcast value")
         check(lib.dpx_barrier(h) == 0, "barrier rc")
+
+    # hierarchical two-level legs (comm/hier.py's native substrate):
+    # sub-groups of L=2 consecutive ranks rendezvous on offset ports,
+    # exact rooted reduce to each leader, q4 ring between leaders,
+    # exact broadcast back — exercising concurrent groups + the qn
+    # codec under the sanitizer. Mirrors HierRing's port scheme.
+    if world % 2 == 0 and world >= 4:
+        L = 2
+        nh = world // L
+        host_id, local_rank = rank // L, rank % L
+        local_base = base_port + world + 1 + host_id * L
+        hl = lib.dpx_comm_init(b"127.0.0.1", local_base, local_rank, L,
+                               20000)
+        check(bool(hl), "local sub-group rendezvous failed")
+        lib.dpx_set_timeout_ms(hl, 30000)
+        hlead = None
+        if local_rank == 0:
+            leader_base = base_port + 2 * world + 1
+            hlead = lib.dpx_comm_init(b"127.0.0.1", leader_base, host_id,
+                                      nh, 20000)
+            check(bool(hlead), "leader sub-group rendezvous failed")
+            lib.dpx_set_timeout_ms(hlead, 30000)
+        n = 4096 + 13
+        rng = np.random.default_rng(77)
+        hbase = rng.standard_normal((world, n)).astype(np.float32)
+        x = hbase[rank].copy()
+        check(lib.dpx_reduce_f32(hl, f32ptr(x), n) == 0,
+              "hier local reduce rc")
+        if hlead is not None:
+            check(lib.dpx_allreduce_qn(hlead, f32ptr(x), n, 64, 4, 4)
+                  == 0, "hier leader allreduce_qn(4) rc")
+        check(lib.dpx_broadcast(
+            hl, x.ctypes.data_as(ctypes.c_char_p), x.nbytes, 0) == 0,
+            "hier local broadcast rc")
+        want = hbase.sum(axis=0)
+        tol = 2.0 * nh * nh * (np.abs(want).max() / 7.0) + 1e-6
+        check(float(np.abs(x - want).max()) <= tol,
+              "hier result beyond q4 bound")
+        # cross-rank bit-identity over the WHOLE world
+        xc = np.uint32(lib.dpx_crc32c(
+            x.ctypes.data_as(ctypes.c_void_p), x.nbytes))
+        rb = (np.zeros(world, np.uint32) if rank == 0 else None)
+        check(lib.dpx_gather(
+            h, xc.tobytes(), 4,
+            rb.ctypes.data_as(ctypes.c_char_p) if rank == 0 else None)
+            == 0, "gather rc (hier)")
+        if rank == 0:
+            check(len(set(rb.tolist())) == 1,
+                  f"hier results not bit-identical: {rb}")
+        if hlead is not None:
+            lib.dpx_comm_destroy(hlead)
+        lib.dpx_comm_destroy(hl)
     lib.dpx_comm_destroy(h)
 
     # abort-path teardown: a second group is aborted, every later op must
-    # fail fast (exercises close/shutdown paths under the sanitizer)
-    h2 = lib.dpx_comm_init(b"127.0.0.1", base_port + world + 1, rank,
+    # fail fast (exercises close/shutdown paths under the sanitizer).
+    # Ports beyond the hier sub-groups' range (base+world+1 .. base+2W+nh)
+    # so no listener is re-bound while a peer still races its teardown.
+    h2 = lib.dpx_comm_init(b"127.0.0.1", base_port + 3 * world + 2, rank,
                            world, 20000)
     check(bool(h2), "second rendezvous failed")
     lib.dpx_comm_abort(h2)
