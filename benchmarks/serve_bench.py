@@ -34,12 +34,25 @@ and a ``vs_unshared_ttft_p50_x`` ratio withheld-or-printed per the
 spread-gate policy; non-smoke runs append the record to
 ``benchmarks/tpu_results.jsonl`` (stage ``serve_shared``).
 
+The **disaggregated arm** (serve/disagg/, docs/serving.md) runs the
+same seeded Poisson open loop through the split engine (PrefillEngine +
+DecodeEngine over the KV-page handoff) vs the monolithic paged engine
+on the SAME population/arrivals: TTFT and TPOT p50/p99 as gated
+medians, per-request handoff bytes, and a ``vs_monolithic_tpot_p99_x``
+ratio printed-or-withheld per the spread gate; a second record (stage
+``serve_disagg``) lands in ``benchmarks/tpu_results.jsonl`` on
+non-smoke runs. A one-shot q8 run pins the handoff byte claim:
+CommStats-booked bytes equal the ``wire.handoff_page_wire_bytes``
+formula, at >= 3.5x under the f32 frame.
+
 ``--smoke`` shrinks everything to a seconds-scale CPU run AND asserts
-engine streams equal standalone ``generate()`` (both engines), that the
-shared arm's hit rate is > 0 with ``prefill_tokens_saved`` exactly the
-analytic count for the synthetic population, and that the paged engine
-kept ONE decode program — the CI job that keeps the engine loop from
-rotting (tier1.yml).
+engine streams equal standalone ``generate()`` (all three engines —
+continuous, paged+shared, disaggregated), that the shared arm's hit
+rate is > 0 with ``prefill_tokens_saved`` exactly the analytic count
+for the synthetic population, that the paged AND disagg engines kept
+ONE decode program (zero on the prefill side of the split), and the
+q8 handoff byte gates above — the CI job that keeps the engine loops
+from rotting (tier1.yml).
 
 Usage: python benchmarks/serve_bench.py [--smoke] [--slots N]
            [--requests N] [--rate R] [--max-new N] [--seed S]
@@ -139,6 +152,38 @@ def run_engine(model, params, reqs, n_slots, max_len, rate=None, seed=0,
                              "prefill_compiles", "sample_compiles")}
     if paged:
         rep["pages"] = st["pages"]
+    return rep, outs
+
+
+def run_disagg(model, params, reqs, n_slots, max_len, rate=None, seed=0,
+               page_len=None, width="f32"):
+    """Submit ``reqs`` through the disaggregated split (closed loop, or
+    Poisson open loop at ``rate``) and aggregate per-request records —
+    which now carry the TTFT decomposition and handoff bytes."""
+    from distributed_pytorch_tpu.serve import (DisaggConfig, DisaggEngine,
+                                               aggregate)
+    eng = DisaggEngine(model, params,
+                       DisaggConfig(n_slots=n_slots, max_len=max_len,
+                                    page_len=page_len,
+                                    handoff_width=width))
+    rng = np.random.default_rng(seed)
+    handles = []
+    t0 = time.monotonic()
+    with eng:
+        for prompt, sp, key in reqs:
+            if rate is not None:
+                time.sleep(rng.exponential(1.0 / rate))
+            handles.append(eng.submit(prompt, sp, rng=key))
+        outs = [h.result(timeout=600) for h in handles]
+    wall = time.monotonic() - t0
+    rep = aggregate([h.metrics for h in handles], wall_s=wall)
+    st = eng.stats()
+    rep["stats"] = {
+        "decode_compiles": st["decode"]["decode_compiles"],
+        "prefill_side_decode_compiles": st["prefill"]["decode_compiles"],
+        "prefill_compiles": st["prefill"]["prefill_compiles"],
+    }
+    rep["handoff"] = st["handoff"]
     return rep, outs
 
 
@@ -433,18 +478,150 @@ def main(argv):
             "prefill_tokens_saved": got_saved, "analytic": analytic,
             "engine_matches_generate": True}
 
+    # ---- disaggregated prefill/decode arm (serve/disagg/) ----
+    # the SAME mixed population and Poisson arrivals through the split
+    # engine vs the monolithic paged engine; TTFT/TPOT p50/p99 as gated
+    # medians, vs_monolithic withheld-or-printed per the spread gate,
+    # and the q8 handoff byte claim pinned against the wire formula.
+    from distributed_pytorch_tpu.serve.disagg import kv_wire_bytes
+    rec_d = pbrecord.make_record("serve_disagg_tpot_ms_p99", "ms",
+                                 device="cpu-loopback")
+    rec_d.update({"bench": "serve_disagg", "smoke": smoke,
+                  "config": dict(rec["config"], page_len=page_len,
+                                 handoff_width="f32"),
+                  "arms": {}})
+    lat_keys = ("ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50",
+                "tpot_ms_p99")
+    first_disagg = {}
+
+    def disagg_once():
+        rep, outs = run_disagg(model, params, mixed, n_slots, max_len,
+                               rate=rate, seed=seed + 3,
+                               page_len=page_len)
+        first_disagg.setdefault("outs", outs)
+        first_disagg.setdefault("rep", rep)
+        return rep
+
+    disagg_rep, disagg_st = measured_stats(
+        disagg_once, lat_keys, warmup=warmup, trials=trials,
+        absent_as_zero=())
+    rec_d["arms"]["engine_disagg_open"] = disagg_rep
+    mono_rep, mono_st = measured_stats(
+        lambda: run_engine(model, params, mixed, n_slots, max_len,
+                           rate=rate, seed=seed + 3, paged=True,
+                           page_len=page_len)[0],
+        lat_keys, warmup=warmup, trials=trials, absent_as_zero=())
+    rec_d["arms"]["engine_monolithic_open"] = mono_rep
+    for k in lat_keys:
+        rec_d["metrics"][f"serve_disagg_{k}"] = pbrecord.make_metric(
+            None, "ms", stats=disagg_st[k], direction="lower")
+        rec_d["metrics"][f"serve_monolithic_{k}"] = pbrecord.make_metric(
+            None, "ms", stats=mono_st[k], direction="lower")
+    rec_d["value"] = round(disagg_st["tpot_ms_p99"].median, 2)
+    rec_d["provenance"] = "measured"
+    rec_d["trusted"] = disagg_st["tpot_ms_p99"].trusted
+    if rec_d["trusted"]:
+        rec_d.pop("untrusted_reason", None)
+    else:
+        rec_d["untrusted_reason"] = \
+            disagg_st["tpot_ms_p99"].untrusted_reason
+    # TPOT is lower-better: >1 means the split decodes at a faster
+    # cadence than the prefill-interleaved monolithic loop
+    vs, why = pbstats.gated_ratio(mono_st["tpot_ms_p99"],
+                                  disagg_st["tpot_ms_p99"])
+    if vs is not None:
+        rec_d["vs_monolithic_tpot_p99_x"] = round(vs, 2)
+    else:
+        rec_d["vs_monolithic_tpot_p99_withheld"] = why
+    # handoff byte claim: one q8 closed-loop pass over the population;
+    # booked bytes must EQUAL the wire formula on both widths and the
+    # q8 frame must be >= 3.5x under f32
+    q8_rep, _ = run_disagg(model, params, mixed, n_slots, max_len,
+                           page_len=page_len, width="q8")
+    pe = (getattr(model, "n_kv_heads", model.n_heads) * page_len
+          * (model.dim // model.n_heads))
+    f32_formula = sum(
+        kv_wire_bytes(model.n_layers, -(-len(p) // page_len), pe, None)
+        for p, _, _ in mixed)
+    q8_formula = sum(
+        kv_wire_bytes(model.n_layers, -(-len(p) // page_len), pe, 8)
+        for p, _, _ in mixed)
+    f32_bytes = first_disagg["rep"]["handoff"]["bytes_sent"]
+    q8_bytes = q8_rep["handoff"]["bytes_sent"]
+    rec_d["handoff"] = {
+        "f32_bytes": f32_bytes, "q8_bytes": q8_bytes,
+        "f32_formula": f32_formula, "q8_formula": q8_formula,
+        "q8_vs_f32_bytes_x": round(f32_bytes / q8_bytes, 2),
+        "page_elems": pe,
+        "handoff_ms_p50": first_disagg["rep"].get("handoff_ms_p50"),
+    }
+    rec_d["metrics"]["serve_disagg_q8_vs_f32_bytes_x"] = \
+        pbrecord.make_metric(round(f32_bytes / q8_bytes, 2), "x")
+
+    if smoke:
+        # the disagg CI gates (tier1.yml): exact-handoff streams must
+        # equal standalone generate(), the q8 handoff must book >= 3.5x
+        # fewer bytes than f32 with CommStats EXACTLY the wire formula,
+        # and the split must keep ONE decode program (zero on the
+        # prefill side)
+        import jax
+        import jax.numpy as jnp
+        from distributed_pytorch_tpu.models.generate import make_generate_fn
+        problems = []
+        for i in (0, n_req // 2, n_req - 1):
+            prompt, sp, key = mixed[i]
+            ref = np.asarray(jax.jit(make_generate_fn(
+                model, sp.max_new_tokens, max_len=max_len))(
+                params, jnp.asarray(prompt[None]), key))[0]
+            if not np.array_equal(first_disagg["outs"][i], ref):
+                problems.append(f"disagg request {i} diverged from "
+                                f"standalone generate()")
+        if f32_bytes != f32_formula:
+            problems.append(f"f32 handoff bytes {f32_bytes} != wire "
+                            f"formula {f32_formula}")
+        if q8_bytes != q8_formula:
+            problems.append(f"q8 handoff bytes {q8_bytes} != wire "
+                            f"formula {q8_formula}")
+        if not f32_bytes / q8_bytes >= 3.5:
+            problems.append(f"q8 handoff byte cut "
+                            f"{f32_bytes / q8_bytes:.2f}x < 3.5x")
+        st_d = first_disagg["rep"]["stats"]
+        if st_d["decode_compiles"] != 1:
+            problems.append(f"disagg decode_compiles "
+                            f"{st_d['decode_compiles']} != 1")
+        if st_d["prefill_side_decode_compiles"] != 0:
+            problems.append(
+                f"prefill-side decode_compiles "
+                f"{st_d['prefill_side_decode_compiles']} != 0")
+        if problems:
+            print(json.dumps({"bench": "serve_disagg",
+                              "error": "; ".join(problems)}))
+            return 1
+        rec_d["disagg_gates"] = {
+            "engine_matches_generate": True,
+            "q8_vs_f32_bytes_x": round(f32_bytes / q8_bytes, 2),
+            "commstats_equals_formula": True,
+            "decode_compiles": 1}
+
     issues = pbrecord.validate_record(rec, strict=False)
     if issues:
         rec["schema_issues"] = issues
         print(f"# WARNING: serve record failed schema self-validation: "
               f"{'; '.join(issues[:3])}", file=sys.stderr)
     print(json.dumps(rec))
+    issues = pbrecord.validate_record(rec_d, strict=False)
+    if issues:
+        rec_d["schema_issues"] = issues
+        print(f"# WARNING: disagg record failed schema self-validation: "
+              f"{'; '.join(issues[:3])}", file=sys.stderr)
+    print(json.dumps(rec_d))
     if not smoke and dpxenv.get("DPX_BENCH_SELFLOG"):
         # real (non-CI) runs land in the trajectory store so the
         # shared-prefix TTFT numbers join the BENCH record trail
-        pbrecord.append_row(
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "tpu_results.jsonl"), "serve_shared", rec)
+        store = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tpu_results.jsonl")
+        pbrecord.append_row(store, "serve_shared", rec)
+        pbrecord.append_row(store, "serve_disagg", rec_d)
     return 0
 
 

@@ -83,6 +83,11 @@ TYPED_ERRORS: Dict[str, Tuple[str, ...]] = {
     "EngineStopped": ("request_id", "iteration"),
     "PagePoolExhausted": ("request_id", "iteration", "needed",
                           "free_pages"),
+    "HandoffError": ("request_id", "iteration", "engine"),
+    "PrefillEngineDied": ("request_id", "iteration", "engine"),
+    "HandoffTimeout": ("request_id", "iteration", "engine",
+                       "deadline_ms"),
+    "HandoffCorrupt": ("request_id", "iteration", "engine", "page"),
     "WorkerFailure": ("rank", "exitcode", "op", "kind"),
 }
 

@@ -58,7 +58,7 @@ order), which the native parity test leans on.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -277,6 +277,22 @@ def quant_leg_wire_bytes(n: int, world: int, block: int = QUANT_BLOCK,
     for start, cnt in segment_blocks(n, world, block):
         total += (world - 1) * span_wire_bytes(start, cnt, n, block, bits)
     return total
+
+
+def handoff_page_wire_bytes(page_elems: int, n_tensors: int,
+                            block: int = QUANT_BLOCK,
+                            bits: Optional[int] = 8) -> int:
+    """Wire bytes of a paged KV handoff's quantizable section
+    (``serve/disagg/``): ``n_tensors`` page tensors of ``page_elems``
+    f32 values each, every page framed INDEPENDENTLY (its scales are
+    local — "per-page scales" — so a hot page never shares dynamic
+    range with a cold one). ``bits=None`` is the exact f32 wire (4
+    bytes/element, no scales). This is the number the handoff books
+    into CommStats, and the CI gate asserts the booked bytes equal it
+    exactly (tier1.yml serve smoke)."""
+    if bits is None:
+        return n_tensors * page_elems * 4
+    return n_tensors * quant_wire_bytes(page_elems, block, bits)
 
 
 def ring_owned_span(n: int, world: int, rank: int,

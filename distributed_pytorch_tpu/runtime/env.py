@@ -312,6 +312,21 @@ register("DPX_SERVE_PREFIX_SHARE", "bool", True,
          "Enable radix prefix sharing in the paged serving cache "
          "(refcounted reuse of resident full prompt pages; 0 = paged "
          "layout without sharing).")
+register("DPX_SERVE_DISAGG", "bool", False,
+         "Serve through the disaggregated prefill/decode split "
+         "(serve/disagg/) where the front door supports it "
+         "(examples/serve_lm.py honors it as the --disagg default; "
+         "docs/serving.md).")
+register("DPX_HANDOFF_WIDTH", "str", "f32",
+         "Wire width of the disaggregated KV-page handoff frame: `f32` "
+         "(exact — the bit-exact-tokens default contract), `q8` "
+         "(block-int8 with per-page scales, ~4x fewer handoff bytes) "
+         "or `q4` (nibble-packed, ~7.9x; serve/disagg/frames.py).")
+register("DPX_HANDOFF_TIMEOUT_MS", "int", 30_000,
+         "Deadline for a sent handoff frame to materialize in the "
+         "decode pool; past it the request fails as a typed "
+         "`HandoffTimeout` instead of waiting forever on a wedged "
+         "prefill engine or transport (0 disables).")
 
 # -- torch front door / benches --------------------------------------------
 register("DPX_WEIGHT_UPDATE", "str", "replicated",

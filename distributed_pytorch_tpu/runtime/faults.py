@@ -47,7 +47,16 @@ everything):
   every page-allocation attempt (admission tail AND mid-decode growth)
   and ``op=page_evict`` at each LRU eviction of a refcount-zero page —
   ``delay@op=page_admit,ms=...`` models a slow allocator under eviction
-  pressure (the chaos case in tests/test_serve_pages.py).
+  pressure (the chaos case in tests/test_serve_pages.py). The
+  disaggregated serving split (``serve/disagg/``) fires
+  ``op=handoff_send`` as the prefill engine hands a finished prompt's
+  KV-page frame to the transport and ``op=handoff_recv`` as the decode
+  engine takes one off it — ``drop_conn@op=handoff_send,call=N``
+  severs the transport mid-handoff of the Nth frame (the
+  kill-the-prefill-engine chaos case in tests/test_serve_disagg.py;
+  under the cross-process transport the hooks run inside real rank
+  processes, so ``kill@op=handoff_send`` hard-kills the prefill
+  process at the frame boundary).
 - ``call``    — the Nth (1-based) invocation of that op in this process.
 - ``step``    — the training step; specs *without* ``op`` fire from
   :func:`on_step` (train loops call it once per step); specs *with*
@@ -118,7 +127,7 @@ COMM_OPS = ("allreduce", "allreduce_q8", "allreduce_q4",
             "reduce_scatter", "allgather", "hier_reduce", "hier_gather",
             "reduce", "gather", "broadcast", "barrier",
             "ckpt", "ckpt_commit", "ckpt_commit_window", "serve_step",
-            "page_admit", "page_evict")
+            "page_admit", "page_evict", "handoff_send", "handoff_recv")
 
 
 @dataclass

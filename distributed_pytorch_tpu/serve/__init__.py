@@ -12,18 +12,23 @@ loop and threaded front door (``engine``), and per-request SLO metrics
 """
 
 from .cache import CompileCounts, SlotPool  # noqa: F401
+from .disagg import DisaggConfig, DisaggEngine  # noqa: F401
 from .engine import EngineConfig, InferenceEngine  # noqa: F401
 from .metrics import aggregate, percentile, request_record  # noqa: F401
 from .pages import PagedSlotPool, PagePool, PrefixIndex  # noqa: F401
 from .scheduler import AdmissionScheduler  # noqa: F401
 from .types import (AdmissionRejected, EngineStopped,  # noqa: F401
-                    PagePoolExhausted, Request, RequestDeadlineExceeded,
-                    RequestHandle, SamplingParams, ServeError)
+                    HandoffCorrupt, HandoffError, HandoffTimeout,
+                    PagePoolExhausted, PrefillEngineDied, Request,
+                    RequestDeadlineExceeded, RequestHandle,
+                    SamplingParams, ServeError)
 
 __all__ = [
     "AdmissionRejected", "AdmissionScheduler", "CompileCounts",
-    "EngineConfig", "EngineStopped", "InferenceEngine", "PagePool",
-    "PagePoolExhausted", "PagedSlotPool", "PrefixIndex", "Request",
+    "DisaggConfig", "DisaggEngine", "EngineConfig", "EngineStopped",
+    "HandoffCorrupt", "HandoffError", "HandoffTimeout",
+    "InferenceEngine", "PagePool", "PagePoolExhausted", "PagedSlotPool",
+    "PrefillEngineDied", "PrefixIndex", "Request",
     "RequestDeadlineExceeded", "RequestHandle", "SamplingParams",
     "ServeError", "SlotPool", "aggregate", "percentile", "request_record",
 ]

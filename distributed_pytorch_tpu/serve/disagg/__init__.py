@@ -1,0 +1,31 @@
+"""serve/disagg/ — disaggregated prefill/decode serving.
+
+The Gemma-on-TPU serving split (PAPERS.md, arXiv 2605.25645): prefill
+and decode run as SEPARATE engines — separate loops in one process
+(:class:`~.transport.LocalTransport`, the default) or separate OS
+processes over the native comm group
+(:class:`~.transport.HostCommTransport`) — connected by a KV-page
+handoff that ships a finished prompt's resident pages through
+``comm/wire.py``'s block codec (EQuARX-style per-page scales,
+``DPX_HANDOFF_WIDTH`` selecting f32/q8/q4; arXiv 2506.17615), so a long
+prompt never appears in the decode loop and handoff bytes run ~4x
+(q8) / ~7.9x (q4) under f32. :class:`~.router.DisaggEngine` is the
+front door; architecture, frame layout, failure model and the quality
+bound: docs/serving.md.
+"""
+
+from .decode import DecodeEngine  # noqa: F401
+from .frames import (HANDOFF_WIDTHS, HandoffFrame,  # noqa: F401
+                     decode_frame, encode_frame, kv_wire_bytes,
+                     resolve_handoff_bits)
+from .prefill import PrefillEngine  # noqa: F401
+from .router import DisaggConfig, DisaggEngine  # noqa: F401
+from .transport import (HostCommTransport, LocalTransport,  # noqa: F401
+                        TransportSevered)
+
+__all__ = [
+    "DecodeEngine", "DisaggConfig", "DisaggEngine", "HANDOFF_WIDTHS",
+    "HandoffFrame", "HostCommTransport", "LocalTransport",
+    "PrefillEngine", "TransportSevered", "decode_frame", "encode_frame",
+    "kv_wire_bytes", "resolve_handoff_bits",
+]

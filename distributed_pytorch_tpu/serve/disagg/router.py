@@ -1,0 +1,462 @@
+"""The disaggregated serving front door (``serve/disagg/``).
+
+:class:`DisaggEngine` keeps the PR 3 contract — ``submit(prompt,
+SamplingParams, rng=...) → RequestHandle`` with a future, streaming
+callbacks, and per-request SLO metrics — while running prefill and
+decode as SEPARATE ENGINES connected by the quantized KV-page handoff:
+
+    submit() → AdmissionScheduler → PrefillEngine (radix reuse, tail
+    prefill, extract pages, encode frame) → transport (block-q8/q4 wire
+    or exact f32; DPX_HANDOFF_WIDTH) → DecodeEngine (integrity check,
+    adopt pages via the alloc/refcount path, sample token 0, decode
+    loop) → future / streaming
+
+The router owns the pieces both engines need one authority for: the
+admission queue, the request registry, the handoff-in-flight set (the
+decode loop sweeps it against ``DPX_HANDOFF_TIMEOUT_MS``), and the ONE
+completion path — every retirement and every typed failure funnels
+through :meth:`finish_ok` / :meth:`fail` under a lock, so a request can
+never resolve twice no matter which engine observed its fate first.
+
+Failure containment (the reason the subsystem exists, chaos-tested):
+:meth:`on_prefill_dead` fails ONLY the requests still on the prefill
+side — queued, mid-prefill, or sent-but-unreceived — each as a typed
+``PrefillEngineDied`` with request + engine attribution, and flips the
+front door to reject new submissions; every decode-resident stream
+keeps producing tokens bit-identical to ``generate()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...models.generate import _check_attn_compatible, _model_window
+from ...runtime import env as dpxenv
+from ...utils.logging import MetricsLogger
+from ..engine import _default_buckets
+from ..metrics import request_record
+from ..scheduler import AdmissionScheduler
+from ..types import (FAILED, FINISHED, AdmissionRejected, EngineStopped,
+                     HandoffCorrupt, HandoffTimeout, PrefillEngineDied,
+                     Request, RequestDeadlineExceeded, RequestHandle,
+                     SamplingParams)
+from .decode import DecodeEngine
+from .prefill import PrefillEngine
+from .transport import LocalTransport
+
+
+@dataclass
+class DisaggConfig:
+    """Shape and policy of the disaggregated split. ``n_slots`` ×
+    ``max_len`` budgets the DECODE pool (the monolithic
+    ``EngineConfig`` semantics); the prefill pool only ever holds
+    prompts (``prefill_pages``, default 4x one max-bucket prompt, so
+    the radix index has residency to hit). ``handoff_width`` selects
+    the frame wire (``f32`` exact — the bit-exact default — or
+    ``q8``/``q4``); None knobs default from the typed env registry
+    (``DPX_HANDOFF_WIDTH`` / ``DPX_HANDOFF_TIMEOUT_MS`` /
+    ``DPX_SERVE_PAGE_LEN`` / ``DPX_SERVE_N_PAGES`` /
+    ``DPX_SERVE_PREFIX_SHARE``)."""
+
+    n_slots: int = 4
+    max_len: int = 256
+    buckets: Optional[Tuple[int, ...]] = None
+    max_queue: int = 64
+    metrics: Optional[MetricsLogger] = None
+    log_every: int = 16
+    allow_custom_attn: bool = False
+    page_len: Optional[int] = None
+    n_pages: Optional[int] = None          # decode pool
+    prefill_pages: Optional[int] = None    # prefill pool
+    prefix_share: Optional[bool] = None
+    handoff_width: Optional[str] = None    # "f32" | "q8" | "q4"
+    handoff_timeout_ms: Optional[int] = None
+
+
+class DisaggEngine:
+    """Disaggregated prefill/decode serving over ``TransformerLM``
+    params — the drop-in for :class:`~..engine.InferenceEngine` when a
+    long prefill must never stall decode cadence.
+
+    >>> eng = DisaggEngine(model, params, DisaggConfig(n_slots=4))
+    >>> eng.start()
+    >>> h = eng.submit(prompt_ids, SamplingParams(max_new_tokens=32))
+    >>> tokens = h.result(timeout=60)
+    >>> eng.shutdown()
+    """
+
+    def __init__(self, model, params,
+                 config: Optional[DisaggConfig] = None, *,
+                 transport=None):
+        from . import frames
+        self.config = cfg = config or DisaggConfig()
+        if cfg.n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {cfg.n_slots}")
+        _check_attn_compatible(model, cfg.allow_custom_attn)
+        if _model_window(model) is not None:
+            raise ValueError(
+                "disaggregated serving runs on the paged KV cache, "
+                "which does not support sliding-window models — use the "
+                "monolithic InferenceEngine (its rolling SlotPool "
+                "already bounds their memory)")
+        if (getattr(model, "pos", None) is not None
+                and cfg.max_len > model.max_seq):
+            raise ValueError(
+                f"max_len {cfg.max_len} exceeds the model's max_seq "
+                f"({model.max_seq})")
+        self.model = model
+        self.params = params
+        self.buckets = tuple(sorted(cfg.buckets)) if cfg.buckets \
+            else _default_buckets(cfg.max_len)
+        if max(self.buckets) > cfg.max_len:
+            raise ValueError(
+                f"largest prefill bucket ({max(self.buckets)}) exceeds "
+                f"max_len ({cfg.max_len}) — the decode pool cannot "
+                f"hold it")
+        width = cfg.handoff_width if cfg.handoff_width is not None \
+            else dpxenv.get("DPX_HANDOFF_WIDTH")
+        self.handoff_width = width
+        bits = frames.resolve_handoff_bits(width)
+        self.handoff_timeout_ms = (
+            cfg.handoff_timeout_ms if cfg.handoff_timeout_ms is not None
+            else dpxenv.get("DPX_HANDOFF_TIMEOUT_MS"))
+        page_len = (cfg.page_len if cfg.page_len is not None
+                    else dpxenv.get("DPX_SERVE_PAGE_LEN"))
+        n_pages = (cfg.n_pages if cfg.n_pages is not None
+                   else dpxenv.get("DPX_SERVE_N_PAGES"))
+        if not n_pages:
+            n_pages = cfg.n_slots * (-(-cfg.max_len // page_len))
+        share = (cfg.prefix_share if cfg.prefix_share is not None
+                 else dpxenv.get("DPX_SERVE_PREFIX_SHARE"))
+        prefill_pages = cfg.prefill_pages or \
+            4 * (-(-max(self.buckets) // page_len))
+        self.metrics = cfg.metrics
+        self.scheduler = AdmissionScheduler(cfg.max_queue)
+        self.transport = transport if transport is not None \
+            else LocalTransport()
+        if not getattr(self.transport, "pollable", True):
+            # the decode loop drains the transport BETWEEN tokens with
+            # recv(0) polls; a transport whose recv can only block
+            # (HostCommTransport — a broadcast cannot return "nothing
+            # yet") would stall cadence on the channel and misread an
+            # idle prefill peer as dead, so it is refused up front
+            raise ValueError(
+                f"{type(self.transport).__name__} is not pollable — "
+                f"the DisaggEngine decode loop needs a non-blocking "
+                f"recv; drive a blocking cross-process transport from "
+                f"a dedicated receiver instead (see "
+                f"serve/disagg/transport.py)")
+        self.prefill = PrefillEngine(
+            model, params, self, self.transport, buckets=self.buckets,
+            page_len=page_len, n_pages=prefill_pages,
+            prefix_share=bool(share), bits=bits)
+        self.decode = DecodeEngine(
+            model, params, self, self.transport, n_slots=cfg.n_slots,
+            max_len=cfg.max_len, page_len=page_len, n_pages=n_pages)
+        self._lock = threading.Lock()
+        self._handoff: Dict[int, Request] = {}   # sent, not yet adopted
+        self._requests: Dict[int, Request] = {}  # all in-flight
+        self._next_id = 0
+        self._completed = 0
+        self._failed = 0
+        self._stop = False
+        self._started = False
+        self._prefill_dead_cause: Optional[Exception] = None
+        self._crash: Optional[Exception] = None
+
+    # -- front door --------------------------------------------------------
+
+    def submit(self, prompt, params: Optional[SamplingParams] = None, *,
+               rng=None, on_token=None) -> RequestHandle:
+        """Enqueue one request; same contract as
+        ``InferenceEngine.submit`` (synchronous typed
+        ``AdmissionRejected`` when it can never be served, bounded
+        queue, per-request PRNG split schedule identical to
+        ``generate()``)."""
+        sp = params or SamplingParams()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with self._lock:
+            if self._stop:
+                raise EngineStopped("engine is shut down")  # dpxlint: disable=DPX004 pre-admission, no request id assigned yet
+            rid = self._next_id
+            self._next_id += 1
+        self._validate(prompt, sp, rid)
+        if rng is None:
+            rng = jax.random.PRNGKey(rid)
+        rngs = np.asarray(jax.random.split(rng, sp.max_new_tokens))
+        now = time.monotonic()
+        req = Request(request_id=rid, prompt=prompt, params=sp,
+                      rngs=rngs, submit_t=now,
+                      deadline_t=(now + sp.deadline_ms / 1e3
+                                  if sp.deadline_ms is not None
+                                  else None),
+                      on_token=on_token, stage="prefill_queue")
+        req.handle = RequestHandle(req)
+        with self._lock:
+            if self._stop:
+                raise EngineStopped("engine is shut down",
+                                    request_id=rid)
+            if self._prefill_dead_cause is not None:
+                exc = AdmissionRejected(
+                    f"request {rid}: the prefill engine is dead — "
+                    f"decode-resident streams continue, new admissions "
+                    f"are refused", reason="prefill_dead",
+                    request_id=rid)
+                exc.__cause__ = self._prefill_dead_cause
+                raise exc
+            self.scheduler.submit(req)   # may raise AdmissionRejected
+            self._requests[rid] = req
+        self.prefill.wake()
+        return req.handle
+
+    def _validate(self, prompt, sp: SamplingParams, rid: int) -> None:
+        s = int(prompt.shape[0])
+        if s < 1 or sp.max_new_tokens < 1:
+            raise AdmissionRejected(
+                f"request {rid}: empty prompt or max_new_tokens < 1",
+                reason="invalid", request_id=rid)
+        if s > max(self.buckets):
+            raise AdmissionRejected(
+                f"request {rid}: prompt length {s} exceeds the largest "
+                f"prefill bucket ({max(self.buckets)})",
+                reason="prompt_too_long", request_id=rid)
+        if s + sp.max_new_tokens > self.config.max_len:
+            raise AdmissionRejected(
+                f"request {rid}: prompt ({s}) + max_new_tokens "
+                f"({sp.max_new_tokens}) exceeds the decode pool "
+                f"({self.config.max_len})",
+                reason="too_long", request_id=rid)
+        L = self.decode.pool.page_len
+        worst = -(-(s + sp.max_new_tokens - 1) // L)
+        if worst > self.decode.pool.n_pages:
+            raise AdmissionRejected(
+                f"request {rid}: worst-case page need ({worst}) exceeds "
+                f"the decode page pool ({self.decode.pool.n_pages} "
+                f"pages of {L})", reason="no_free_pages",
+                request_id=rid)
+        if -(-s // self.prefill.pool.page_len) > self.prefill.pool.n_pages:
+            raise AdmissionRejected(
+                f"request {rid}: prompt needs "
+                f"{-(-s // self.prefill.pool.page_len)} page(s), more "
+                f"than the whole prefill pool "
+                f"({self.prefill.pool.n_pages})",
+                reason="no_free_pages", request_id=rid)
+
+    def start(self) -> "DisaggEngine":
+        if self._started:
+            raise RuntimeError("engine already started")
+        self._started = True
+        self.decode.start()
+        self.prefill.start()
+        return self
+
+    def shutdown(self, wait: bool = True) -> None:
+        with self._lock:
+            self._stop = True
+        self.prefill.stop(wait=wait)
+        self.decode.stop(wait=wait)
+        self._drain_on_stop()
+
+    def __enter__(self) -> "DisaggEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -- the one completion path ------------------------------------------
+
+    def _resolve(self, req: Request) -> bool:
+        """Claim the right to resolve ``req`` (exactly-once, under the
+        lock); False if another path already did."""
+        with self._lock:
+            if req.done:
+                return False
+            self._requests.pop(req.request_id, None)
+            self._handoff.pop(req.request_id, None)
+            return True
+
+    def finish_ok(self, req: Request) -> None:
+        if not self._resolve(req):
+            return
+        req.state = FINISHED
+        with self._lock:
+            self._completed += 1
+        rec = request_record(req, "ok")
+        req.handle.metrics = rec
+        if self.metrics is not None:
+            self.metrics.event("serve_request", **rec)
+        req.handle.future.set_result(
+            np.asarray(req.out_tokens, np.int32))
+
+    def fail(self, req: Request, exc: Exception, outcome: str) -> None:
+        if not self._resolve(req):
+            return
+        req.state = FAILED
+        with self._lock:
+            self._failed += 1
+        rec = request_record(req, outcome)
+        req.handle.metrics = rec
+        if self.metrics is not None:
+            self.metrics.event("serve_request", **rec)
+        req.handle.future.set_exception(exc)
+
+    def fail_queued_deadline(self, req: Request) -> None:
+        self.fail(req, RequestDeadlineExceeded(
+            f"request {req.request_id} missed its deadline "
+            f"({req.params.deadline_ms} ms) while queued for prefill",
+            deadline_ms=req.params.deadline_ms, stage="queued",
+            request_id=req.request_id,
+            iteration=self.prefill.iterations),
+            outcome="deadline_queued")
+
+    def fail_handoff_corrupt(self, exc: HandoffCorrupt,
+                             iteration: int) -> None:
+        """Route a corrupt frame to its request when the header named
+        one; unattributable damage (bad magic, truncated header) means
+        the channel itself cannot be trusted — treated as prefill-side
+        death, decode residents unaffected."""
+        req = None
+        if exc.request_id is not None:
+            with self._lock:
+                req = self._requests.get(exc.request_id)
+        if req is not None:
+            exc.iteration = iteration
+            self.fail(req, exc, outcome="handoff_corrupt")
+        else:
+            self.on_prefill_dead(exc)
+
+    # -- handoff bookkeeping ----------------------------------------------
+
+    def enter_handoff(self, req: Request) -> None:
+        with self._lock:
+            self._handoff[req.request_id] = req
+
+    def take_handoff(self, request_id: int) -> Optional[Request]:
+        with self._lock:
+            return self._handoff.pop(request_id, None)
+
+    def handoff_count(self) -> int:
+        with self._lock:
+            return len(self._handoff)
+
+    def sweep_handoff_timeouts(self, now: float, iteration: int) -> None:
+        """Fail (typed ``HandoffTimeout``) every sent frame that outran
+        ``DPX_HANDOFF_TIMEOUT_MS`` — called by the decode loop each
+        iteration, so a wedged prefill engine or transport cannot park
+        a request forever."""
+        tmo = self.handoff_timeout_ms
+        if not tmo:
+            return
+        with self._lock:
+            late = [r for r in self._handoff.values()
+                    if r.handoff_send_t is not None
+                    and (now - r.handoff_send_t) * 1e3 >= tmo]
+        for req in late:
+            self.fail(req, HandoffTimeout(
+                f"request {req.request_id}: handoff frame not "
+                f"materialized within {tmo} ms of send",
+                deadline_ms=float(tmo), engine="transport",
+                request_id=req.request_id, iteration=iteration),
+                outcome="handoff_timeout")
+
+    # -- failure domains ---------------------------------------------------
+
+    def on_prefill_dead(self, cause: Exception) -> None:
+        """The prefill engine is gone (crash, severed transport,
+        injected kill). Fail ONLY its side of the handoff — queued,
+        mid-prefill, sent-but-unreceived — typed and attributed; flip
+        the front door to reject new work; leave every decode-resident
+        stream running."""
+        with self._lock:
+            if self._prefill_dead_cause is not None:
+                return
+            self._prefill_dead_cause = cause
+            victims = list(self._handoff.values())
+        victims += self.prefill.drain_requests()
+        victims += self.scheduler.drain()
+        for req in victims:
+            exc = PrefillEngineDied(
+                f"request {req.request_id} lost in stage "
+                f"{req.stage}: the prefill engine died "
+                f"({cause!r}) — decode-resident streams continue",
+                request_id=req.request_id, engine="prefill",
+                iteration=self.prefill.iterations)
+            exc.__cause__ = cause
+            self.fail(req, exc, outcome="prefill_died")
+
+    def on_decode_crash(self, cause: Exception) -> None:
+        """A decode-loop crash strands every future — fail them all
+        typed with the cause chained, then stop serving (the monolithic
+        engine's crash-drain contract)."""
+        self._crash = cause
+        with self._lock:
+            self._stop = True
+        self.prefill.stop(wait=False)
+        self.transport.abort()
+        self._drain_on_stop()
+
+    def _drain_on_stop(self) -> None:
+        cause = f" (engine crashed: {self._crash!r})" \
+            if self._crash is not None else ""
+        victims = self.scheduler.drain() + self.prefill.drain_requests() \
+            + self.decode.drain_requests()
+        with self._lock:
+            victims += list(self._handoff.values())
+        for req in victims:
+            exc = EngineStopped(
+                f"engine stopped with request {req.request_id} in "
+                f"stage {req.stage}{cause}",
+                request_id=req.request_id,
+                iteration=self.decode.iterations)
+            exc.__cause__ = self._crash
+            self.fail(req, exc, outcome="engine_stopped")
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict:
+        """Split-aware engine stats. The compile-discipline gates live
+        here: ``decode.decode_compiles == 1`` and
+        ``prefill.decode_compiles == 0`` after any workload — the split
+        must not multiply programs (asserted in tests + CI smoke)."""
+        tstats = self.transport.stats.summary()
+        return {
+            "completed": self._completed,
+            "failed": self._failed,
+            "queue_depth": len(self.scheduler),
+            "buckets": self.buckets,
+            "handoff_width": self.handoff_width,
+            "prefill": self.prefill.stats(),
+            "decode": self.decode.stats(),
+            "handoff": {
+                "in_flight": self.handoff_count(),
+                "frames_sent": self.transport.frames_sent,
+                "frames_recv": self.transport.frames_recv,
+                "bytes_sent": int(tstats.get("handoff_send", {})
+                                  .get("bytes", 0)),
+                "bytes_recv": int(tstats.get("handoff_recv", {})
+                                  .get("bytes", 0)),
+            },
+        }
+
+    def periodic_metrics(self, iteration: int) -> None:
+        """Emit the periodic engine record (decode-loop cadence)."""
+        if self.metrics is None or iteration % self.config.log_every:
+            return
+        d = self.decode.stats()
+        self.metrics.log(
+            step=iteration, kind="serve_disagg_engine",
+            queue_depth=len(self.scheduler),
+            handoff_in_flight=self.handoff_count(),
+            active_slots=d["active_slots"],
+            pending_handoffs=d["pending_handoffs"],
+            tokens_emitted=d["tokens_emitted"],
+            pool_occupancy=d["pages"]["pool_occupancy"],
+            handoff_bytes_sent=int(
+                self.transport.stats.summary()
+                .get("handoff_send", {}).get("bytes", 0)))

@@ -1,0 +1,167 @@
+"""Handoff transports: how encoded frames travel prefill → decode.
+
+Two implementations of one tiny contract (``send``/``recv``/``abort``
+plus a ``CommStats`` booking the KV wire bytes under ``handoff_send``):
+
+- :class:`LocalTransport` — a same-process queue. The two engines run
+  as separate loops (threads) in one process; this is the testing and
+  single-host deployment shape, and the one ``DisaggEngine`` builds by
+  default.
+- :class:`HostCommTransport` — the frame pipe over the native TCP
+  process group (:class:`~...runtime.native.HostComm`): prefill and
+  decode run as SEPARATE OS PROCESSES, rendezvoused exactly like
+  training ranks, frames moving as length-prefixed broadcasts from the
+  prefill rank. Failure semantics come from PR 2's typed comm layer for
+  free: a killed prefill process surfaces as ``CommPeerDied`` within
+  one deadline tick, a wedged one as ``CommTimeout`` — both re-raised
+  here as :class:`TransportSevered` for the engine layer to convert
+  into the typed handoff vocabulary (``PrefillEngineDied`` /
+  ``HandoffTimeout``).
+
+Both transports fire the ``DPX_FAULT`` hooks — ``op=handoff_send``
+entering a send, ``op=handoff_recv`` as a frame is taken off — with
+themselves as the fault hook's comm, so ``drop_conn@op=handoff_send``
+severs the channel mid-handoff (the in-process analog of killing the
+prefill engine; the chaos test in tests/test_serve_disagg.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ...runtime import faults
+from ...utils.profiler import CommStats
+
+
+class TransportSevered(RuntimeError):
+    """The handoff channel is gone (peer death, abort, injected
+    drop_conn). Internal signal — the engine layer converts it into the
+    typed ``HandoffError`` vocabulary with request/engine attribution
+    (``serve/disagg/router.py``); it never reaches callers raw."""
+
+
+class LocalTransport:
+    """Same-process frame queue between the prefill and decode loops."""
+
+    #: recv(0) is a true non-blocking poll — safe to drive from the
+    #: decode loop between tokens (the DisaggEngine requirement).
+    pollable = True
+
+    def __init__(self):
+        self._q: "queue.Queue[bytes]" = queue.Queue()
+        self._severed = threading.Event()
+        self.stats = CommStats()
+        self.frames_sent = 0
+        self.frames_recv = 0
+
+    def send(self, frame: bytes, kv_bytes: int) -> None:
+        """Enqueue one encoded frame, booking its KV wire bytes (the
+        ``wire.handoff_page_wire_bytes`` accounting the CI gate pins)
+        under ``handoff_send``."""
+        faults.on_comm_op("handoff_send", comm=self)
+        if self._severed.is_set():
+            raise TransportSevered("handoff transport severed")
+        with self.stats.timed("handoff_send", kv_bytes):
+            self._q.put(frame)
+        self.frames_sent += 1
+
+    def recv(self, timeout_s: float = 0.0) -> Optional[bytes]:
+        """One frame, or None when nothing arrives within ``timeout_s``
+        (0 = non-blocking poll). Raises :class:`TransportSevered` once
+        the channel is severed AND drained — frames already in flight
+        are still delivered, exactly like bytes buffered in a socket."""
+        try:
+            frame = self._q.get(timeout=timeout_s) if timeout_s > 0 \
+                else self._q.get_nowait()
+        except queue.Empty:
+            if self._severed.is_set():
+                raise TransportSevered(
+                    "handoff transport severed") from None
+            return None
+        faults.on_comm_op("handoff_recv", comm=self)
+        self.frames_recv += 1
+        return frame
+
+    def abort(self) -> None:
+        """Sever the channel NOW (fault injection's ``drop_conn`` and
+        the engine teardown path): senders fail immediately, receivers
+        after draining what was already in flight."""
+        self._severed.set()
+
+    @property
+    def severed(self) -> bool:
+        return self._severed.is_set()
+
+
+class HostCommTransport:
+    """Frame pipe over a 2-process :class:`~...runtime.native.HostComm`
+    group: the prefill process is ``src``, frames travel as a length
+    broadcast followed by the payload broadcast. Blocking receive with
+    the native per-op deadline (``DPX_COMM_TIMEOUT_MS``) — a wedged or
+    dead peer becomes a typed failure, never a hang.
+
+    This is the cross-process HANDOFF PROTOCOL (frame framing + PR 2
+    failure semantics over real process boundaries — what
+    tests/test_serve_disagg.py's kill case proves), driven from each
+    rank process's main loop. It is NOT pollable: a broadcast cannot
+    return "nothing yet", so plugging it straight into
+    ``DisaggEngine``'s decode loop would stall token cadence on the
+    channel and misread an idle prefill peer as dead after one comm
+    deadline — the engine therefore refuses it at construction
+    (``pollable = False``); a dedicated receiver feeding a local queue
+    is the integration path for a fully split deployment."""
+
+    pollable = False
+
+    def __init__(self, comm, src: int = 0):
+        if comm.world != 2:
+            raise ValueError(
+                f"HostCommTransport needs a 2-rank group (prefill + "
+                f"decode), got world={comm.world}")
+        self.comm = comm
+        self.src = src
+        self.stats = CommStats()
+        self.frames_sent = 0
+        self.frames_recv = 0
+
+    def send(self, frame: bytes, kv_bytes: int) -> None:
+        from ...runtime.native import CommError
+        faults.on_comm_op("handoff_send", rank=self.comm.rank, comm=self)
+        try:
+            with self.stats.timed("handoff_send", kv_bytes):
+                self.comm.broadcast(
+                    np.array([len(frame)], np.int64), src=self.src)
+                self.comm.broadcast(
+                    np.frombuffer(frame, np.uint8).copy(), src=self.src)
+        except CommError as e:
+            raise TransportSevered(
+                f"handoff send failed: {e}") from e
+        self.frames_sent += 1
+
+    def recv(self, timeout_s: float = 0.0) -> Optional[bytes]:
+        """Blocking receive of one frame (``timeout_s`` is accepted for
+        interface parity; the native ``DPX_COMM_TIMEOUT_MS`` deadline
+        governs, so this still cannot hang forever)."""
+        from ...runtime.native import CommError
+        faults.on_comm_op("handoff_recv", rank=self.comm.rank, comm=self)
+        hdr = np.zeros(1, np.int64)
+        try:
+            self.comm.broadcast(hdr, src=self.src)
+            buf = np.zeros(int(hdr[0]), np.uint8)
+            self.comm.broadcast(buf, src=self.src)
+        except CommError as e:
+            raise TransportSevered(
+                f"handoff recv failed: {e}") from e
+        self.frames_recv += 1
+        return buf.tobytes()
+
+    def abort(self) -> None:
+        self.comm.abort()
+
+    @property
+    def severed(self) -> bool:
+        return False

@@ -8,6 +8,16 @@ Definitions (docs/serving.md):
   first token, ``(last_token_t - first_token_t) / (n_tokens - 1)`` —
   the streaming cadence. Undefined (None) for 1-token outputs.
 
+Disaggregated serving (``serve/disagg/``) decomposes TTFT along the
+handoff timeline: ``queue_ms`` (submit → prefill admission) +
+``prefill_ms`` (admission → frame sent) + ``handoff_ms`` (sent →
+pages materialized in the decode pool) + ``decode_ms`` (materialized →
+first token sampled). Every token — the first included — is emitted by
+the DECODE engine, so TPOT spans decode-engine time exclusively; a
+long co-resident prefill can slow prefill_ms/handoff_ms of the request
+being prefilled, never the cadence of a decoding stream. The spans are
+None for monolithic engines (no handoff timeline exists).
+
 Records flow into the existing line-JSON ``utils.logging.MetricsLogger``
 (one ``serve_request`` event per completed/failed request, one periodic
 ``step`` record with queue depth / slot occupancy), so serving SLOs
@@ -42,6 +52,18 @@ def request_record(req: Request, outcome: str) -> Dict:
            # prefill tokens that reuse skipped
            "prefix_hit_pages": req.prefix_hit_pages,
            "prefill_tokens_saved": req.prefill_tokens_saved}
+    if req.handoff_send_t is not None:
+        # the disagg TTFT decomposition (None spans = the request
+        # failed before reaching that stage)
+        rec["prefill_ms"] = ((req.handoff_send_t - req.admit_t) * 1e3
+                             if req.admit_t is not None else None)
+        rec["handoff_ms"] = ((req.handoff_recv_t - req.handoff_send_t)
+                             * 1e3 if req.handoff_recv_t is not None
+                             else None)
+        rec["decode_ms"] = ((req.first_token_t - req.handoff_recv_t)
+                            * 1e3 if req.handoff_recv_t is not None
+                            and req.first_token_t is not None else None)
+        rec["handoff_bytes"] = req.handoff_bytes
     return rec
 
 
@@ -82,6 +104,18 @@ def aggregate(records: List[Dict], wall_s: Optional[float] = None) -> Dict:
                                   if prompt_toks else None)
         out["prefix_hit_pages"] = sum(r.get("prefix_hit_pages") or 0
                                       for r in ok)
+    hand = [r["handoff_ms"] for r in ok
+            if r.get("handoff_ms") is not None]
+    if hand:
+        # disagg fleet view: the handoff leg of the TTFT decomposition
+        # plus total frame payload moved prefill → decode
+        out["handoff_ms_p50"] = percentile(hand, 50)
+        out["handoff_ms_p99"] = percentile(hand, 99)
+        out["prefill_ms_p50"] = percentile(
+            [r["prefill_ms"] for r in ok
+             if r.get("prefill_ms") is not None], 50)
+        out["handoff_bytes"] = sum(r.get("handoff_bytes") or 0
+                                   for r in ok)
     if wall_s:
         out["wall_s"] = round(wall_s, 3)
         out["tokens_per_sec"] = round(toks / wall_s, 2)

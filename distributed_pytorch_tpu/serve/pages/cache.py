@@ -200,6 +200,58 @@ class PagedSlotPool:
         self.lengths[np.asarray(active)] += 1
         return logits
 
+    def extract(self, slot: int) -> Tuple[int, List[np.ndarray],
+                                          List[np.ndarray]]:
+        """Host copies of ``slot``'s resident pages, in table order —
+        the prefill side of the disaggregated KV-page handoff
+        (``serve/disagg/``). Returns ``(length, ks, vs)`` where ks/vs
+        are per-layer ``(P, Hkv, page_len, Dh)`` f32 numpy arrays.
+        Positions past ``length`` in the last page are ZEROED: a reused
+        pool page may carry a previous occupant's stale K/V there, and
+        while the decode mask would never attend it, shipping garbage
+        would poison the quantized frame's per-page scales."""
+        row = self.owned[slot]
+        length = int(self.lengths[slot])
+        valid_last = length - (len(row) - 1) * self.page_len
+        # gather ON DEVICE, then transfer: only the slot's pages cross
+        # the host boundary, not the whole pool (which would scale each
+        # handoff with pool size instead of prompt size)
+        idx = jnp.asarray(np.asarray(row, np.int32))
+        ks, vs = [], []
+        for i in range(self.model.n_layers):
+            # np.array (not asarray): the zero-padding below mutates,
+            # and a CPU-backend transfer can alias read-only memory
+            k = np.array(self.k_pages[i][idx], np.float32)
+            v = np.array(self.v_pages[i][idx], np.float32)
+            if valid_last < self.page_len:
+                k[-1, :, valid_last:, :] = 0.0
+                v[-1, :, valid_last:, :] = 0.0
+            ks.append(k)
+            vs.append(v)
+        return length, ks, vs
+
+    def adopt(self, slot: int, length: int, ks: List[np.ndarray],
+              vs: List[np.ndarray]) -> int:
+        """Materialize a handed-off request's pages into THIS pool —
+        the decode side of the disaggregated handoff. Pages come from
+        the same allocation path admissions use (free list, then LRU
+        eviction of refcount-zero indexed pages), so
+        :class:`~..types.PagePoolExhausted` back-pressure is intact and
+        nothing is changed on failure. Returns the page count adopted."""
+        n = int(ks[0].shape[0])
+        pids = self._alloc(n)          # all-or-nothing; may raise
+        self.tables[slot, :n] = pids
+        self.tables[slot, n:] = 0
+        self.owned[slot] = pids
+        idx = jnp.asarray(np.asarray(pids, np.int32))
+        for i in range(self.model.n_layers):
+            self.k_pages[i] = self.k_pages[i].at[idx].set(
+                jnp.asarray(ks[i], self.k_pages[i].dtype))
+            self.v_pages[i] = self.v_pages[i].at[idx].set(
+                jnp.asarray(vs[i], self.v_pages[i].dtype))
+        self.lengths[slot] = length
+        return n
+
     def release(self, slot: int) -> None:
         """Drop the slot's references (retirement, failure, or engine
         drain): private pages go straight back to the free list, indexed

@@ -84,6 +84,53 @@ class EngineStopped(ServeError):
     """The engine shut down while the request was still in flight."""
 
 
+class HandoffError(ServeError):
+    """A disaggregated-serving KV-page handoff failed (``serve/disagg/``).
+
+    Base of the handoff failure vocabulary: carries the request, the
+    iteration at which the failure was observed, and ``engine`` — which
+    side of the split is BLAMED (``"prefill"`` / ``"decode"`` /
+    ``"transport"``). The attribution matters operationally: a dead
+    prefill engine must fail ONLY its in-flight requests, typed, while
+    decode-resident streams keep producing bit-exact tokens — so a
+    supervisor restarting the prefill side needs to know no decode
+    state was lost (docs/serving.md)."""
+
+    def __init__(self, msg: str, *, engine: str = "transport", **kw):
+        super().__init__(msg, **kw)
+        self.engine = engine
+
+
+class PrefillEngineDied(HandoffError):
+    """The prefill engine died (crash, injected kill, severed
+    transport) with this request still on its side of the handoff —
+    queued for prefill, mid-prefill, or sent-but-never-received. Only
+    those requests fail; every decode-resident stream continues."""
+
+
+class HandoffTimeout(HandoffError):
+    """A sent handoff frame did not materialize in the decode pool
+    within ``DPX_HANDOFF_TIMEOUT_MS`` — the transport or the prefill
+    side is wedged but nothing closed. Mirrors
+    ``runtime.native.CommTimeout``'s ``deadline_ms`` field (the same
+    failure shape at the serving layer)."""
+
+    def __init__(self, msg: str, *, deadline_ms: float = 0.0, **kw):
+        super().__init__(msg, **kw)
+        self.deadline_ms = deadline_ms
+
+
+class HandoffCorrupt(HandoffError):
+    """A handoff frame failed its integrity check (magic/version/CRC).
+    ``page`` names the first page tensor whose CRC32C mismatched (−1 =
+    the header or logits section) — corruption must never reach the
+    decode pool as silently wrong KV."""
+
+    def __init__(self, msg: str, *, page: int = -1, **kw):
+        super().__init__(msg, **kw)
+        self.page = page
+
+
 class PagePoolExhausted(ServeError):
     """The paged KV pool (``serve/pages/``) could not supply a page:
     every page is either free-list-empty or held by a live reader
@@ -133,6 +180,20 @@ class Request:
     retire_iteration: Optional[int] = None
     first_token_t: Optional[float] = None
     last_token_t: Optional[float] = None
+    # disaggregated serving (serve/disagg/): the handoff timeline and
+    # wire accounting. ``handoff_send_t`` is stamped when the prefill
+    # engine finishes the tail prefill and hands the frame to the
+    # transport; ``handoff_recv_t`` when the decode engine materializes
+    # the pages into its pool. Together with submit_t/admit_t/
+    # first_token_t they decompose TTFT into queue → prefill → handoff
+    # → decode-admission spans (serve/metrics.py); all None for
+    # monolithic engines.
+    handoff_send_t: Optional[float] = None
+    handoff_recv_t: Optional[float] = None
+    handoff_bytes: Optional[int] = None
+    #: coarse lifecycle location for the disagg router's failure
+    #: attribution: "prefill_queue" | "prefill" | "handoff" | "decode"
+    stage: Optional[str] = None
 
     @property
     def done(self) -> bool:

@@ -8,7 +8,7 @@ engine's compile/occupancy stats. Runs on CPU in seconds:
 
     python examples/serve_lm.py [--requests N] [--max-new N]
         [--slots N] [--temperature T] [--metrics-log FILE]
-        [--paged] [--shared-prefix N]
+        [--paged] [--shared-prefix N] [--disagg] [--handoff-width W]
 
 With --metrics-log, per-request TTFT/TPOT events and periodic engine
 records are appended as line-JSON (the same stream training metrics
@@ -16,7 +16,12 @@ use — utils/logging.MetricsLogger). With --paged the engine runs the
 paged, prefix-shared KV cache (serve/pages/); --shared-prefix N gives
 every request the same N-token "system prompt", so the printed
 per-request records show the prefix pages being computed once and hit
-thereafter (prefix_hit_pages / prefill_tokens_saved).
+thereafter (prefix_hit_pages / prefill_tokens_saved). With --disagg
+(default from DPX_SERVE_DISAGG) the requests run through the
+DISAGGREGATED split (serve/disagg/): separate prefill and decode
+engines joined by the KV-page handoff, --handoff-width f32|q8|q4
+choosing the frame wire — the per-request lines then print the TTFT
+decomposition (queue/prefill/handoff/decode) and handoff bytes live.
 """
 
 from __future__ import annotations
@@ -49,6 +54,15 @@ def parse_args(argv=None):
     p.add_argument("--shared-prefix", type=int, default=0,
                    help="give every request the same N-token system "
                         "prompt (shows prefix sharing with --paged)")
+    from distributed_pytorch_tpu.runtime import env as dpxenv
+    p.add_argument("--disagg", action="store_true",
+                   default=bool(dpxenv.get("DPX_SERVE_DISAGG")),
+                   help="disaggregated prefill/decode split "
+                        "(serve/disagg/; default DPX_SERVE_DISAGG)")
+    p.add_argument("--handoff-width", type=str, default=None,
+                   choices=("f32", "q8", "q4"),
+                   help="wire width of the KV-page handoff frame "
+                        "(default DPX_HANDOFF_WIDTH)")
     return p.parse_args(argv)
 
 
@@ -59,8 +73,17 @@ def main(argv=None):
     params = model.init(jax.random.PRNGKey(0))
     logger = MetricsLogger(path=args.metrics_log) if args.metrics_log \
         else None
-    cfg = EngineConfig(n_slots=args.slots, max_len=args.max_len,
-                       metrics=logger, log_every=8, paged=args.paged)
+    if args.disagg:
+        from distributed_pytorch_tpu.serve import (DisaggConfig,
+                                                   DisaggEngine)
+        cfg = DisaggConfig(n_slots=args.slots, max_len=args.max_len,
+                           metrics=logger, log_every=8,
+                           handoff_width=args.handoff_width)
+        make_engine = lambda: DisaggEngine(model, params, cfg)  # noqa: E731
+    else:
+        cfg = EngineConfig(n_slots=args.slots, max_len=args.max_len,
+                           metrics=logger, log_every=8, paged=args.paged)
+        make_engine = lambda: InferenceEngine(model, params, cfg)  # noqa: E731
     rng = np.random.default_rng(0)
     shared = rng.integers(0, 61, (args.shared_prefix,)).astype(np.int32) \
         if args.shared_prefix else None
@@ -70,7 +93,7 @@ def main(argv=None):
             print(f"  [req {rid}] token {i}: {tok}", flush=True)
         return cb
 
-    with InferenceEngine(model, params, cfg) as eng:
+    with make_engine() as eng:
         handles = []
         for i in range(args.requests):
             prompt = rng.integers(0, 61,
@@ -99,17 +122,34 @@ def main(argv=None):
                     f"TTFT {m['ttft_ms']:.1f} ms")
             if m["tpot_ms"]:
                 line += f", TPOT {m['tpot_ms']:.2f} ms"
-            if args.paged:
+            if args.disagg:
+                line += (f" [queue {m['queue_ms']:.0f} + prefill "
+                         f"{m['prefill_ms']:.0f} + handoff "
+                         f"{m['handoff_ms']:.1f} + decode "
+                         f"{m['decode_ms']:.0f} ms; "
+                         f"{m['handoff_bytes']} handoff B, "
+                         f"prefix hit {m['prefix_hit_pages']} pages]")
+            elif args.paged:
                 line += (f", prefix hit {m['prefix_hit_pages']} pages "
                          f"({m['prefill_tokens_saved']} prefill tokens "
                          f"saved)")
             print(line)
         st = eng.stats()
-        print(f"engine: {st['iterations']} iterations, "
-              f"{st['tokens_emitted']} tokens, decode compiles "
-              f"{st['decode_compiles']}, prefill compiles "
-              f"{st['prefill_compiles']}, samplers {st['sample_compiles']}")
-        if args.paged:
+        if args.disagg:
+            print(f"split: decode compiles "
+                  f"{st['decode']['decode_compiles']} (prefill-side "
+                  f"{st['prefill']['decode_compiles']}), prefill "
+                  f"compiles {st['prefill']['prefill_compiles']}, "
+                  f"{st['handoff']['frames_sent']} frames / "
+                  f"{st['handoff']['bytes_sent']} handoff bytes "
+                  f"({st['handoff_width']})")
+        else:
+            print(f"engine: {st['iterations']} iterations, "
+                  f"{st['tokens_emitted']} tokens, decode compiles "
+                  f"{st['decode_compiles']}, prefill compiles "
+                  f"{st['prefill_compiles']}, samplers "
+                  f"{st['sample_compiles']}")
+        if args.paged and not args.disagg:
             ps = st["pages"]
             hr = ps["prefix_hit_rate"]
             print(f"pages: {ps['pages_in_use']}/{ps['n_pages']} in use "
