@@ -26,8 +26,12 @@ SPREAD``) that structurally withholds ``vs_baseline``, and the roofline
 plausibility gate. When the TPU backend stays unhealthy after bounded
 retries the record still carries the newest verified on-chip number as
 an explicit ``last_good`` carry-forward with provenance — a metric is
-never null (perfbench/trajectory.py). ``--smoke`` runs the CPU-gated
-perfbench smoke (CI: the bench-smoke job).
+never null (perfbench/trajectory.py); before falling back to a
+carry-forward, a no-TPU container measures the pinned HOST flagship
+arm against a calibrated host peak (``mfu_host`` stage,
+docs/compute.md) so the headline stays a fresh gated measurement.
+``--smoke`` runs the CPU-gated perfbench smoke (CI: the bench-smoke
+job); ``--headline`` measures and lands ONLY the flagship headline.
 
 Robustness: the TPU backend behind the axon tunnel comes and goes
 (BENCH_r01.json died on it). Backend init runs in a subprocess with
@@ -980,6 +984,119 @@ def _dp8_metric_blobs(dp8: dict) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# decode-attention arm: the page-blockwise decode kernel vs the dense
+# full-pool baseline (docs/compute.md) — the CI smoke gates (i) token
+# streams bit-identical to generate() on a LONG pool serving short
+# requests and (ii) measured short-resident decode step time <= the
+# dense-full-width softmax it replaced
+# ---------------------------------------------------------------------------
+
+DECODE_ATTN_POOL = 2048     # pool width (positions) — the "capacity"
+DECODE_ATTN_RESIDENT = 12   # resident length — the "occupancy"
+
+
+def bench_decode_attention(max_len: int = DECODE_ATTN_POOL,
+                           n_slots: int = 4,
+                           resident: int = DECODE_ATTN_RESIDENT,
+                           steps: int = 30) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_pytorch_tpu import models
+    from distributed_pytorch_tpu.models.generate import (decode_step_slots,
+                                                         make_generate_fn)
+    from distributed_pytorch_tpu.ops.decode_attention import (
+        DECODE_BLOCK, resident_blocks)
+    from distributed_pytorch_tpu.serve import (EngineConfig,
+                                               InferenceEngine,
+                                               SamplingParams)
+    from distributed_pytorch_tpu.utils.profiler import fetch_fence
+
+    model = models.TransformerLM(vocab=128, dim=64, n_layers=2,
+                                 n_heads=4, n_kv_heads=2, pos="rope",
+                                 max_seq=max_len)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+
+    # (i) token contract on the long pool: engine streams == generate()
+    progress("decode-attn arm: token contract (long pool, short "
+             "requests)")
+    prompts = [rng.integers(0, 128, (s,)).astype(np.int32)
+               for s in (5, resident, 7, 9)]
+    sp = SamplingParams(max_new_tokens=6)
+    keys = [jax.random.PRNGKey(40 + i) for i in range(len(prompts))]
+    eng = InferenceEngine(model, params,
+                          EngineConfig(n_slots=n_slots, max_len=max_len))
+    with eng:
+        outs = [eng.submit(p, sp, rng=k).result(timeout=300)
+                for p, k in zip(prompts, keys)]
+    decode_compiles = eng.pool.compiles.decode
+    tokens_equal = True
+    for p, k, out in zip(prompts, keys, outs):
+        fn = make_generate_fn(model, sp.max_new_tokens, max_len=max_len)
+        ref = np.asarray(jax.jit(fn)(params, jnp.asarray(p[None]), k))[0]
+        tokens_equal = tokens_equal and bool(np.array_equal(out, ref))
+
+    # (ii) decode step time at short resident length, blockwise vs the
+    # dense full-pool softmax — same jitted step, same donation, only
+    # the kernel differs
+    def make_step(blockwise):
+        def f(p, ks, vs, lengths, tokens):
+            lo, ks, vs = decode_step_slots(model, p, ks, vs, lengths,
+                                           tokens, blockwise=blockwise)
+            return lo, ks, vs
+        return jax.jit(f, donate_argnums=(1, 2))
+
+    dh = model.dim // model.n_heads
+    lengths = jnp.asarray(
+        rng.integers(1, resident, (n_slots,)).astype(np.int32))
+    tokens = jnp.asarray(rng.integers(0, 128, (n_slots,)), jnp.int32)
+
+    def one_run(step_fn):
+        ks = [jnp.asarray(rng.standard_normal((n_slots, 2, max_len, dh)),
+                          jnp.float32) for _ in range(model.n_layers)]
+        vs = [jnp.asarray(rng.standard_normal((n_slots, 2, max_len, dh)),
+                          jnp.float32) for _ in range(model.n_layers)]
+        lo, ks, vs = step_fn(params, ks, vs, lengths, tokens)  # compile
+        fetch_fence(lo)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            lo, ks, vs = step_fn(params, ks, vs, lengths, tokens)
+        fetch_fence(lo)
+        return steps / (time.perf_counter() - t0)   # steps/s
+
+    rows = {}
+    for name, blockwise in (("blockwise", True), ("dense", False)):
+        progress(f"decode-attn arm: timing {name} decode "
+                 f"(pool {max_len}, resident <= {resident})")
+        fn = make_step(blockwise)
+        # steps/s through the perfbench policy (trials, warmup discard,
+        # spread gate) — the ms medians below are its reciprocal view
+        rows[name] = _stats.measure(lambda fn=fn: one_run(fn))
+    blk_ms = 1e3 / rows["blockwise"].median
+    dense_ms = 1e3 / rows["dense"].median
+    visited = int(resident_blocks(lengths, DECODE_BLOCK,
+                                  -(-max_len // DECODE_BLOCK)))
+    return {"pool_len": max_len,
+            "resident_len_max": int(np.asarray(lengths).max()),
+            "block_len": DECODE_BLOCK,
+            "blocks_total": -(-max_len // DECODE_BLOCK),
+            "blocks_visited": visited,
+            "tokens_equal_generate": tokens_equal,
+            "decode_compiles": decode_compiles,
+            "blockwise_step_ms": round(blk_ms, 3),
+            "dense_step_ms": round(dense_ms, 3),
+            "speedup_x": round(dense_ms / blk_ms, 2) if blk_ms else None,
+            "blockwise_trusted": rows["blockwise"].trusted,
+            "dense_trusted": rows["dense"].trusted,
+            "runs_blockwise_ms": [round(1e3 / r, 3)
+                                  for r in rows["blockwise"].runs],
+            "runs_dense_ms": [round(1e3 / r, 3)
+                              for r in rows["dense"].runs]}
+
+
+# ---------------------------------------------------------------------------
 
 
 def _stage_main(stage: str) -> int:
@@ -992,6 +1109,9 @@ def _stage_main(stage: str) -> int:
         from benchmarks.mfu_transformer import MEDIUM
         from benchmarks.mfu_transformer import run as mfu_run
         print(json.dumps(mfu_run(steps=20, **MEDIUM)))
+    elif stage == "mfu_host":
+        from benchmarks.mfu_transformer import run_host_flagship
+        print(json.dumps(run_host_flagship()))
     elif stage == "min_ddp":
         print(json.dumps(bench_min_ddp()))
     elif stage == "dp8_comm":
@@ -1003,10 +1123,88 @@ def _stage_main(stage: str) -> int:
     elif stage == "decode":
         from benchmarks.decode_tpu import run_gqa_compare
         print(json.dumps(run_gqa_compare()))
+    elif stage == "decode_attn":
+        print(json.dumps(bench_decode_attention()))
     else:
         print(json.dumps({"error": f"unknown stage {stage!r}"}))
         return 2
     return 0
+
+
+def _adopt_fresh_mfu(rec: dict, mfu_rec: dict, stage: str) -> bool:
+    """Fold a fresh mfu-stage result into the headline record (value,
+    provenance, trust from the per-run spread gate when trials exist,
+    roofline + plausibility BEFORE the raw row lands) and append the
+    raw row. Returns True when a measured mfu was adopted."""
+    # `is not None`, not `in`: the mfu stage emits "mfu": null when
+    # peak FLOPS for the device kind are unknown — that must fall
+    # through to the carry-forward path, never become a "measured"
+    # null headline (the r03-r05 failure mode)
+    ok = mfu_rec.get("mfu") is not None
+    if ok:
+        runs = mfu_rec.get("mfu_runs") or []
+        st = _stats.summarize(runs, warmup=0) if len(runs) > 1 else None
+        rec["value"] = mfu_rec["mfu"]
+        rec["provenance"] = "measured"
+        rec["trusted"] = bool(st.trusted) if st is not None else True
+        if rec["trusted"]:
+            rec.pop("untrusted_reason", None)
+        else:
+            rec["untrusted_reason"] = st.untrusted_reason
+        rec["device"] = mfu_rec.get("device", rec.get("device"))
+        rec["tokens_per_sec"] = mfu_rec["tokens_per_sec"]
+        rec["mfu_detail"] = mfu_rec
+        rec["metrics"][HEADLINE_METRIC] = _record.make_metric(
+            mfu_rec["mfu"], "mfu_fraction", stats=st)
+        # plausibility verdict BEFORE the raw row lands: bench_mfu
+        # rows are future last_good sources, so a roofline-poisoned
+        # value must reach the store as ok=False, not as evidence
+        attach_roofline(rec)
+    append_result(stage, mfu_rec,
+                  ok=ok and rec.get("trusted", False))
+    return ok and rec.get("provenance") == "measured"
+
+
+def _adopt_last_good(rec: dict) -> bool:
+    """Fill an unmeasured headline from the newest last_good flagship
+    row (explicit provenance, traceable source), or mark the record
+    untrusted with the reason when none exists. The ONE carry-forward
+    shape — main() and headline() both use it, so the two entry points
+    can never drift into writing differently-shaped records into the
+    same trajectory store."""
+    lg = last_good_record()
+    if lg:
+        rec["value"] = lg["mfu"]
+        rec["provenance"] = "last_good"
+        rec["last_good"] = lg
+        rec["trusted"] = True
+        rec.pop("untrusted_reason", None)
+        rec["metrics"][HEADLINE_METRIC] = _record.make_metric(
+            lg["mfu"], "mfu_fraction", provenance="last_good",
+            last_good=lg)
+        return True
+    rec["untrusted_reason"] = (
+        "unmeasured and no last_good flagship row on file: "
+        + rec.get("error", rec.get("tpu_backend", "?")))
+    return False
+
+
+def _host_flagship_fallback(rec: dict) -> bool:
+    """No healthy TPU: measure the pinned HOST flagship arm
+    (benchmarks/mfu_transformer.FLAGSHIP_CPU — the composed bf16-mp +
+    remat + donation recipe against the CALIBRATED host peak) so the
+    headline moves off the carry-forward with a fresh, gated, honestly
+    labeled measurement (device + peak_source travel in mfu_detail).
+
+    JAX_PLATFORMS=cpu explicitly: the runner strips the axon relay env
+    only for cpu children, and THE scenario this fallback exists for is
+    a wedged relay — an un-pinned child would block dialing it at
+    interpreter startup and burn the whole stage timeout."""
+    host_rec = run_json_subprocess(
+        [sys.executable, os.path.abspath(__file__), "--stage",
+         "mfu_host"], 2400, label="stage mfu_host",
+        env={"JAX_PLATFORMS": "cpu"})
+    return _adopt_fresh_mfu(rec, host_rec, "bench_mfu_host")
 
 
 def main():
@@ -1017,27 +1215,8 @@ def main():
 
     if info:
         mfu_rec = _run_stage("mfu", timeout_s=1800)
-        # `is not None`, not `in`: the mfu stage emits "mfu": null when
-        # peak FLOPS for the device kind are unknown — that must fall
-        # through to the carry-forward path, never become a "measured"
-        # null headline (the r03-r05 failure mode)
-        if mfu_rec.get("mfu") is not None:
-            rec["value"] = mfu_rec["mfu"]
-            rec["provenance"] = "measured"
-            rec["trusted"] = True
-            rec.pop("untrusted_reason", None)
-            rec["tokens_per_sec"] = mfu_rec["tokens_per_sec"]
-            rec["mfu_detail"] = mfu_rec
-            rec["metrics"][HEADLINE_METRIC] = _record.make_metric(
-                mfu_rec["mfu"], "mfu_fraction")
-            # plausibility verdict BEFORE the raw row lands: bench_mfu
-            # rows are future last_good sources, so a roofline-poisoned
-            # value must reach the store as ok=False, not as evidence
-            attach_roofline(rec)
-        append_result("bench_mfu", mfu_rec,
-                      ok=mfu_rec.get("mfu") is not None
-                      and rec.get("trusted", False))
-        if mfu_rec.get("mfu") is None:
+        adopted = _adopt_fresh_mfu(rec, mfu_rec, "bench_mfu")
+        if not adopted:
             rec["error"] = ("mfu stage: "
                             + str(mfu_rec.get("error")
                                   or ("returned null mfu (device kind "
@@ -1057,7 +1236,13 @@ def main():
         rec["decode"] = _run_stage("decode", timeout_s=2400)
         append_result("bench_decode", rec["decode"])
     else:
-        rec["error"] = "no healthy TPU backend after retries"
+        # no TPU: the pinned host flagship arm is still a REAL gated
+        # measurement (calibrated peak, spread-gated trials) — only
+        # when IT also fails does the carry-forward path below engage
+        rec["tpu_backend"] = "no healthy TPU backend after retries"
+        if not _host_flagship_fallback(rec):
+            rec["error"] = rec["tpu_backend"] \
+                + "; host flagship arm also failed"
 
     if "value" not in rec:
         # last_good carry-forward — covers BOTH failure modes: backend
@@ -1065,20 +1250,7 @@ def main():
         # (the round-3 killer). Nothing was measured NOW, so the record
         # says so in provenance — but it always carries a value a reader
         # can trace to its raw on-chip row, never a null.
-        lg = last_good_record()
-        if lg:
-            rec["value"] = lg["mfu"]
-            rec["provenance"] = "last_good"
-            rec["last_good"] = lg
-            rec["trusted"] = True
-            rec.pop("untrusted_reason", None)
-            rec["metrics"][HEADLINE_METRIC] = _record.make_metric(
-                lg["mfu"], "mfu_fraction", provenance="last_good",
-                last_good=lg)
-        else:
-            rec["untrusted_reason"] = (
-                "unmeasured and no last_good flagship row on file: "
-                + rec.get("error", "?"))
+        _adopt_last_good(rec)
 
     rec["dp8"] = bench_dp8()
     rec["metrics"].update(_dp8_metric_blobs(rec["dp8"]))
@@ -1186,6 +1358,49 @@ def main():
                       and rec.get("trusted", False) and not issues)
 
     print(json.dumps(rec))
+
+
+def headline() -> int:
+    """``--headline``: measure and land ONLY the flagship headline.
+
+    TPU mfu stage when the backend is healthy, else the pinned host
+    flagship arm (``mfu_host``) — fresh gated measurement, roofline +
+    plausibility attached, schema-validated, appended to the store.
+    The dp8*/torch companion arms are NOT re-run: they are environment-
+    sensitive (core count, neighbors) and re-measuring them on a
+    changed container would manufacture spurious benchdiff verdicts —
+    ``vs_baseline`` is withheld with exactly that reason, per the
+    gate's never-silently-blank policy."""
+    rec = _record.make_record(HEADLINE_METRIC, "mfu_fraction")
+    info = wait_for_backend()
+    rec["device"] = info.get("kind") or "none"
+    if info:
+        adopted = _adopt_fresh_mfu(rec, _run_stage("mfu", timeout_s=1800),
+                                   "bench_mfu")
+    else:
+        rec["tpu_backend"] = "no healthy TPU backend after retries"
+        adopted = _host_flagship_fallback(rec)
+    if not adopted and "value" not in rec:
+        if not _adopt_last_good(rec):
+            rec["error"] = (rec.get("tpu_backend", "")
+                            + "; flagship unmeasured and no last_good "
+                              "row on file")
+    if "roofline_flagship" not in rec:
+        attach_roofline(rec)
+    rec["vs_baseline_withheld"] = (
+        "headline mode measures the flagship arm only — baselines and "
+        "companion arms deliberately not re-run")
+    issues = _record.validate_record(rec, strict=False)
+    if issues:
+        rec["schema_issues"] = issues
+        print(f"# WARNING: record failed schema self-validation: "
+              f"{'; '.join(issues[:3])}", file=sys.stderr)
+    if _env.get("DPX_BENCH_SELFLOG"):
+        append_result("bench_record", rec,
+                      ok=rec.get("provenance") == "measured"
+                      and rec.get("trusted", False) and not issues)
+    print(json.dumps(rec))
+    return 0 if rec.get("provenance") == "measured" and not issues else 1
 
 
 # ---------------------------------------------------------------------------
@@ -1323,6 +1538,38 @@ def smoke() -> int:
                       **{k: hr[k] for k in ("vs_q8", "vs_q8_withheld")
                          if k in hr}}))
 
+    progress("perfbench smoke: decode-attention arm (page-blockwise vs "
+             "dense full pool)")
+    da = run_json_subprocess(
+        [sys.executable, os.path.abspath(__file__), "--stage",
+         "decode_attn"], 600, label="decode attn smoke",
+        env={"JAX_PLATFORMS": "cpu"})
+    gate("error" not in da, f"decode-attn arm failed: {da.get('error')}")
+    # (i) the kernel swap is invisible at the serving contract: token
+    # streams bit-identical to generate() on a 2048-wide pool serving
+    # ~12-token requests, with ONE decode compile
+    gate(da["tokens_equal_generate"] is True,
+         "long-pool engine streams diverged from generate()")
+    gate(da["decode_compiles"] == 1,
+         f"decode compiles {da['decode_compiles']} != 1")
+    # (ii) the claimed win is MEASURED: at short resident length the
+    # blockwise step must not be slower than the dense full-pool
+    # baseline it replaced (it should be much faster — the scan visits
+    # blocks_visited of blocks_total; the conservative gate is <=)
+    gate(da["blocks_visited"] < da["blocks_total"],
+         f"smoke config visits every block "
+         f"({da['blocks_visited']}/{da['blocks_total']}) — the "
+         "short-resident claim would be vacuous")
+    gate(da["blockwise_step_ms"] <= da["dense_step_ms"],
+         f"blockwise decode {da['blockwise_step_ms']}ms slower than "
+         f"dense full-pool baseline {da['dense_step_ms']}ms")
+    print(json.dumps({"smoke": "decode_attention", "ok": True,
+                      "blockwise_step_ms": da["blockwise_step_ms"],
+                      "dense_step_ms": da["dense_step_ms"],
+                      "speedup_x": da["speedup_x"],
+                      "blocks": f"{da['blocks_visited']}/"
+                                f"{da['blocks_total']}"}))
+
     progress("perfbench smoke: loopback dp8 (pinned, warmup-discarded)")
     dp8 = run_json_subprocess(
         [sys.executable, "-c", _dp8_code(n_steps=15)], 420,
@@ -1371,4 +1618,6 @@ if __name__ == "__main__":
         raise SystemExit(_stage_main(sys.argv[2]))
     if "--smoke" in sys.argv[1:]:
         raise SystemExit(smoke())
+    if "--headline" in sys.argv[1:]:
+        raise SystemExit(headline())
     main()

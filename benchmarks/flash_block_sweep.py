@@ -1,17 +1,25 @@
-"""On-chip block-size sweep for the flash attention kernel.
+"""Block-size sweep for the blockwise attention kernels — train AND
+decode shapes from one driver.
 
-The kernel's cost at moderate sequence lengths is dominated by grid-step
-count (per-step fixed overhead + per-tile mask/stat VPU work), not MXU
-time, so (block_q, block_k) is the first-order tuning knob. This sweeps
-tilings per sequence length, timed with the amortized scan-repeat method
-(see flash_attention_tpu._time_kernel) and prints the best per seq —
-those become the kernel's dispatch-table defaults.
+The flash kernel's cost at moderate sequence lengths is dominated by
+grid-step count (per-step fixed overhead + per-tile mask/stat VPU
+work), not MXU time, so (block_q, block_k) is the first-order tuning
+knob. This sweeps tilings per sequence length, timed with the amortized
+scan-repeat method (see flash_attention_tpu._time_kernel) and prints
+the best per seq — those become the kernel's dispatch-table defaults.
 
-Usage: python benchmarks/flash_block_sweep.py [--fwdbwd]
+``--decode`` sweeps the DECODE page-scan instead
+(ops/decode_attention.py): block length vs resident length over a long
+slot pool, so the same table that picks the training tiles also picks
+the serving page/block size (the decode kernel is shared by
+serve/cache.py, serve/pages/ and both engines — docs/compute.md).
+
+Usage: python benchmarks/flash_block_sweep.py [--fwdbwd | --decode]
 """
 
 import itertools
 import json
+import math
 import os
 import sys
 
@@ -22,9 +30,70 @@ import jax.numpy as jnp
 
 from benchmarks.flash_attention_tpu import _qkv, _time_kernel
 from distributed_pytorch_tpu.ops import flash_attention
+from distributed_pytorch_tpu.ops.decode_attention import (
+    blockwise_decode_attention, dense_decode_attention)
+
+
+def sweep_decode(pool_len: int = 4096, n_slots: int = 8, h: int = 8,
+                 h_kv: int = 4, d: int = 64) -> dict:
+    """Decode page-scan point: ms/step per (block_len, resident_len)
+    over a (n_slots, Hkv, pool_len, Dh) pool, plus the dense full-pool
+    baseline per resident length. The right block length balances
+    per-block loop overhead against wasted tail width — exactly the
+    grid-step-vs-tile tradeoff of the training sweep, at decode shapes.
+    """
+    dtype = jnp.bfloat16
+    scale = 1.0 / math.sqrt(d)
+    key = jax.random.PRNGKey(3)
+    q, k, v = _qkv(key, n_slots, h, 1, pool_len, d, dtype)
+    k = k[:, :h_kv]
+    v = v[:, :h_kv]
+    table = {}
+    for resident in (64, 512, pool_len):
+        lengths = jnp.full((n_slots,), resident - 1, jnp.int32)
+        rows = []
+        for blk in (64, 128, 256, 512):
+
+            def fn(q, k, v, _b=blk):
+                return jnp.sum(blockwise_decode_attention(
+                    q, k, v, lengths, scale=scale,
+                    block_len=_b).astype(jnp.float32))
+
+            try:
+                t = _time_kernel(fn, q, k, v)
+            except Exception as e:  # noqa: BLE001
+                print(f"# decode res={resident} blk={blk}: "
+                      f"{type(e).__name__}", file=sys.stderr, flush=True)
+                continue
+            rows.append({"block_len": blk, "ms": round(t * 1e3, 3)})
+            print(f"# decode res={resident} blk={blk}: {t*1e3:.3f}ms",
+                  file=sys.stderr, flush=True)
+
+        def dense_fn(q, k, v):
+            mask = jnp.arange(pool_len)[None, :] <= lengths[:, None]
+            return jnp.sum(dense_decode_attention(
+                q, k, v, mask, scale=scale).astype(jnp.float32))
+
+        try:
+            t = _time_kernel(dense_fn, q, k, v)
+            dense_ms = round(t * 1e3, 3)
+        except Exception as e:  # noqa: BLE001
+            dense_ms = f"{type(e).__name__}"
+        rows.sort(key=lambda r: r["ms"])
+        table[resident] = {"dense_full_pool_ms": dense_ms, "arms": rows}
+        print(f"# decode res={resident} best: "
+              f"{json.dumps(rows[0]) if rows else 'ALL FAILED'} "
+              f"(dense {dense_ms}ms)", flush=True)
+    return {"mode": "decode", "pool_len": pool_len, "n_slots": n_slots,
+            "best": {r: t["arms"][0] for r, t in table.items()
+                     if t["arms"]},
+            "all": table}
 
 
 def main(argv):
+    if "--decode" in argv:
+        print(json.dumps(sweep_decode()))
+        return 0
     grad_mode = "--fwdbwd" in argv
     b, h, d = 4, 8, 64
     dtype = jnp.bfloat16

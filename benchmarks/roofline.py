@@ -65,6 +65,19 @@ _ACT_UNITS_PER_LAYER = 12.0
 _ACT_UNITS_PER_LAYER_REMAT = 2.0
 
 
+def remat_enabled(remat) -> bool:
+    """Normalize a remat flag OR named policy to the binary question
+    the activation-traffic model asks: are per-layer activations
+    rematerialized? The ONE rule — ``hbm_bytes_per_step`` and
+    ``analyze`` both use it, so a config carrying the new policy
+    strings (``none|full|dots_saveable``, models/transformer.py) can
+    never read as remat-enabled through one entry point and disabled
+    through the other. ``dots_saveable`` stores strictly less than
+    "none"; the two-unit block-boundary estimate is the conservative
+    lower bound for both remat policies."""
+    return remat not in (False, None, "none")
+
+
 def count_params(cfg) -> int:
     d, L, V = cfg["dim"], cfg["n_layers"], cfg["vocab"]
     per_layer = 12 * d * d  # qkv 3d^2 + proj d^2 + mlp 8d^2 (r=4)
@@ -85,7 +98,8 @@ def hbm_bytes_per_step(cfg, *, fused_ce: Optional[bool] = None,
     FLAGSHIP identity carries them), same contract as :func:`analyze`.
     """
     fused_ce = cfg.get("fused_ce", False) if fused_ce is None else fused_ce
-    remat = cfg.get("remat", False) if remat is None else remat
+    remat = remat_enabled(cfg.get("remat", False) if remat is None
+                          else remat)
     master_f32 = (cfg.get("master_f32", False) if master_f32 is None
                   else master_f32)
     P = count_params(cfg)
@@ -117,21 +131,32 @@ def hbm_bytes_per_step(cfg, *, fused_ce: Optional[bool] = None,
 
 
 def analyze(cfg, *, device_kind: str = "TPU v5 lite",
-            fused_ce: Optional[bool] = None, remat: Optional[bool] = None,
-            master_f32: Optional[bool] = None) -> dict:
+            fused_ce: Optional[bool] = None, remat=None,
+            master_f32: Optional[bool] = None,
+            peak_flops: Optional[float] = None,
+            mem_bytes_per_s: Optional[float] = None) -> dict:
     # arm flags default from the config dict itself (FLAGSHIP carries
     # its arm flags as part of the flagship identity) so a flagship
     # promotion propagates here without touching call sites
     fused_ce = cfg.get("fused_ce", False) if fused_ce is None else fused_ce
-    remat = cfg.get("remat", False) if remat is None else remat
+    # named remat policies normalize through the shared rule
+    remat = remat_enabled(cfg.get("remat", False) if remat is None
+                          else remat)
     master_f32 = (cfg.get("master_f32", False) if master_f32 is None
                   else master_f32)
-    if device_kind not in PEAK_BF16 or device_kind not in HBM_GBPS:
-        raise ValueError(
-            f"unsupported device_kind {device_kind!r}: roofline specs "
-            f"exist for {sorted(PEAK_BF16)}")
-    peak = PEAK_BF16[device_kind]
-    bw = HBM_GBPS[device_kind]
+    if peak_flops is not None and mem_bytes_per_s is not None:
+        # CALIBRATED specs (benchmarks/mfu_transformer.calibrate_host):
+        # hosts without a spec-sheet row anchor their ceilings to their
+        # own measured matmul/memcpy peaks — same math, honest inputs
+        peak, bw = peak_flops, mem_bytes_per_s
+    else:
+        if device_kind not in PEAK_BF16 or device_kind not in HBM_GBPS:
+            raise ValueError(
+                f"unsupported device_kind {device_kind!r}: roofline "
+                f"specs exist for {sorted(PEAK_BF16)} (or pass measured "
+                f"peak_flops + mem_bytes_per_s overrides)")
+        peak = PEAK_BF16[device_kind]
+        bw = HBM_GBPS[device_kind]
     tok = cfg["batch"] * cfg["seq"]
     flops = 3 * model_flops_per_token(
         cfg["dim"], cfg["n_layers"], cfg["vocab"], cfg["seq"]) * tok

@@ -24,6 +24,9 @@ Also answers the round-3 question "why doesn't batch 16-64 beat batch
 to scale sublinearly.
 
 Usage: python benchmarks/step_breakdown.py [--batch N] [--seq N] [--steps N]
+       python benchmarks/step_breakdown.py --compute   (remat x mp ladder:
+           step time + compiled activation-memory per policy, docs/compute.md)
+       python benchmarks/step_breakdown.py --comm      (grad-reduce arms)
 Prints one JSON line; appends nothing (bench.py/run_all_tpu own the log).
 """
 
@@ -203,6 +206,82 @@ def run(dim=FLAGSHIP["dim"], n_layers=FLAGSHIP["n_layers"],
             "mfu_full": round(fl / rows["full"] / peak, 4) if peak else None}
 
 
+def run_compute(dim=FLAGSHIP["dim"], n_layers=FLAGSHIP["n_layers"],
+                n_heads=FLAGSHIP["n_heads"], vocab=FLAGSHIP["vocab"],
+                seq=FLAGSHIP["seq"], batch=FLAGSHIP["batch"], steps=20,
+                dtype=jnp.float32) -> dict:
+    """The compute-path ladder (docs/compute.md): remat policies x
+    mixed precision, each a REAL compiled train step measured with the
+    amortized fetch-fenced method plus XLA's compiled memory analysis —
+    the activation-memory/step-time tradeoff as data, not prose.
+
+    Arms: remat none/full/dots_saveable at mp=off, plus the composed
+    recipe (dots_saveable + bf16 mixed precision). Per arm: step_ms,
+    temp (activation high-water) bytes, argument bytes. The model is
+    f32-NATIVE on purpose — the mp arm measures the master-weights
+    recipe (f32 master, bf16 compute cast) against the f32 baseline;
+    the bf16-native flagship is mfu_transformer's own measurement.
+    Run with ``--compute``."""
+    from distributed_pytorch_tpu import models, optim
+    from distributed_pytorch_tpu.models.transformer import REMAT_POLICIES
+    from distributed_pytorch_tpu.ops import make_flash_attn_fn
+    from distributed_pytorch_tpu.ops.losses import cross_entropy
+    from distributed_pytorch_tpu.parallel import make_train_step
+    from distributed_pytorch_tpu.utils.profiler import compiled_memory
+
+    import bench
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1),
+                                0, vocab, dtype=jnp.int32)
+    opt = optim.adamw(3e-4)
+    arms = [(pol, "off") for pol in REMAT_POLICIES] \
+        + [("dots_saveable", "bf16")]
+    rows = {}
+    for pol, mp in arms:
+        label = f"remat={pol},mp={mp}"
+
+        def arm_thunk(pol=pol, mp=mp):
+            model = models.TransformerLM(
+                vocab=vocab, dim=dim, n_layers=n_layers, n_heads=n_heads,
+                max_seq=seq, attn_fn=make_flash_attn_fn(), remat=pol,
+                dtype=dtype)
+            params = model.init(jax.random.PRNGKey(0))
+
+            def loss_fn(p, toks):
+                logits = model.apply(p, toks[:, :-1]).astype(jnp.float32)
+                return cross_entropy(logits, toks[:, 1:]), {}
+
+            step = make_train_step(loss_fn, opt, donate=False,
+                                   mixed_precision=mp)
+            st = opt.init(params)
+            t = _time_step(step, params, st, tokens, steps)
+            mem = compiled_memory(
+                lambda p, o, b: step(p, o, b), params, st, tokens)
+            return {"step_ms": round(t * 1e3, 3),
+                    "temp_bytes": mem.get("temp_size_bytes"),
+                    "argument_bytes": mem.get("argument_size_bytes")}
+
+        rows[label] = bench.arm(f"compute arm: {label}", arm_thunk)
+    base = rows.get("remat=none,mp=off", {})
+    dev = jax.devices()[0]
+    return {"device": dev.device_kind,
+            "config": {"dim": dim, "n_layers": n_layers, "vocab": vocab,
+                       "seq": seq, "batch": batch,
+                       "dtype": str(jnp.dtype(dtype).name)},
+            "steps_timed": steps,
+            "arms": rows,
+            # the tradeoff, joined: bytes saved vs ms paid per policy
+            "vs_none": {k: {"step_ms_delta": round(
+                                v["step_ms"] - base.get("step_ms", 0), 3),
+                            "temp_bytes_saved":
+                                (base.get("temp_bytes") - v["temp_bytes"])
+                                if (base.get("temp_bytes") is not None
+                                    and v.get("temp_bytes") is not None)
+                                else None}
+                        for k, v in rows.items()
+                        if k != "remat=none,mp=off" and "step_ms" in v}}
+
+
 def run_comm(world=8, hidden=1024, in_dim=256, batch_per_rank=8,
              steps=30) -> dict:
     """Gradient-reduce comm breakdown on the virtual CPU mesh: the same
@@ -295,6 +374,12 @@ def run_comm(world=8, hidden=1024, in_dim=256, batch_per_rank=8,
 def main(argv):
     if "--comm" in argv:
         print(json.dumps(run_comm(steps=_flag(argv, "--steps", 30))))
+        return 0
+    if "--compute" in argv:
+        print(json.dumps(run_compute(
+            batch=_flag(argv, "--batch", FLAGSHIP["batch"]),
+            seq=_flag(argv, "--seq", FLAGSHIP["seq"]),
+            steps=_flag(argv, "--steps", 20))))
         return 0
     rec = run(batch=_flag(argv, "--batch", FLAGSHIP["batch"]),
               seq=_flag(argv, "--seq", FLAGSHIP["seq"]),

@@ -36,6 +36,9 @@ import jax
 import jax.numpy as jnp
 
 from ..nn.attention import dense_attention
+from ..ops.decode_attention import (blockwise_decode_attention,
+                                    dense_decode_attention,
+                                    paged_decode_attention)
 from .transformer import TransformerLM
 
 Params = Dict[str, Any]
@@ -123,10 +126,20 @@ def _pad_to(x, w: int):
 
 def decode_step(model: TransformerLM, params: Params, cache: KVCache,
                 token,
-                window: Optional[int] = None) -> Tuple[jnp.ndarray,
-                                                       KVCache]:
+                window: Optional[int] = None,
+                blockwise: bool = True) -> Tuple[jnp.ndarray,
+                                                 KVCache]:
     """One cached decode step. token: (B,) int32 at position
     ``cache.length``. Returns (logits (B, vocab), advanced cache).
+
+    Attention over the cache runs page-blockwise by default
+    (:func:`..ops.decode_attention.blockwise_decode_attention`): the
+    online-softmax block merge visits only the blocks that hold
+    resident positions, so the per-token cost scales with
+    ``cache.length``, not the preallocated ``max_len``.
+    ``blockwise=False`` keeps the dense full-width softmax — the
+    reference implementation the blockwise kernel is tested against,
+    and the baseline the decode-attention bench arm times.
 
     With ``window`` the cache is the rolling W-slot buffer from
     :func:`prefill`: the new position writes slot ``idx % W``
@@ -134,7 +147,8 @@ def decode_step(model: TransformerLM, params: Params, cache: KVCache,
     mask reconstructs each slot's global position from the slot index —
     slot j holds ``idx - ((idx - j) mod W)``, valid iff >= 0. Exact
     sliding-window semantics in O(window) memory, independent of how
-    long generation runs."""
+    long generation runs. (The rolling buffer's width IS the window —
+    every slot is potentially resident, so it keeps the dense path.)"""
     idx = cache.length
     x = model.tok.apply(params["tok"], token[:, None])         # (B,1,D)
     if getattr(model, "pos", None) is not None:
@@ -162,18 +176,14 @@ def decode_step(model: TransformerLM, params: Params, cache: KVCache,
             cache.v[i], hv.astype(cache.v[i].dtype), (0, 0, write_at, 0))
         new_k.append(k)
         new_v.append(v)
-        # grouped einsum: hq (B,H,1,Dh) vs cache (B,Hkv,max,Dh) — under
-        # GQA the H/Hkv query heads of a group read the same cache head
-        bq, hh, _, dd = hq.shape
-        hkv = k.shape[1]
-        hq_g = hq.reshape(bq, hkv, hh // hkv, 1, dd)
-        logits = jnp.einsum("bngqd,bnkd->bngqk", hq_g, k).astype(
-            jnp.float32) * scale                            # (B,Hkv,g,1,max)
-        logits = jnp.where(pos_mask[None, None, None, None, :], logits,
-                           -jnp.inf)
-        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-        o = jnp.einsum("bngqk,bnkd->bngqd", probs, v) \
-            .reshape(bq, hh, 1, dd)
+        if blockwise and window is None:
+            # scalar position broadcast to a length-1 batch axis: the
+            # (1, L) validity mask broadcasts over the B rows
+            o = blockwise_decode_attention(hq, k, v, idx[None],
+                                           scale=scale)
+        else:
+            o = dense_decode_attention(hq, k, v, pos_mask[None, :],
+                                       scale=scale)
         x = x + blk.attn.project_out(p["attn"], o)
         x = x + blk.mlp(p, x)
 
@@ -241,7 +251,8 @@ def prefill_partial(model: TransformerLM, params: Params, tokens,
 
 def decode_step_slots(model: TransformerLM, params: Params, ks, vs,
                       lengths, tokens,
-                      window: Optional[int] = None
+                      window: Optional[int] = None,
+                      blockwise: bool = True
                       ) -> Tuple[jnp.ndarray, list, list]:
     """One decode step over a SLOT POOL: per-row cache lengths.
 
@@ -251,6 +262,13 @@ def decode_step_slots(model: TransformerLM, params: Params, ks, vs,
     row writes/masks at its own position (the write is a where-mask
     select, value-identical to ``dynamic_update_slice``). ks/vs:
     per-layer (B, Hkv, max_len, Dh); tokens (B,) int32.
+
+    Attention is page-blockwise by default (see :func:`decode_step`):
+    the cost per step scales with ``max(lengths)``, not the pool's
+    ``max_len`` — a pool sized for long requests no longer taxes every
+    short resident request for its full width. ``blockwise=False``
+    keeps the dense full-width softmax (reference + bench baseline;
+    the sliding-window rolling layout always uses it).
 
     Per-row math is exactly :func:`decode_step`'s; XLA's fusion choices
     are batch-shape-dependent, so across DIFFERENT batch shapes logits
@@ -286,16 +304,10 @@ def decode_step_slots(model: TransformerLM, params: Params, ks, vs,
         v = jnp.where(write_mask, hv.astype(vs[i].dtype), vs[i])
         new_k.append(k)
         new_v.append(v)
-        bq, hh, _, dd = hq.shape
-        hkv = k.shape[1]
-        hq_g = hq.reshape(bq, hkv, hh // hkv, 1, dd)
-        logits = jnp.einsum("bngqd,bnkd->bngqk", hq_g, k).astype(
-            jnp.float32) * scale                        # (B,Hkv,g,1,max)
-        logits = jnp.where(pos_mask[:, None, None, None, :], logits,
-                           -jnp.inf)
-        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-        o = jnp.einsum("bngqk,bnkd->bngqd", probs, v) \
-            .reshape(bq, hh, 1, dd)
+        if blockwise and window is None:
+            o = blockwise_decode_attention(hq, k, v, idx, scale=scale)
+        else:
+            o = dense_decode_attention(hq, k, v, pos_mask, scale=scale)
         x = x + blk.attn.project_out(p["attn"], o)
         x = x + blk.mlp(p, x)
 
@@ -316,7 +328,8 @@ def _gather_pages(pool, tables):
 
 def decode_step_slots_paged(model: TransformerLM, params: Params,
                             k_pages, v_pages, tables, lengths, tokens,
-                            active, *, page_len: int
+                            active, *, page_len: int,
+                            blockwise: bool = True
                             ) -> Tuple[jnp.ndarray, list, list]:
     """One decode step over a PAGED slot pool (``serve/pages/``).
 
@@ -337,6 +350,15 @@ def decode_step_slots_paged(model: TransformerLM, params: Params,
     mask exposes ``<= lengths[b]``. ``tables``/``lengths``/``tokens``/
     ``active`` are all traced — ONE compiled program serves every
     request mix and every page-table state.
+
+    Attention runs page-blockwise by default
+    (:func:`..ops.decode_attention.paged_decode_attention`): the page
+    gather moved INSIDE the online-softmax block loop, whose traced
+    trip count is the resident page count — per-token cost scales with
+    ``max(lengths)``, not ``tables.shape[1] * page_len``, and dead
+    pages past every slot's length are never even gathered.
+    ``blockwise=False`` keeps the dense full-table gather + softmax
+    (the reference the contract tests pin the kernel against).
 
     Returns ``(logits (B, vocab), new_k_pages, new_v_pages)``; host-side
     page allocation (growing a table at page boundaries) and length
@@ -370,24 +392,23 @@ def decode_step_slots_paged(model: TransformerLM, params: Params,
             hv[:, :, 0, :].astype(v_pages[i].dtype), mode="drop")
         new_kp.append(kp)
         new_vp.append(vp)
-        # logical rows: gather the updated pool, then re-select the new
-        # key at the write position — identity for active rows (already
-        # scattered), and gives inactive rows decode_step_slots' exact
-        # value semantics (their discarded logits still see "their" key)
-        k = jnp.where(write_mask, hk.astype(kp.dtype),
-                      _gather_pages(kp, tables))
-        v = jnp.where(write_mask, hv.astype(vp.dtype),
-                      _gather_pages(vp, tables))
-        bq, hh, _, dd = hq.shape
-        hkv = k.shape[1]
-        hq_g = hq.reshape(bq, hkv, hh // hkv, 1, dd)
-        logits = jnp.einsum("bngqd,bnkd->bngqk", hq_g, k).astype(
-            jnp.float32) * scale                        # (B,Hkv,g,1,W)
-        logits = jnp.where(pos_mask[:, None, None, None, :], logits,
-                           -jnp.inf)
-        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-        o = jnp.einsum("bngqk,bnkd->bngqd", probs, v) \
-            .reshape(bq, hh, 1, dd)
+        if blockwise:
+            # the page gather lives inside the block loop; hk/hv are
+            # re-selected at the write position per block — identity
+            # for active rows (already scattered), and gives inactive
+            # rows decode_step_slots' exact value semantics (their
+            # discarded logits still see "their" key)
+            o = paged_decode_attention(hq, kp, vp, tables, idx,
+                                       hk, hv, scale=scale,
+                                       page_len=page_len)
+        else:
+            # logical rows: gather the updated pool, then re-select the
+            # new key at the write position
+            k = jnp.where(write_mask, hk.astype(kp.dtype),
+                          _gather_pages(kp, tables))
+            v = jnp.where(write_mask, hv.astype(vp.dtype),
+                          _gather_pages(vp, tables))
+            o = dense_decode_attention(hq, k, v, pos_mask, scale=scale)
         x = x + blk.attn.project_out(p["attn"], o)
         x = x + blk.mlp(p, x)
 

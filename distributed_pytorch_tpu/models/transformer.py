@@ -11,13 +11,62 @@ shape.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable, Optional, Union
 
 import jax
 import jax.numpy as jnp
 
 from ..nn.attention import TransformerBlock
 from ..nn.core import Embedding, LayerNorm, Linear, Module, Params
+
+#: Named per-layer rematerialization policies (docs/compute.md).
+#: ``none``  — save every activation (fastest step, most HBM);
+#: ``full``  — ``jax.checkpoint`` the whole block: save only the block
+#:             boundary, recompute the block in backward (~1/3 more
+#:             forward FLOPs for O(n_layers) less activation HBM);
+#: ``dots_saveable`` — ``jax.checkpoint_policies.dots_saveable``: save
+#:             matmul outputs, recompute only the cheap elementwise
+#:             chain (LN/GELU/softmax) — most of ``full``'s memory win
+#:             at a fraction of its recompute.
+REMAT_POLICIES = ("none", "full", "dots_saveable")
+
+
+def resolve_remat(remat: Union[bool, str, None]) -> str:
+    """Canonical policy name for a ``remat=`` argument: bools keep
+    their historical meaning (False -> ``none``, True -> ``full``),
+    ``None`` defers to the typed ``DPX_REMAT`` env knob, strings must
+    name a member of :data:`REMAT_POLICIES`."""
+    if remat is None:
+        from ..runtime import env as _env
+        remat = _env.get("DPX_REMAT")
+    if remat is False:
+        return "none"
+    if remat is True:
+        return "full"
+    if remat not in REMAT_POLICIES:
+        raise ValueError(
+            f"remat must be a bool or one of {'|'.join(REMAT_POLICIES)}, "
+            f"got {remat!r}")
+    return remat
+
+
+def apply_remat_policy(fn: Callable, policy: str) -> Callable:
+    """Wrap a per-layer forward with the named checkpoint policy — the
+    ONE place a policy name becomes a ``jax.checkpoint`` call, shared
+    by :class:`TransformerLM` and any custom trainer that wants the
+    same vocabulary. Unknown names raise (a typo'd policy silently
+    becoming a different memory/recompute tradeoff is exactly what the
+    typed vocabulary exists to stop); callers with bools/None resolve
+    through :func:`resolve_remat` first."""
+    if policy not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat policy {policy!r}; choose from "
+            f"{'|'.join(REMAT_POLICIES)}")
+    if policy == "none":
+        return fn
+    if policy == "full":
+        return jax.checkpoint(fn)
+    return jax.checkpoint(fn, policy=jax.checkpoint_policies.dots_saveable)
 
 
 class TransformerLM(Module):
@@ -30,7 +79,8 @@ class TransformerLM(Module):
                  pos: str = "learned", rope_base: float = 10000.0,
                  tie_embeddings: bool = False,
                  attn_fn: Optional[Callable] = None,
-                 remat: bool = False, dtype=jnp.float32):
+                 remat: Union[bool, str, None] = False,
+                 dtype=jnp.float32):
         if pos not in ("learned", "rope", "none"):
             raise ValueError(f"pos must be learned|rope|none, got {pos!r}")
         self.vocab = vocab
@@ -41,7 +91,11 @@ class TransformerLM(Module):
         # decode KV cache by the group factor (nn/attention.py)
         self.n_kv_heads = n_kv_heads if n_kv_heads is not None else n_heads
         self.max_seq = max_seq
-        self.remat = remat
+        # named per-layer remat policy (REMAT_POLICIES); bools keep
+        # their historical meaning, None defers to DPX_REMAT.
+        # self.remat stays the truthy back-compat view of the policy.
+        self.remat_policy = resolve_remat(remat)
+        self.remat = self.remat_policy != "none"
         self.dtype = dtype
         # positional scheme: "learned" absolute table (the classic GPT-2
         # setup), "rope" rotary phases inside attention (no positional
@@ -126,12 +180,12 @@ class TransformerLM(Module):
                 return blk.apply(p, x, rng=r, train=train,
                                  positions=positions)
 
-            if self.remat:
-                # recompute the block in backward instead of saving its
-                # activations: trades ~1/3 more FLOPs for O(n_layers)
-                # less activation HBM, buying batch size (and MFU) on
-                # memory-bound configs
-                run_block = jax.checkpoint(run_block)
+            # per-layer remat policy: "full" recomputes the block in
+            # backward instead of saving its activations (~1/3 more
+            # FLOPs for O(n_layers) less activation HBM, buying batch
+            # size on memory-bound configs); "dots_saveable" keeps the
+            # matmul outputs and recomputes only the elementwise chain
+            run_block = apply_remat_policy(run_block, self.remat_policy)
             x = run_block(params["blocks"][i], x)
         x = self.ln_f.apply(params["ln_f"], x)
         if return_hidden:

@@ -29,7 +29,23 @@ def dense_attention(q, k, v, *, causal: bool = False,
     q: (B, H, S, Dh); k, v: (B, Hkv, S, Dh) where Hkv divides H —
     Hkv < H is grouped-query attention (each kv head serves H/Hkv query
     heads), computed via a grouped einsum so the kv tensors are never
-    repeated in memory. Softmax in float32 regardless of input dtype.
+    repeated in memory.
+
+    The **f32-stats contract** (docs/compute.md, guarded by
+    tests/test_compute_path.py): the softmax — max, exp, and the
+    normalizing SUM — runs in float32 regardless of input dtype; only
+    the resulting probabilities are cast back to ``v.dtype`` for the
+    p@v matmul. Under bf16 mixed precision this is what keeps the
+    normalizer from accumulating in 8 mantissa bits (at S=512 a pure
+    bf16 sum of uniform probabilities drifts by several percent). The
+    flash kernel and ``ops.decode_attention`` follow the same rule.
+    A fully-masked ROW (causal with s_q > s_k puts whole rows above
+    the diagonal) yields NaN here by definition of softmax over an
+    all--inf row; the flash kernel deliberately matches that, while
+    the blockwise decode path — where fully-masked BLOCKS are routine
+    for short rows — masks with a finite sentinel and exact-zero
+    probabilities so the merge never manufactures NaN.
+
     ``window`` (requires ``causal``): sliding-window attention — row i
     sees keys (i+off-window, i+off] only (off aligns cross-length
     diagonals). This is the single-device path;

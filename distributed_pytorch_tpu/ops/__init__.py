@@ -1,5 +1,7 @@
 """Ops: losses and TPU (Pallas) kernels with portable fallbacks."""
 from . import losses
+from .decode_attention import (blockwise_decode_attention,
+                               paged_decode_attention)
 from .flash_attention import (flash_attention, flash_attention_with_lse,
                               make_flash_attn_fn)
 from .losses import (cross_entropy, cross_entropy_per_example,

@@ -623,8 +623,20 @@ def flash_attention(q, k, v, *, causal: bool = False,
 # (grid too short to amortize kernel overhead); seq 1024 flash wins
 # 1.51x and the gap widens with seq (8.5x at 4096). Below this many
 # KEYS, the dense einsum is the faster O(S^2) and still cheap in
-# memory, so make_flash_attn_fn dispatches to it.
-FLASH_MIN_SEQ = 1024
+# memory, so make_flash_attn_fn dispatches to it. The threshold lives
+# in the typed env registry (DPX_FLASH_MIN_SEQ, default = the measured
+# crossover); this module attribute is its import-time read, kept for
+# the consumers that report it (benchmarks/mfu_transformer.py).
+# make_flash_attn_fn re-reads the registry at build time, so a test or
+# deployment that sets the variable after import still takes effect.
+from ..runtime import env as _env  # noqa: E402 — placed at its consumer
+
+FLASH_MIN_SEQ = int(_env.get("DPX_FLASH_MIN_SEQ"))
+
+#: Sentinel default for ``make_flash_attn_fn(min_seq_flash=...)``: "use
+#: the registry value at build time" (None/0 keep meaning "always run
+#: the kernel").
+_MIN_SEQ_ENV = object()
 
 # one-time flag for the dense-dispatch info log (list, so the closure in
 # make_flash_attn_fn can mutate it without a global statement)
@@ -635,19 +647,22 @@ def make_flash_attn_fn(block_q: Optional[int] = None,
                        block_k: Optional[int] = None,
                        interpret: Optional[bool] = None,
                        window: Optional[int] = None,
-                       min_seq_flash: Optional[int] = FLASH_MIN_SEQ):
+                       min_seq_flash=_MIN_SEQ_ENV):
     """An ``attn_fn`` for :class:`nn.attention.MultiHeadAttention` /
     model constructors: models built with this compute attention through
     the pallas kernel instead of the dense einsum path. ``window`` bakes
     sliding-window (local) attention into the model — O(S*window)
     compute and the long-context default for causal decoders.
 
-    Below ``min_seq_flash`` keys (default: the measured v5e crossover,
-    ``FLASH_MIN_SEQ``) the call dispatches to the dense einsum instead —
-    same function, faster at short seq — so enabling flash is safe at
-    every sequence length. Shapes are static under jit, so the dispatch
-    costs nothing at runtime. Pass ``min_seq_flash=None`` (or 0) to
-    always run the kernel (tests, kernel benchmarking)."""
+    Below ``min_seq_flash`` keys (default: the typed registry knob
+    ``DPX_FLASH_MIN_SEQ``, whose default is the measured v5e crossover)
+    the call dispatches to the dense einsum instead — same function,
+    faster at short seq — so enabling flash is safe at every sequence
+    length. Shapes are static under jit, so the dispatch costs nothing
+    at runtime. Pass ``min_seq_flash=None`` (or 0) to always run the
+    kernel (tests, kernel benchmarking)."""
+    if min_seq_flash is _MIN_SEQ_ENV:
+        min_seq_flash = int(_env.get("DPX_FLASH_MIN_SEQ"))
 
     def attn_fn(q, k, v, *, causal=False, scale=None):
         if min_seq_flash and k.shape[-2] < min_seq_flash:

@@ -5,7 +5,7 @@ from . import data_parallel, fsdp, moe, pipeline, sequence, spmd, tensor
 from .data_parallel import (DataParallel, make_eval_step,
                             make_scan_train_steps, make_stateful_eval_step,
                             make_stateful_train_step, make_train_step,
-                            prepare_ddp_model, stack_state)
+                            mp_cast_params, prepare_ddp_model, stack_state)
 from .fsdp import (fsdp_param_specs, make_fsdp_train_step,
                    make_zero1_train_step, make_zero2_train_step,
                    opt_state_specs, shard_layouts, shard_model_and_opt)

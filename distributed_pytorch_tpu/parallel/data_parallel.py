@@ -57,6 +57,48 @@ class StatefulStepOutput(NamedTuple):
 #: grad_reduce spellings accepted by :func:`make_train_step`.
 GRAD_REDUCE_MODES = ("mean", "int8", "quant", "q4", "adaptive")
 
+#: mixed_precision policies accepted by :func:`make_train_step`.
+MP_POLICIES = ("off", "bf16")
+
+
+def mp_cast_params(params):
+    """The bf16 compute copy of an f32 master tree: float32 leaves cast
+    to bfloat16, everything else (int tables, already-low-precision
+    leaves, quantized int8 weights) untouched. The ONE definition of
+    the mixed-precision working-copy cast — the train step and the
+    tests pin the same rule."""
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16)
+        if hasattr(p, "dtype") and p.dtype == jnp.float32 else p, params)
+
+
+def _wrap_mixed_precision(loss_fn: Callable, policy: str) -> Callable:
+    """``bf16``: the loss consumes the bf16 CAST of the f32 params.
+
+    This is the master-weights recipe (docs/compute.md, the same
+    error-feedback shape as PR 7's sharded gather leg and
+    ``optim.with_master_f32``): the authoritative copy stays float32 —
+    the optimizer only ever updates the master, so sub-``2^-8``
+    updates are never lost to bf16 rounding — while every matmul in
+    forward AND backward runs on bf16 operands (activations follow the
+    params' dtype through the first embedding/projection). The cast is
+    linear, so JAX returns the gradients in the MASTER's dtype (f32):
+    both comm front doors, the quantized wire, and the sharded ZeRO-1
+    update all see the exact f32 gradient tree they already speak.
+
+    Softmax and LayerNorm statistics stay f32 by the kernels' own
+    contract (``nn.attention.dense_attention``, the flash kernel,
+    ``ops.decode_attention``), which is what keeps bf16 compute from
+    degrading accumulation — guarded by tests, not by hope.
+    """
+    if policy == "off":
+        return loss_fn
+
+    def mp_loss(params, batch):
+        return loss_fn(mp_cast_params(params), batch)
+
+    return mp_loss
+
 
 def _leaf_offsets(leaves, block: int):
     """Start offset of each leaf inside the block-padded flat bucket."""
@@ -81,7 +123,8 @@ def make_train_step(loss_fn: Callable, optimizer: Optimizer,
                     weight_update: Optional[str] = None,
                     overlap: Optional[bool] = None,
                     comm_buckets: Optional[int] = None,
-                    on_bucket_ready: Optional[Callable] = None) -> Callable:
+                    on_bucket_ready: Optional[Callable] = None,
+                    mixed_precision: Optional[str] = None) -> Callable:
     """Compile a data-parallel training step.
 
     ``loss_fn(params, batch) -> (loss, metrics)`` where ``loss`` is the
@@ -123,6 +166,16 @@ def make_train_step(loss_fn: Callable, optimizer: Optimizer,
     compiled SPMD path ignores these (XLA already schedules the fused
     reduce against compute).
 
+    ``mixed_precision``: ``"off"`` (f32 throughout) or ``"bf16"``
+    (default from the typed ``DPX_MP_POLICY`` knob): run forward and
+    backward on the bf16 CAST of the params while the f32 tree the
+    step carries stays the authoritative master the optimizer updates
+    — the master-weights pattern (docs/compute.md). Orthogonal to
+    every other mode: the wrap happens before front-door dispatch, so
+    SPMD, host, sharded (ZeRO-1) and overlapped steps all honor it,
+    and the gradients crossing any wire remain f32 (quantization error
+    feedback composes unchanged).
+
     ``weight_update``: ``"replicated"`` (every rank runs the full
     optimizer step — DDP/torch semantics) or ``"sharded"`` (ZeRO-1,
     arXiv 2004.13336: reduce-scatter the grads, step only the owned
@@ -139,6 +192,14 @@ def make_train_step(loss_fn: Callable, optimizer: Optimizer,
         raise ValueError(
             f"grad_reduce must be one of {'|'.join(GRAD_REDUCE_MODES)}, "
             f"got {grad_reduce!r}")
+    if mixed_precision is None:
+        from ..runtime import env as _env
+        mixed_precision = _env.get("DPX_MP_POLICY")
+    if mixed_precision not in MP_POLICIES:
+        raise ValueError(
+            f"mixed_precision must be one of {'|'.join(MP_POLICIES)}, "
+            f"got {mixed_precision!r}")
+    loss_fn = _wrap_mixed_precision(loss_fn, mixed_precision)
     if weight_update is None:
         from ..runtime import env as _env
         weight_update = _env.get("DPX_WEIGHT_UPDATE")

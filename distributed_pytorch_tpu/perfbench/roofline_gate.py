@@ -44,10 +44,32 @@ def attach_flagship(rec: dict, *, announce: bool = True) -> dict:
     try:
         from benchmarks.mfu_transformer import FLAGSHIP
         from benchmarks.roofline import analyze, attach_measured
-        rl = attach_measured(
-            analyze(FLAGSHIP),
-            (rec.get("mfu_detail") or {}).get("step_ms_median"))
+        det = rec.get("mfu_detail") or {}
+        cal = det.get("calibration")
+        cfg_src = det.get("config") or {}
+        dims = ("dim", "n_layers", "n_heads", "vocab", "seq", "batch")
+        if cal and all(k in cfg_src for k in dims):
+            # calibrated-host record (no spec-sheet row for the device):
+            # analyze the config that actually ran against the MEASURED
+            # peaks it was normalized by — the ceilings and the MFU then
+            # share one denominator, so the plausibility gate stays
+            # meaningful off-TPU (docs/compute.md)
+            analysis = analyze(
+                {k: cfg_src[k] for k in dims},
+                device_kind=det.get("device", "host"),
+                fused_ce=bool(cfg_src.get("fused_ce")),
+                remat=cfg_src.get("remat"),
+                master_f32=bool(cfg_src.get("master_f32"))
+                or cfg_src.get("mp") == "bf16",
+                peak_flops=cal["peak_flops"],
+                mem_bytes_per_s=cal["mem_bytes_per_s"])
+            analysis["specs_source"] = "calibrated_host"
+        else:
+            analysis = analyze(FLAGSHIP)
+        rl = attach_measured(analysis, det.get("step_ms_median"))
         out = {k: rl[k] for k in ROOFLINE_KEYS if k in rl}
+        if "specs_source" in rl:
+            out["specs_source"] = rl["specs_source"]
         rec["roofline_flagship"] = out
     except Exception as e:  # noqa: BLE001 — attach must never block
         rec.setdefault("warnings", []).append(

@@ -272,6 +272,24 @@ register("DPX_COMM_BUCKETS", "int", 4,
          "(clamped to the leaf count; only read when the overlap path "
          "is active).")
 
+# -- compute path (docs/compute.md) -----------------------------------------
+register("DPX_FLASH_MIN_SEQ", "int", 1024,
+         "Key count below which the flash attn_fn dispatches to the "
+         "dense einsum instead of the pallas kernel (the measured v5e "
+         "crossover; ops/flash_attention.py — numerics identical "
+         "either way).")
+register("DPX_MP_POLICY", "str", "off",
+         "Default mixed-precision policy of `parallel.make_train_step`: "
+         "`off` (f32 throughout) or `bf16` (bf16 compute-params/"
+         "activations with the f32 master kept authoritative — "
+         "docs/compute.md).")
+register("DPX_REMAT", "str", "none",
+         "Default per-layer remat policy of `models.TransformerLM"
+         "(remat=None)`: `none` (save all activations), `full` "
+         "(recompute each block in backward), or `dots_saveable` "
+         "(save matmul outputs only, recompute elementwise — "
+         "jax.checkpoint_policies; docs/compute.md).")
+
 # -- observability ----------------------------------------------------------
 register("DPX_METRICS_LOG", "str", None,
          "Line-JSON file receiving structured events (worker failures, "

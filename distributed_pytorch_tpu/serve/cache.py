@@ -134,3 +134,14 @@ class SlotPool:
         logits, self.ks, self.vs, self.lengths = self._decode_fn(
             params, self.ks, self.vs, self.lengths, tokens, active)
         return logits
+
+    def release(self, slot: int) -> None:
+        """Zero a retired slot's length (the engine's every exit path
+        calls this, mirroring ``PagedSlotPool.release``). Correctness
+        never needed it — a freed slot's stale rows are unreachable
+        under the position mask — but the blockwise decode's trip count
+        is ``max(lengths)``: a frozen 2000-token length would keep every
+        co-resident short request paying for 2000 positions until the
+        slot was reused, exactly the O(capacity) tax the kernel
+        removes."""
+        self.lengths = self.lengths.at[slot].set(0)

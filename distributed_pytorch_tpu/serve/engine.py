@@ -464,12 +464,13 @@ class InferenceEngine:
 
     def _free_slot(self, req: Request) -> None:
         if req.slot is not None:
-            if self._paged:
-                # every exit path (retire, deadline, crash drain) runs
-                # through here, so page refcounts can never leak:
-                # private pages free immediately, indexed prompt pages
-                # stay resident for future prefix hits
-                self.pool.release(req.slot)
+            # every exit path (retire, deadline, crash drain) runs
+            # through here. Paged: page refcounts can never leak —
+            # private pages free immediately, indexed prompt pages stay
+            # resident for future prefix hits. Contiguous: the slot's
+            # length zeroes so the blockwise decode's max(lengths) trip
+            # count stops charging for a request that no longer exists.
+            self.pool.release(req.slot)
             self._running.pop(req.slot, None)
             self._free.append(req.slot)
             req.slot = None
