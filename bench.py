@@ -984,6 +984,134 @@ def _dp8_metric_blobs(dp8: dict) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# dp8_donate arm: whole-step buffer donation A/B on the pjit front door
+# (docs/front_door.md) — the same spec point built donate=ON (the
+# default: params + opt state donated, out == in shardings) and
+# donate=OFF, paired steps/s through the perfbench policy plus XLA's
+# OWN memory accounting (memory_analysis): the donated build must
+# alias its state buffers (alias bytes > 0) and its peak bytes must be
+# STRICTLY below the copy build's — the HBM the roofline says the
+# compute-bound flagship needs back. Compile counters assert one
+# program per arm (the front-door discipline, not trusted).
+# ---------------------------------------------------------------------------
+
+DONATE_HIDDEN = 2048
+DONATE_IN_DIM = 512
+
+
+def bench_dp8_donate(steps: int = 20) -> dict:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from distributed_pytorch_tpu.runtime.jax_compat import (
+        ensure_cpu_devices)
+    ensure_cpu_devices(8)
+    import numpy as np
+
+    import distributed_pytorch_tpu as dist
+    from distributed_pytorch_tpu import models, optim
+    from distributed_pytorch_tpu.ops.losses import cross_entropy
+    from distributed_pytorch_tpu.parallel import front_door, make_step
+
+    _stats.pin_process()
+    dist.init_process_group(rank=0, world_size=8)
+    model = models.DummyModel(in_dim=DONATE_IN_DIM,
+                              hidden_dim=DONATE_HIDDEN, n_classes=16)
+    params0 = model.init(jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(np.shape(l)))
+                   for l in jax.tree_util.tree_leaves(params0))
+    opt = optim.adamw(1e-3)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return cross_entropy(model.apply(p, x), y), {}
+
+    rng = np.random.default_rng(0)
+    x = dist.shard_batch(
+        rng.standard_normal((64, DONATE_IN_DIM)).astype(np.float32))
+    y = dist.shard_batch((np.arange(64) % 16).astype(np.int32))
+    batch = (x, y)
+
+    front_door.cache_clear()
+    arms = {}
+    for name, donate in (("donated", True), ("copy", False)):
+        step = make_step(loss_fn, opt, donate=donate)
+        p = model.init(jax.random.PRNGKey(0))
+        st = opt.init(p)
+        out = step(p, st, batch)          # compile + warm (counted)
+        jax.block_until_ready(out.loss)
+        # memory_analysis AFTER the counted first call: lower() shares
+        # the jit trace cache, so the other order would satisfy the
+        # first call from the uncounted analysis trace
+        arms[name] = {"step": step,
+                      "mem": step.memory_analysis(out.params,
+                                                  out.opt_state, batch)}
+        state = {"out": out}
+
+        def one_run(step=step, state=state):
+            o = state["out"]
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                o = step(o.params, o.opt_state, batch)
+            jax.block_until_ready(o.loss)
+            state["out"] = o
+            return steps / (time.perf_counter() - t0)
+
+        arms[name]["stats"] = _stats.measure(one_run)
+
+    don, cop = arms["donated"], arms["copy"]
+    rec = {
+        "donate_world": 8,
+        "model_params": n_params,
+        "global_batch": 64,
+        "donated_steps_per_sec": round(don["stats"].median, 2),
+        "copy_steps_per_sec": round(cop["stats"].median, 2),
+        "donate_runs": {
+            "donated": [round(r, 2) for r in don["stats"].runs],
+            "copy": [round(r, 2) for r in cop["stats"].runs]},
+        # XLA's compiled accounting, not a narrative: peak = args +
+        # outputs + temps - aliased; donation aliases params+opt state
+        "donated_peak_bytes": don["mem"]["peak_bytes"],
+        "copy_peak_bytes": cop["mem"]["peak_bytes"],
+        "donated_alias_bytes": don["mem"]["alias"],
+        "copy_alias_bytes": cop["mem"]["alias"],
+        "peak_saved_bytes": (cop["mem"]["peak_bytes"]
+                             - don["mem"]["peak_bytes"]),
+        "peak_saved_frac": round(
+            1 - don["mem"]["peak_bytes"]
+            / max(cop["mem"]["peak_bytes"], 1), 4),
+        # the front-door compile discipline, asserted by the smoke
+        "donated_compiles": don["step"].compiles,
+        "copy_compiles": cop["step"].compiles,
+        "timing_method": f"{steps}-step chained windows, fetch-fenced, "
+                         "perfbench trials",
+    }
+    dist.cleanup()
+    return rec
+
+
+def _dp8_donate_metric_blobs(rec: dict) -> dict:
+    """Gated metric blobs + the vs_copy gated_ratio for the dp8_donate
+    arm (the flagship claim is a RATIO, so both sides run through the
+    spread gate — never a bare division)."""
+    blobs = {}
+    runs = rec.get("donate_runs") or {}
+    stats = {}
+    for name, key in (("dp8_donate_steps_per_sec", "donated"),
+                      ("dp8_donate_copy_steps_per_sec", "copy")):
+        if runs.get(key):
+            stats[key] = _stats.summarize(runs[key], warmup=0)
+            blobs[name] = _record.make_metric(None, "steps_per_sec",
+                                              stats=stats[key])
+    if "donated" in stats and "copy" in stats:
+        ratio, why = _stats.gated_ratio(stats["donated"], stats["copy"])
+        if ratio is not None:
+            rec["vs_copy"] = round(ratio, 2)
+        else:
+            rec["vs_copy_withheld"] = why
+    return blobs
+
+
+# ---------------------------------------------------------------------------
 # decode-attention arm: the page-blockwise decode kernel vs the dense
 # full-pool baseline (docs/compute.md) — the CI smoke gates (i) token
 # streams bit-identical to generate() on a LONG pool serving short
@@ -1120,6 +1248,8 @@ def _stage_main(stage: str) -> int:
         print(json.dumps(bench_dp8_sharded()))
     elif stage == "dp8_hier":
         print(json.dumps(bench_dp8_hier()))
+    elif stage == "dp8_donate":
+        print(json.dumps(bench_dp8_donate()))
     elif stage == "decode":
         from benchmarks.decode_tpu import run_gqa_compare
         print(json.dumps(run_gqa_compare()))
@@ -1266,6 +1396,18 @@ def main():
     rec["metrics"].update(_dp8_sharded_metric_blobs(rec["dp8_sharded"]))
     append_result("bench_dp8_sharded", rec["dp8_sharded"],
                   ok="error" not in rec["dp8_sharded"])
+
+    # dp8_donate flagship arm (whole-step buffer donation on the pjit
+    # front door): paired donate-on/off steps/s as a gated ratio plus
+    # XLA memory_analysis peak bytes per arm — subprocess-isolated like
+    # every other stage
+    rec["dp8_donate"] = run_json_subprocess(
+        [sys.executable, os.path.abspath(__file__), "--stage",
+         "dp8_donate"], 600, label="dp8 donate bench",
+        env={"JAX_PLATFORMS": "cpu", "DPX_CPU_DEVICES": "8"})
+    rec["metrics"].update(_dp8_donate_metric_blobs(rec["dp8_donate"]))
+    append_result("bench_dp8_donate", rec["dp8_donate"],
+                  ok="error" not in rec["dp8_donate"])
 
     # dp8_hier_adaptive flagship arm (adaptive-width two-level ring +
     # measured comm-overlap exposure): paired vs the flat q8 ring as a
@@ -1475,6 +1617,42 @@ def smoke() -> int:
                       **{k: sh[k] for k in ("vs_replicated",
                                             "vs_replicated_withheld")
                          if k in sh}}))
+
+    progress("perfbench smoke: dp8_donate (whole-step buffer donation "
+             "A/B on the pjit front door)")
+    dn = run_json_subprocess(
+        [sys.executable, os.path.abspath(__file__), "--stage",
+         "dp8_donate"], 420, label="dp8 donate smoke",
+        env={"JAX_PLATFORMS": "cpu", "DPX_CPU_DEVICES": "8"})
+    gate("error" not in dn, f"dp8 donate arm failed: {dn.get('error')}")
+    # the donation claim is XLA's own accounting, ASSERTED: the donated
+    # build must alias its state buffers and its compiled peak bytes
+    # must be STRICTLY below the copy build's
+    gate(dn["donated_alias_bytes"] > 0,
+         "donated build aliased zero bytes — donation silently dropped")
+    gate(dn["copy_alias_bytes"] == 0,
+         f"copy build aliased {dn['copy_alias_bytes']} bytes — the A/B "
+         "arms are not a donation A/B")
+    gate(dn["donated_peak_bytes"] < dn["copy_peak_bytes"],
+         f"donated peak {dn['donated_peak_bytes']} not below copy peak "
+         f"{dn['copy_peak_bytes']}")
+    # one compiled program per arm (the front-door counter discipline)
+    gate(dn["donated_compiles"] == 1 and dn["copy_compiles"] == 1,
+         f"compile counters != 1: donated {dn['donated_compiles']}, "
+         f"copy {dn['copy_compiles']}")
+    blobs = _dp8_donate_metric_blobs(dn)
+    gate("dp8_donate_steps_per_sec" in blobs,
+         "donate arm produced no gated metric blob")
+    gate(("vs_copy" in dn) != ("vs_copy_withheld" in dn),
+         "dp8_donate must carry vs_copy XOR its withhold reason")
+    print(json.dumps({"smoke": "dp8_donate", "ok": True,
+                      "peak_bytes": {"donated": dn["donated_peak_bytes"],
+                                     "copy": dn["copy_peak_bytes"]},
+                      "peak_saved_frac": dn["peak_saved_frac"],
+                      "alias_bytes": dn["donated_alias_bytes"],
+                      **{k: dn[k] for k in ("vs_copy",
+                                            "vs_copy_withheld")
+                         if k in dn}}))
 
     progress("perfbench smoke: dp8_hier_adaptive (q4/adaptive two-level "
              "ring + overlap)")

@@ -29,6 +29,13 @@ carry attribution, threads are named. Each is now a machine-checked rule
 * **DPX005** — ``threading.Thread(...)`` without ``name=``. Every
   thread must carry a named owner: the ckpt phase trace, the watchdog,
   and crash dumps all attribute by thread name.
+* **DPX006** — ``jax.jit`` of a step/decode builder (innermost
+  enclosing function name contains ``step`` or ``decode``) inside the
+  package without ``donate_argnums``. The front-door invariant
+  (docs/front_door.md): train-step and decode hot loops donate their
+  state buffers — a copying build silently doubles peak memory every
+  step. Inline-waivable like the others (eval steps and grad-only
+  jits legitimately don't own their inputs).
 
 Suppression: append ``# dpxlint: disable=DPXnnn <reason>`` to the
 offending line (or the line above); ``# dpxlint: disable-file=DPXnnn
@@ -51,7 +58,11 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from .schedule import FRONT_DOOR_SURFACE, NATIVE_OPS
 
-RULES = ("DPX001", "DPX002", "DPX003", "DPX004", "DPX005")
+RULES = ("DPX001", "DPX002", "DPX003", "DPX004", "DPX005", "DPX006")
+
+#: DPX006: a jit call inside a function whose name matches this is a
+#: step/decode-builder site and must carry ``donate_argnums``.
+_STEP_BUILDER_RE = re.compile(r"step|decode", re.IGNORECASE)
 
 #: Call names counted as collectives for DPX001 (the static half shares
 #: its vocabulary with the schedule verifier).
@@ -187,6 +198,7 @@ class _FileChecker:
         self._check_blocking_calls(tree)       # DPX003
         self._check_typed_raises(tree)         # DPX004
         self._check_thread_names(tree)         # DPX005
+        self._check_jit_donation(tree)         # DPX006
         return self.findings
 
     # -- DPX001 ------------------------------------------------------------
@@ -358,6 +370,79 @@ class _FileChecker:
                     "threading.Thread without name= — every thread "
                     "carries a named owner (phase traces, watchdog, "
                     "crash dumps attribute by thread name)")
+
+
+    # -- DPX006 ------------------------------------------------------------
+
+    def _check_jit_donation(self, tree: ast.Module) -> None:
+        """``jit(...)`` without ``donate_argnums`` inside a step/decode
+        builder — in any spelling: a direct call, a ``@jax.jit``
+        decorator on a step/decode-named def, or ``partial(jax.jit,
+        ...)``. Attribution is to the INNERMOST enclosing function def:
+        helper closures named outside the step/decode vocabulary
+        (samplers, admit buckets) are not builder sites."""
+        if not self._in_package():
+            return
+
+        def is_jit_ref(node: ast.AST) -> bool:
+            return ((isinstance(node, ast.Name) and node.id == "jit")
+                    or (isinstance(node, ast.Attribute)
+                        and node.attr == "jit"))
+
+        def msg(owner: str, spelling: str) -> str:
+            return (f"{spelling} in step/decode builder {owner!r} "
+                    "without donate_argnums — the front door donates "
+                    "step buffers (docs/front_door.md); pass "
+                    "donate_argnums or waive with a reason")
+
+        def check_decorators(fn: ast.AST) -> None:
+            for dec in fn.decorator_list:
+                if is_jit_ref(dec):
+                    # bare @jax.jit can never donate
+                    self._emit("DPX006", dec, msg(fn.name, "@jit"))
+                elif (isinstance(dec, ast.Call)
+                        and _call_name(dec) == "jit"
+                        and not any(kw.arg == "donate_argnums"
+                                    for kw in dec.keywords)):
+                    self._emit("DPX006", dec, msg(fn.name, "@jit(...)"))
+
+        # decorator expressions are judged ONCE, by check_decorators
+        # (against the decorated def's own name) — never re-judged by
+        # the generic call walk against the enclosing owner
+        decorator_nodes = {
+            id(d)
+            for fn in ast.walk(tree)
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+            for dec in fn.decorator_list
+            for d in ast.walk(dec)}
+
+        def walk(node: ast.AST, owner: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    if _STEP_BUILDER_RE.search(child.name):
+                        check_decorators(child)
+                    walk(child, child.name)
+                    continue
+                if id(child) in decorator_nodes:
+                    continue
+                in_builder = (owner is not None
+                              and _STEP_BUILDER_RE.search(owner))
+                if (isinstance(child, ast.Call) and in_builder
+                        and _call_name(child) == "jit"
+                        and not any(kw.arg == "donate_argnums"
+                                    for kw in child.keywords)):
+                    self._emit("DPX006", child, msg(owner, "jax.jit"))
+                elif (isinstance(child, ast.Call) and in_builder
+                        and _call_name(child) == "partial"
+                        and child.args and is_jit_ref(child.args[0])
+                        and not any(kw.arg == "donate_argnums"
+                                    for kw in child.keywords)):
+                    self._emit("DPX006", child,
+                               msg(owner, "partial(jax.jit, ...)"))
+                walk(child, owner)
+
+        walk(tree, None)
 
 
 def _call_name(call: ast.Call) -> Optional[str]:

@@ -593,9 +593,23 @@ def make_generate_fn(model: TransformerLM, max_new: int, *,
                      top_p: Optional[float] = None,
                      max_len: Optional[int] = None,
                      allow_custom_attn: bool = False,
-                     pin_weight_stream: bool = False):
+                     pin_weight_stream: bool = False,
+                     param_shardings=None):
     """Build ``fn(params, prompt, rng) -> (B, max_new) tokens`` suitable
     for ``jax.jit`` (all shape-determining arguments are closed over).
+
+    ``param_shardings``: the producer's params out-shardings (a train
+    step's ``out_shardings["params"]`` — docs/front_door.md). When set,
+    the returned fn asserts the params it receives already carry them
+    (``parallel.front_door.verify_handoff``): the eval/prefill entry of
+    the reshard-free pjit-to-pjit chain — a mismatch raises a typed
+    ``HandoffMismatch`` instead of pjit silently copying the weights.
+    The check runs on CONCRETE params — i.e. on eager calls of the
+    returned fn (tracers carry no sharding on this jax). If you wrap
+    fn in ``jax.jit`` yourself, run ``verify_handoff(params,
+    param_shardings)`` once before the first call — that is exactly
+    what ``serve.EngineConfig(param_shardings=)`` does at engine
+    construction, the production admit path.
 
     ``pin_weight_stream``: ties the params consumed by each decode step
     to the loop-varying cache counter through an optimization barrier,
@@ -617,6 +631,11 @@ def make_generate_fn(model: TransformerLM, max_new: int, *,
     window = _model_window(model)
 
     def fn(params, prompt, rng):
+        if param_shardings is not None and not isinstance(
+                jax.tree_util.tree_leaves(params)[0], jax.core.Tracer):
+            from ..parallel.front_door import verify_handoff
+            verify_handoff(params, param_shardings,
+                           what="generate params")
         s = prompt.shape[1]
         limit = max_len or (s + max_new)
         if limit > model.max_seq:

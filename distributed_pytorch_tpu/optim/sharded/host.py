@@ -63,6 +63,7 @@ def make_host_sharded_train_step(loss_fn: Callable, optimizer: Optimizer,
     quant = grad_reduce in ("quant", "int8")
     ef = ErrorFeedback() if quant else None
 
+    # dpxlint: disable=DPX006 grads-only jit; params re-read every step
     vg = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
     holder = {}
 

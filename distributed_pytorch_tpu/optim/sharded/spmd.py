@@ -1,15 +1,13 @@
 """SPMD-front-door sharded train step: ZeRO-1 inside the compiled step.
 
-The same ``reduce-scatter -> local sharded step -> all-gather`` dataflow
-as :mod:`.host`, expressed in mesh collectives under ``shard_map``:
-``psum_scatter`` hands each device its 1/world chunk of the flat grad
-bucket, the wrapped optimizer updates the chunk's moments + master, and
-``all_gather`` rebuilds the replicated params — with
-``grad_reduce="quant"`` both legs ride the block-int8 wire
-(:func:`...comm.primitives.quantized_reduce_scatter` /
-:func:`...comm.primitives.quantized_all_gather`, the same
-``comm/wire.py`` block rule as the native ring, and the gather leg is
-bit-identical across devices by construction).
+Thin shim over the one mesh-addressed front door
+(:mod:`...parallel.front_door`, docs/front_door.md): the
+``reduce-scatter -> local step on the owned 1/world slice ->
+all-gather`` engine itself lives there (``weight_update="sharded"``),
+where it shares the builder cache, whole-step buffer donation with
+out == in shardings, and the trace-time compile counters with every
+other spec point. This module keeps the historical builder name and
+signature.
 
 The sharded optimizer state is GLOBAL flat vectors (moments, master)
 sharded ``P(axis)`` along the data axis — the exact spec tree
@@ -25,12 +23,10 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from .. import Optimizer
-from .layout import build_layout
-from .optimizer import shard_optimizer
 
 
 def make_spmd_sharded_train_step(loss_fn: Callable, optimizer: Optimizer,
-                                 donate: bool = True,
+                                 donate: Optional[bool] = None,
                                  grad_reduce: str = "mean",
                                  pad_multiple: Optional[int] = None
                                  ) -> Callable:
@@ -42,99 +38,8 @@ def make_spmd_sharded_train_step(loss_fn: Callable, optimizer: Optimizer,
     :class:`.optimizer.ShardedOptState` from the returned step's
     ``init_opt_state(params)``. ``step.state_specs(opt_state)`` exports
     the PartitionSpec tree for checkpointing."""
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
+    from ...parallel.front_door import make_step
 
-    from ...comm import primitives as prim
-    from ...runtime import context
-    from ...runtime.context import DATA_AXIS
-    from ...runtime.jax_compat import shard_map
-
-    world = context.get_world_size()
-    quant = grad_reduce in ("quant", "int8")
-    holder = {}
-
-    def _ensure(params):
-        if "layout" not in holder:
-            holder["layout"] = build_layout(params, world,
-                                            pad_multiple=pad_multiple)
-            holder["sharded"] = shard_optimizer(optimizer,
-                                                holder["layout"])
-        return holder["layout"], holder["sharded"]
-
-    def init_opt_state(params):
-        layout, sharded = _ensure(params)
-        state = sharded.init_global(params)
-        if world > 1:
-            from ...parallel.tensor import shard_params
-            state = shard_params(state, state_specs(state),
-                                 context.get_mesh())
-        return state
-
-    def state_specs(opt_state, axis: str = DATA_AXIS):
-        layout = holder.get("layout")
-        if layout is None:
-            raise RuntimeError(
-                "state_specs needs the layout — call init_opt_state "
-                "(or run one step) first")
-        return layout.state_specs(opt_state, axis=axis)
-
-    def _local_step(layout, sharded, params, state, batch):
-        (loss, metrics), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params, batch)
-        flat_g = layout.flatten_jnp(grads)
-        if world > 1:
-            if quant:
-                g_slice = prim.quantized_reduce_scatter(
-                    flat_g, DATA_AXIS) / world
-            else:
-                g_slice = prim.reduce_scatter(flat_g, DATA_AXIS) / world
-        else:
-            g_slice = flat_g
-        new_master, new_state = sharded.update_flat(g_slice, state)
-        if world > 1:
-            if quant:
-                flat_new = prim.quantized_all_gather(new_master,
-                                                     DATA_AXIS)
-            else:
-                flat_new = prim.all_gather(new_master, DATA_AXIS,
-                                           axis=0, tiled=True)
-        else:
-            flat_new = new_master
-        new_params = layout.unflatten_jnp(flat_new)
-        return new_params, new_state, loss[None], metrics
-
-    def _build(params, opt_state):
-        layout, sharded = _ensure(params)
-        if world == 1:
-            def local(params, state, batch):
-                from ...parallel.data_parallel import StepOutput
-                return StepOutput(*_local_step(layout, sharded, params,
-                                               state, batch))
-            return jax.jit(local,
-                           donate_argnums=(0, 1) if donate else ())
-
-        mesh = context.get_mesh()
-        specs = state_specs(opt_state)
-        island = lambda p, s, b: _local_step(layout, sharded, p, s, b)
-        sharded_fn = shard_map(
-            island, mesh=mesh,
-            in_specs=(P(), specs, P(DATA_AXIS)),
-            out_specs=(P(), specs, P(DATA_AXIS), P(DATA_AXIS)),
-            check_vma=False)
-
-        def stepper(params, state, batch):
-            from ...parallel.data_parallel import StepOutput
-            return StepOutput(*sharded_fn(params, state, batch))
-        return jax.jit(stepper, donate_argnums=(0, 1) if donate else ())
-
-    def step(params, opt_state, batch):
-        if "compiled" not in holder:
-            holder["compiled"] = _build(params, opt_state)
-        return holder["compiled"](params, opt_state, batch)
-
-    step.init_opt_state = init_opt_state
-    step.state_specs = state_specs
-    step.holder = holder
-    return step
+    return make_step(loss_fn, optimizer, weight_update="sharded",
+                     wire=grad_reduce, donate=donate,
+                     pad_multiple=pad_multiple)

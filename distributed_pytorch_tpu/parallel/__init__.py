@@ -1,11 +1,17 @@
 """Parallelism engines: data (DDP), tensor, sequence (ring attention),
 pipeline (GPipe + 1F1B over pp), expert (Switch MoE over ep), and the composed
-GSPMD mesh trainer."""
-from . import data_parallel, fsdp, moe, pipeline, sequence, spmd, tensor
+GSPMD mesh trainer — all built over ONE mesh-addressed pjit front door
+(:mod:`.front_door`: spec-driven dp/fsdp/tp/ZeRO-1, whole-step buffer
+donation, reshard-free pjit-to-pjit handoff; docs/front_door.md)."""
+from . import (data_parallel, front_door, fsdp, moe, pipeline, sequence,
+               spmd, tensor)
 from .data_parallel import (DataParallel, make_eval_step,
                             make_scan_train_steps, make_stateful_eval_step,
                             make_stateful_train_step, make_train_step,
                             mp_cast_params, prepare_ddp_model, stack_state)
+from .front_door import (FROM_INPUTS, FrontDoorStep, HandoffMismatch,
+                         StepSpecs, handoff_shardings, make_step,
+                         verify_handoff)
 from .fsdp import (fsdp_param_specs, make_fsdp_train_step,
                    make_zero1_train_step, make_zero2_train_step,
                    opt_state_specs, shard_layouts, shard_model_and_opt)

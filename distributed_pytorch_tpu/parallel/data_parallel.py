@@ -25,12 +25,11 @@ the "stacked" layout the eager collectives consume (``comm/collectives.py``).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..comm import primitives as prim
 from ..optim import Optimizer
@@ -100,15 +99,6 @@ def _wrap_mixed_precision(loss_fn: Callable, policy: str) -> Callable:
     return mp_loss
 
 
-def _leaf_offsets(leaves, block: int):
-    """Start offset of each leaf inside the block-padded flat bucket."""
-    offs, off = [], 0
-    for g in leaves:
-        offs.append(off)
-        off += g.size + ((-g.size) % block)
-    return offs
-
-
 def _wire_format(grad_reduce: str) -> str:
     """Map a grad_reduce spelling onto the front doors' wire-format
     vocabulary (comm/host_backend.WIRE_FORMATS)."""
@@ -118,7 +108,7 @@ def _wire_format(grad_reduce: str) -> str:
 
 
 def make_train_step(loss_fn: Callable, optimizer: Optimizer,
-                    donate: bool = True,
+                    donate: Optional[bool] = None,
                     grad_reduce: str = "mean",
                     weight_update: Optional[str] = None,
                     overlap: Optional[bool] = None,
@@ -126,6 +116,13 @@ def make_train_step(loss_fn: Callable, optimizer: Optimizer,
                     on_bucket_ready: Optional[Callable] = None,
                     mixed_precision: Optional[str] = None) -> Callable:
     """Compile a data-parallel training step.
+
+    Thin shim over the one mesh-addressed front door
+    (:func:`.front_door.make_step` — docs/front_door.md): this builder
+    keeps the historical DDP-facing signature; the engine, the builder
+    cache, whole-step buffer donation (``donate=None`` reads the typed
+    ``DPX_DONATE`` knob, default on) with out == in shardings, and the
+    compile-counter discipline all live there.
 
     ``loss_fn(params, batch) -> (loss, metrics)`` where ``loss`` is the
     *local-batch mean* scalar and ``metrics`` a pytree of per-example arrays
@@ -188,150 +185,12 @@ def make_train_step(loss_fn: Callable, optimizer: Optimizer,
     wire only (its gather leg's error feedback owns the exact master
     copy); combine q4/adaptive with ``weight_update="replicated"``.
     """
-    if grad_reduce not in GRAD_REDUCE_MODES:
-        raise ValueError(
-            f"grad_reduce must be one of {'|'.join(GRAD_REDUCE_MODES)}, "
-            f"got {grad_reduce!r}")
-    if mixed_precision is None:
-        from ..runtime import env as _env
-        mixed_precision = _env.get("DPX_MP_POLICY")
-    if mixed_precision not in MP_POLICIES:
-        raise ValueError(
-            f"mixed_precision must be one of {'|'.join(MP_POLICIES)}, "
-            f"got {mixed_precision!r}")
-    loss_fn = _wrap_mixed_precision(loss_fn, mixed_precision)
-    if weight_update is None:
-        from ..runtime import env as _env
-        weight_update = _env.get("DPX_WEIGHT_UPDATE")
-    if weight_update not in ("replicated", "sharded"):
-        raise ValueError(f"weight_update must be replicated|sharded, "
-                         f"got {weight_update!r}")
-    if weight_update == "sharded":
-        if grad_reduce in ("q4", "adaptive"):
-            raise ValueError(
-                "weight_update='sharded' supports grad_reduce mean|"
-                "quant|int8 only (the sharded gather leg pins the q8 "
-                "grid its exact-master error feedback assumes); use "
-                "weight_update='replicated' with q4/adaptive")
-        from ..optim.sharded import make_sharded_train_step
-        return make_sharded_train_step(loss_fn, optimizer, donate=donate,
-                                       grad_reduce=grad_reduce)
-    world = context.get_world_size()
-    if context.get_host_comm() is not None:
-        return _make_host_train_step(loss_fn, optimizer,
-                                     grad_reduce=grad_reduce,
-                                     overlap=overlap,
-                                     comm_buckets=comm_buckets,
-                                     on_bucket_ready=on_bucket_ready)
-
-    def _reduce_grads(grads, bits=8, want_flat=False):
-        if grad_reduce == "mean":
-            return prim.pmean(grads, DATA_AXIS), None
-        # ONE compressed collective pair for the whole tree: flatten
-        # every leaf into a single f32 bucket, reduce, unflatten —
-        # dozens of per-leaf all-to-alls would pay per-collective
-        # latency on exactly the meshes this targets. Each leaf is
-        # zero-padded to a QUANT_BLOCK multiple so no quantization-scale
-        # block ever spans two leaves — a tiny layernorm grad sharing a
-        # block with an embedding grad's tail would quantize to zero
-        # under the big leaf's scale. (The per-leaf padding is also why
-        # this is hand-rolled rather than jax.flatten_util.ravel_pytree.)
-        bs = prim.QUANT_BLOCK
-        leaves, treedef = jax.tree_util.tree_flatten(grads)
-        padded = []
-        for g in leaves:
-            f = jnp.ravel(g).astype(jnp.float32)
-            pad = (-f.shape[0]) % bs
-            padded.append(jnp.pad(f, (0, pad)) if pad else f)
-        red = prim.quantized_pmean(jnp.concatenate(padded), DATA_AXIS,
-                                   bits=bits)
-        out, off = [], 0
-        for g in leaves:
-            out.append(red[off:off + g.size].reshape(g.shape)
-                       .astype(g.dtype))
-            off += g.size + ((-g.size) % bs)
-        # the chooser statistic runs on the UNPADDED concatenation —
-        # the per-leaf pad zeros above would deflate their blocks' rms
-        # and read as dynamic range, pinning the adaptive width at q8
-        # for any model with many small leaves; dropping them also
-        # matches the host front door's chooser input (raw ravel
-        # concat), so both front doors walk the same policy
-        flat = jnp.concatenate(
-            [red[o:o + g.size] for o, g in
-             zip(_leaf_offsets(leaves, bs), leaves)]) \
-            if want_flat else None
-        return jax.tree_util.tree_unflatten(treedef, out), flat
-
-    adaptive = grad_reduce == "adaptive" and world > 1
-    fixed_bits = 8
-    if grad_reduce in ("quant", "int8", "q4") and world > 1:
-        from ..comm import host_backend as _hb
-        resolved = _hb.resolve_wire_width(_wire_format(grad_reduce))
-        if resolved == "adaptive":      # DPX_WIRE_WIDTH=adaptive
-            adaptive = True
-        else:
-            fixed_bits = resolved
-
-    def make_local_step(bits, want_stat):
-        def local_step(params, opt_state, batch):
-            (loss, metrics), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, batch)
-            stat = jnp.float32(0.0)
-            if world > 1:
-                grads, red = _reduce_grads(grads, bits,
-                                           want_flat=want_stat)
-                if want_stat and red is not None:
-                    from ..comm.wire import DYNRANGE_THRESH
-                    from ..ops.quant import block_outlier_frac_jnp
-                    stat = block_outlier_frac_jnp(
-                        red, prim.QUANT_BLOCK, DYNRANGE_THRESH)
-            params, opt_state = optimizer.update(grads, opt_state, params)
-            return params, opt_state, loss[None], metrics, stat
-        return local_step
-
-    if world == 1:
-        inner = make_local_step(8, False)
-
-        def step(params, opt_state, batch):
-            return StepOutput(*inner(params, opt_state, batch)[:4])
-        return jax.jit(step, donate_argnums=(0, 1) if donate else ())
-
-    mesh = context.get_mesh()
-
-    def compile_width(bits, want_stat):
-        sharded = shard_map(
-            make_local_step(bits, want_stat), mesh=mesh,
-            in_specs=(P(), P(), P(DATA_AXIS)),
-            out_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P()),
-            check_vma=False,
-        )
-        return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
-
-    if not adaptive:
-        prog = compile_width(fixed_bits, False)
-
-        def step(params, opt_state, batch):
-            return StepOutput(*prog(params, opt_state, batch)[:4])
-        return step
-
-    # adaptive: one compiled program per width (the chooser's hysteresis
-    # bounds the flapping, so at most two programs ever exist); the
-    # dynamic-range statistic is computed INSIDE the step on the reduced
-    # bucket — bit-identical across devices — and only that scalar
-    # crosses to the host, where the chooser (shared policy with the
-    # host front door) picks the next step's program.
-    from ..comm.wire import WidthChooser
-    chooser = WidthChooser()
-    progs = {8: compile_width(8, True), 4: compile_width(4, True)}
-
-    def step(params, opt_state, batch):
-        p, o, loss, metrics, stat = progs[chooser.width](
-            params, opt_state, batch)
-        chooser.observe_frac(float(stat))
-        return StepOutput(p, o, loss, metrics)
-
-    step.width_chooser = chooser
-    return step
+    from .front_door import make_step
+    return make_step(loss_fn, optimizer, wire=grad_reduce,
+                     weight_update=weight_update,
+                     mixed_precision=mixed_precision,
+                     overlap=overlap, comm_buckets=comm_buckets,
+                     on_bucket_ready=on_bucket_ready, donate=donate)
 
 
 def _partition_contiguous(sizes, k: int):
@@ -429,7 +288,9 @@ def _make_host_train_step(loss_fn: Callable, optimizer: Optimizer,
     if not overlap:
         n_buckets = 1
 
+    # dpxlint: disable=DPX006 grads-only jit; params re-read every step
     vg = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+    # dpxlint: disable=DPX006 host door interleaves update with ring comm on the same buffers
     upd = jax.jit(optimizer.update)
     efs = {}  # bucket index -> ErrorFeedback (sizes differ per bucket)
 
@@ -625,6 +486,7 @@ def make_eval_step(eval_fn: Callable) -> Callable:
     degradation."""
     world = context.get_world_size()
     if world == 1:
+        # dpxlint: disable=DPX006 eval does not own the params (the trainer still does)
         return jax.jit(eval_fn)
     mesh = context.get_mesh()
     sharded = shard_map(
@@ -633,6 +495,7 @@ def make_eval_step(eval_fn: Callable) -> Callable:
         out_specs=P(DATA_AXIS),
         check_vma=False,
     )
+    # dpxlint: disable=DPX006 eval does not own the params (the trainer still does)
     return jax.jit(sharded)
 
 
@@ -643,6 +506,7 @@ def make_stateful_eval_step(eval_fn: Callable) -> Callable:
     eval mode uses running stats without updating them."""
     world = context.get_world_size()
     if world == 1:
+        # dpxlint: disable=DPX006 eval does not own the params (the trainer still does)
         return jax.jit(eval_fn)
     mesh = context.get_mesh()
     sharded = shard_map(
@@ -651,6 +515,7 @@ def make_stateful_eval_step(eval_fn: Callable) -> Callable:
         out_specs=P(DATA_AXIS),
         check_vma=False,
     )
+    # dpxlint: disable=DPX006 eval does not own the params (the trainer still does)
     return jax.jit(sharded)
 
 

@@ -157,7 +157,7 @@ def make_fsdp_train_step(loss_fn: Callable, optimizer: Optimizer,
                          mesh: Mesh, param_specs,
                          state_specs: Optional[Any] = None,
                          grad_specs: Optional[Any] = None,
-                         donate: bool = True) -> Callable:
+                         donate: Optional[bool] = None) -> Callable:
     """Compile ``step(params, opt_state, batch) -> SpmdStepOutput`` with
     the ZeRO layout pinned by sharding constraints.
 
@@ -189,33 +189,27 @@ def make_fsdp_train_step(loss_fn: Callable, optimizer: Optimizer,
     and each device keeps only its 1/N grad shard, replicated grads
     (ZeRO-1, the torch ZeroRedundancyOptimizer shape) all-reduce and
     keep whole gradients on every device. :func:`make_zero1_train_step`
-    and :func:`make_zero2_train_step` wrap the two non-default rungs."""
-    state_specs = param_specs if state_specs is None else state_specs
-    grad_specs = state_specs if grad_specs is None else grad_specs
+    and :func:`make_zero2_train_step` wrap the two non-default rungs.
 
-    def constrain(tree, specs):
-        return jax.tree_util.tree_map(
-            lambda x, s: jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, s)),
-            tree, specs, is_leaf=lambda x: x is None)
-
-    def step(params, opt_state, batch):
-        o_specs = opt_state_specs(opt_state, state_specs, params=params)
-        (loss, metrics), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params, batch)
-        grads = constrain(grads, grad_specs)   # reduce-scatter/all-reduce
-        params, opt_state = optimizer.update(grads, opt_state, params)
-        params = constrain(params, param_specs)
-        opt_state = constrain(opt_state, o_specs)
-        return SpmdStepOutput(params, opt_state, loss, metrics)
-
-    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    Thin shim over the front door (:func:`.front_door.make_step` with
+    ``specs=StepSpecs(params, opt, grads)`` — docs/front_door.md): the
+    ladder semantics are unchanged, and the step additionally carries
+    the front-door contract — params AND opt state donated with
+    ``out_shardings`` pinned equal to ``in_shardings`` (``DPX_DONATE``),
+    trace-time compile counters, and ``step.out_shardings`` for the
+    reshard-free handoff to eval/serve."""
+    from .front_door import StepSpecs, make_step
+    return make_step(loss_fn, optimizer, mesh=mesh,
+                     specs=StepSpecs(params=param_specs, opt=state_specs,
+                                     grads=grad_specs),
+                     donate=donate)
 
 
 def make_zero1_train_step(loss_fn: Callable, optimizer: Optimizer,
                           mesh: Mesh, params, *, axis: str = "dp",
                           min_size: int = 1024,
-                          donate: bool = True) -> Tuple[Callable, Any]:
+                          donate: Optional[bool] = None
+                          ) -> Tuple[Callable, Any]:
     """ZeRO-1: replicated params, optimizer state sharded over ``axis``.
 
     The forward/backward see whole (replicated) params — no all-gather
@@ -247,7 +241,8 @@ def make_zero1_train_step(loss_fn: Callable, optimizer: Optimizer,
 def make_zero2_train_step(loss_fn: Callable, optimizer: Optimizer,
                           mesh: Mesh, params, *, axis: str = "dp",
                           min_size: int = 1024,
-                          donate: bool = True) -> Tuple[Callable, Any]:
+                          donate: Optional[bool] = None
+                          ) -> Tuple[Callable, Any]:
     """ZeRO-2: replicated params, reduce-scattered grads, sharded
     optimizer state over ``axis``.
 

@@ -116,7 +116,7 @@ def make_spmd_train_step(loss_fn: Callable, optimizer: Optimizer,
                          mesh: Optional[Mesh] = None,
                          param_specs: Optional[Any] = None,
                          batch_spec: Any = None,
-                         donate: bool = True) -> Callable:
+                         donate: Optional[bool] = None) -> Callable:
     """Compile ``step(params, opt_state, batch) -> SpmdStepOutput`` where
     sharding is carried by the *inputs* (place params with
     ``tensor.shard_params`` / batch with :func:`shard_batch_spec` first);
@@ -124,16 +124,15 @@ def make_spmd_train_step(loss_fn: Callable, optimizer: Optimizer,
     (loss, metrics)`` computes the GLOBAL mean loss — under GSPMD the code
     sees logical (global) shapes, so it is written exactly like
     single-device code.
+
+    Thin shim over the front door (:func:`.front_door.make_step` with
+    ``specs=FROM_INPUTS`` — docs/front_door.md): builder cache, compile
+    counters, and whole-step donation (``DPX_DONATE``) come from there.
     """
     del mesh, param_specs, batch_spec  # carried by input shardings
-
-    def step(params, opt_state, batch):
-        (loss, metrics), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params, batch)
-        params, opt_state = optimizer.update(grads, opt_state, params)
-        return SpmdStepOutput(params, opt_state, loss, metrics)
-
-    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    from .front_door import FROM_INPUTS, make_step
+    return make_step(loss_fn, optimizer, specs=FROM_INPUTS,
+                     donate=donate)
 
 
 def shard_batch_spec(batch, mesh: Mesh, spec: P):

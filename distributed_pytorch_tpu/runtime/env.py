@@ -283,6 +283,14 @@ register("DPX_MP_POLICY", "str", "off",
          "`off` (f32 throughout) or `bf16` (bf16 compute-params/"
          "activations with the f32 master kept authoritative — "
          "docs/compute.md).")
+register("DPX_DONATE", "bool", True,
+         "Default whole-step buffer donation of the pjit front door "
+         "(`parallel.front_door.make_step` and every builder shimmed "
+         "over it): params + optimizer state are donated with "
+         "out_shardings pinned equal to in_shardings, so the update "
+         "runs in place instead of copying the full state every step "
+         "(docs/front_door.md). Set 0 to force copying builds "
+         "everywhere (debugging).")
 register("DPX_REMAT", "str", "none",
          "Default per-layer remat policy of `models.TransformerLM"
          "(remat=None)`: `none` (save all activations), `full` "

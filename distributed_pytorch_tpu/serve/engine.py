@@ -86,6 +86,13 @@ class EngineConfig:
     page_len: Optional[int] = None
     n_pages: Optional[int] = None
     prefix_share: Optional[bool] = None
+    # reshard-free admit (docs/front_door.md): the params handed to the
+    # engine must ALREADY carry these shardings — typically a train
+    # step's ``out_shardings["params"]`` (parallel.handoff_shardings).
+    # Admission then never copies or reshards the weights; a mismatch
+    # raises a typed HandoffMismatch at construction instead of pjit
+    # silently resharding on the first prefill.
+    param_shardings: Optional[Any] = None
 
 
 class InferenceEngine:
@@ -104,6 +111,12 @@ class InferenceEngine:
             raise ValueError(f"n_slots must be >= 1, got {cfg.n_slots}")
         _check_attn_compatible(model, cfg.allow_custom_attn)
         self.model = model
+        if cfg.param_shardings is not None:
+            # the train -> serve-admit half of the reshard-free
+            # pjit-to-pjit handoff contract: assert, never copy
+            from ..parallel.front_door import verify_handoff
+            params = verify_handoff(params, cfg.param_shardings,
+                                    what="serve-admit params")
         self.params = params
         self.window = _model_window(model)
         if (self.window is None and getattr(model, "pos", None) is not None

@@ -318,76 +318,10 @@ class TestHierSim:
 
 
 # ---------------------------------------------------------------------------
-# SPMD front door: q4 / adaptive grad_reduce
-# ---------------------------------------------------------------------------
-
-
-class TestSpmdAdaptive:
-    @pytest.mark.slow
-    def test_grad_reduce_q4_trains(self, group8):
-        """make_train_step(grad_reduce="q4") tracks the exact-reduce
-        step on the reference workload (EF-free SPMD path: two q4
-        quantizations total, bounded)."""
-        import jax
-        from distributed_pytorch_tpu import models, optim
-        from distributed_pytorch_tpu.ops.losses import cross_entropy
-        from distributed_pytorch_tpu.parallel import make_train_step
-
-        model = models.DummyModel(in_dim=1, hidden_dim=32, n_classes=4)
-        params = model.init(jax.random.PRNGKey(0))
-        opt = optim.adamw(1e-3)
-
-        def loss_fn(p, batch):
-            x, y = batch
-            return cross_entropy(model.apply(p, x), y), {}
-
-        x = dist.shard_batch(np.arange(16, dtype=np.float32)[:, None])
-        y = dist.shard_batch((np.arange(16) % 4).astype(np.int32))
-        step_q = make_train_step(loss_fn, opt, donate=False,
-                                 grad_reduce="q4")
-        step_e = make_train_step(loss_fn, opt, donate=False)
-        pq = pe = params
-        sq, se = opt.init(params), opt.init(params)
-        for _ in range(5):
-            oq = step_q(pq, sq, (x, y))
-            oe = step_e(pe, se, (x, y))
-            pq, sq, pe, se = (oq.params, oq.opt_state, oe.params,
-                              oe.opt_state)
-        np.testing.assert_allclose(float(oq.loss.mean()),
-                                   float(oe.loss.mean()),
-                                   rtol=5e-2, atol=5e-2)
-
-    @pytest.mark.slow
-    def test_adaptive_step_exposes_chooser_and_runs(self, group8):
-        """grad_reduce="adaptive" on the mesh: one program per width,
-        the chooser fed by the in-step statistic, widths recorded."""
-        import jax
-        from distributed_pytorch_tpu import models, optim
-        from distributed_pytorch_tpu.ops.losses import cross_entropy
-        from distributed_pytorch_tpu.parallel import make_train_step
-
-        model = models.DummyModel(in_dim=1, hidden_dim=32, n_classes=4)
-        params = model.init(jax.random.PRNGKey(0))
-        opt = optim.adamw(1e-3)
-
-        def loss_fn(p, batch):
-            x, y = batch
-            return cross_entropy(model.apply(p, x), y), {}
-
-        x = dist.shard_batch(np.arange(16, dtype=np.float32)[:, None])
-        y = dist.shard_batch((np.arange(16) % 4).astype(np.int32))
-        step = make_train_step(loss_fn, opt, donate=False,
-                               grad_reduce="adaptive")
-        assert step.width_chooser is not None
-        st = opt.init(params)
-        for _ in range(3):
-            out = step(params, st, (x, y))
-            params, st = out.params, out.opt_state
-        assert len(step.width_chooser.widths) == 3
-        assert set(step.width_chooser.widths) <= {4, 8}
-        assert np.isfinite(float(out.loss.mean()))
-
-
+# SPMD front door q4/adaptive: moved to the spec-driven suite
+# (tests/test_front_door.py::TestSpecMatrix — the ISSUE 13 collapse;
+# q4/adaptive/sharded points now run FAST-tier there against the one
+# replicated oracle, with compile counters asserted per width)
 # ---------------------------------------------------------------------------
 # host front door: multiprocess parity, width agreement, overlap, chaos
 # ---------------------------------------------------------------------------

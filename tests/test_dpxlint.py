@@ -153,6 +153,103 @@ class TestRules:
         assert "DPX005" in _rules(findings)
         assert _lint_snippet(tmp_path, good) == []
 
+    def test_dpx006_jit_in_step_builder_without_donation(self, tmp_path):
+        bad = """
+            import jax
+
+            def make_train_step(loss_fn):
+                return jax.jit(loss_fn)
+        """
+        good = """
+            import jax
+
+            def make_train_step(loss_fn):
+                return jax.jit(loss_fn, donate_argnums=(0, 1))
+        """
+        assert "DPX006" in _rules(_lint_snippet(tmp_path, bad))
+        assert _lint_snippet(tmp_path, good) == []
+
+    def test_dpx006_innermost_owner_and_decode(self, tmp_path):
+        """Attribution is to the INNERMOST enclosing def: a sampler
+        closure inside a decode builder is not a builder site, while a
+        jit directly in a decode fn is."""
+        mixed = """
+            import jax
+
+            def build_decode(model):
+                def sampler(logits):
+                    pass
+                fn = jax.jit(sampler)          # in build_decode: flagged
+
+                def make_sampler():
+                    return jax.jit(sampler)    # innermost not step/decode
+
+                return fn
+        """
+        findings = _lint_snippet(tmp_path, mixed)
+        assert _rules(findings) == ["DPX006"]
+        assert findings[0].line_text.startswith("fn = jax.jit")
+
+    def test_dpx006_decorator_and_partial_spellings(self, tmp_path):
+        """The donation lint covers every jit spelling: a bare
+        @jax.jit decorator on a step/decode-named def (can never
+        donate), a @jit(...) decorator without donate_argnums, and
+        partial(jax.jit, ...) inside a builder."""
+        bad = """
+            import functools
+
+            import jax
+
+            @jax.jit
+            def train_step(params, opt_state, batch):
+                pass
+
+            @jax.jit(static_argnums=(0,))
+            def decode_step(params, cache):
+                pass
+
+            def make_train_step(loss_fn):
+                return functools.partial(jax.jit,
+                                         static_argnums=(0,))(loss_fn)
+        """
+        assert _rules(_lint_snippet(tmp_path, bad)) == ["DPX006"] * 3
+        good = """
+            import functools
+
+            import jax
+
+            @jax.jit(donate_argnums=(0, 1))
+            def train_step(params, opt_state, batch):
+                pass
+
+            def make_train_step(loss_fn):
+                return functools.partial(
+                    jax.jit, donate_argnums=(0, 1))(loss_fn)
+
+            @jax.jit
+            def sample_logits(logits):
+                pass
+        """
+        assert _lint_snippet(tmp_path, good) == []
+
+    def test_dpx006_scoped_to_package_and_waivable(self, tmp_path):
+        outside = """
+            import jax
+
+            def make_train_step(loss_fn):
+                return jax.jit(loss_fn)
+        """
+        assert _lint_snippet(tmp_path, outside,
+                             rel="benchmarks/mod.py") == []
+        waived = """
+            import jax
+
+            def make_eval_step(fn):
+                # dpxlint: disable=DPX006 eval does not own the params
+                return jax.jit(fn)
+        """
+        assert _lint_snippet(tmp_path, waived) == []
+
 
 class TestAllowlist:
     def test_inline_disable_same_line_and_line_above(self, tmp_path):

@@ -317,6 +317,11 @@ class TestParamResidual:
 
 
 class TestSpmdSharded:
+    """The SPMD sharded-vs-replicated trajectory matrix moved to the
+    spec-driven suite (tests/test_front_door.py::TestSpecMatrix — the
+    ISSUE 13 collapse); this class keeps only what is NOT a per-front-
+    door duplicate: the checkpoint-facing spec exports and validation."""
+
     def _setup(self):
         model = models.DummyModel(in_dim=1, hidden_dim=32, n_classes=4)
         params = model.init(jax.random.PRNGKey(0))
@@ -330,51 +335,11 @@ class TestSpmdSharded:
         y = dist.shard_batch((np.arange(16) % 4).astype(np.int32))
         return params, opt, loss_fn, (x, y)
 
-    def test_tracks_replicated_step(self, group8):
-        """weight_update="sharded" through parallel.make_train_step:
-        the loss trajectory matches the replicated step to float
-        tolerance (per-slice math is bit-exact; only collective
-        reduction order may differ)."""
+    def test_init_opt_state_is_sharded_state(self, group8):
         params, opt, loss_fn, batch = self._setup()
-        step_r = make_train_step(loss_fn, opt, donate=False)
-        step_s = make_train_step(loss_fn, opt, donate=False,
-                                 weight_update="sharded")
-        sr, ss = opt.init(params), step_s.init_opt_state(params)
-        assert isinstance(ss, ShardedOptState)
-        pr = ps = params
-        for _ in range(5):
-            outr = step_r(pr, sr, batch)
-            outs = step_s(ps, ss, batch)
-            pr, sr = outr.params, outr.opt_state
-            ps, ss = outs.params, outs.opt_state
-            np.testing.assert_allclose(float(outr.loss.mean()),
-                                       float(outs.loss.mean()),
-                                       rtol=1e-5, atol=1e-6)
-        for a, b in zip(jax.tree_util.tree_leaves(pr),
-                        jax.tree_util.tree_leaves(ps)):
-            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       rtol=2e-5, atol=1e-6)
-
-    def test_quant_wire_composes(self, group8):
-        """grad_reduce="quant" + weight_update="sharded": both legs
-        ride the block-int8 codec and the trajectory still tracks."""
-        params, opt, loss_fn, batch = self._setup()
-        step_e = make_train_step(loss_fn, opt, donate=False,
-                                 weight_update="sharded")
-        step_q = make_train_step(loss_fn, opt, donate=False,
-                                 weight_update="sharded",
-                                 grad_reduce="quant")
-        se, sq = (step_e.init_opt_state(params),
-                  step_q.init_opt_state(params))
-        pe = pq = params
-        for _ in range(5):
-            oute = step_e(pe, se, batch)
-            outq = step_q(pq, sq, batch)
-            pe, se = oute.params, oute.opt_state
-            pq, sq = outq.params, outq.opt_state
-        np.testing.assert_allclose(float(outq.loss.mean()),
-                                   float(oute.loss.mean()),
-                                   rtol=5e-3, atol=5e-3)
+        step = make_train_step(loss_fn, opt, donate=False,
+                               weight_update="sharded")
+        assert isinstance(step.init_opt_state(params), ShardedOptState)
 
     def test_state_specs_exported_for_ckpt(self, group8):
         params, opt, loss_fn, batch = self._setup()
@@ -477,110 +442,12 @@ class TestQuantizedLegPrimitives:
 # ---------------------------------------------------------------------------
 
 
-def _host_train_worker(rank, world, q, mode, steps):
-    """Spawn-picklable worker: the reference DDP workload stepped with
-    replicated vs sharded weight updates; reports the loss trajectory,
-    a bitwise param digest, and per-op CommStats bytes."""
-    import hashlib
-
-    import jax as _jax
-    import numpy as _np
-
-    import distributed_pytorch_tpu as _dist
-    from distributed_pytorch_tpu import models as _models
-    from distributed_pytorch_tpu import optim as _optim
-    from distributed_pytorch_tpu.ops.losses import cross_entropy as _ce
-    from distributed_pytorch_tpu.parallel import (
-        make_train_step as _mk_step)
-    from distributed_pytorch_tpu.runtime import context as _ctx
-
-    _dist.init_process_group(rank, world)
-    try:
-        model = _models.DummyModel(in_dim=1, hidden_dim=32, n_classes=4)
-        params = model.init(_jax.random.PRNGKey(0))
-        opt = _optim.adamw(1e-2)
-
-        def loss_fn(p, batch):
-            x, y = batch
-            return _ce(model.apply(p, x), y), {}
-
-        rng = _np.random.default_rng(0)
-        x = rng.random((16, 1), dtype=_np.float32)
-        y = rng.integers(0, 4, (16,)).astype(_np.int32)
-        lo = rank * (16 // world)
-        hi = lo + 16 // world
-        if mode == "replicated":
-            step = _mk_step(loss_fn, opt)
-            st = opt.init(params)
-        else:
-            gr = "quant" if mode == "sharded_quant" else "mean"
-            step = _mk_step(loss_fn, opt, weight_update="sharded",
-                            grad_reduce=gr)
-            st = step.init_opt_state(params)
-        losses = []
-        for _ in range(steps):
-            out = step(params, st, (x[lo:hi], y[lo:hi]))
-            params, st = out.params, out.opt_state
-            losses.append(float(_np.asarray(out.loss)[0]))
-        digest = hashlib.sha256(b"".join(
-            _np.ascontiguousarray(_np.asarray(l, _np.float32)).tobytes()
-            for l in _jax.tree_util.tree_leaves(params))).hexdigest()
-        comm = _ctx.get_host_comm()
-        stats = {k: int(v["bytes"])
-                 for k, v in comm.stats.summary().items()}
-        q.put((rank, mode, digest, losses, stats))
-    finally:
-        _dist.cleanup()
-
-
-_host_mode_cache = {}
-
-
-def _run_host_mode(mode, world=2, steps=4):
-    key = (mode, world, steps)
-    if key in _host_mode_cache:  # the replicated baseline is shared
-        return _host_mode_cache[key]
-    ctx = mp.get_context("spawn")
-    q = ctx.Queue()
-    launch_multiprocess(_host_train_worker, world, q, mode, steps)
-    res = {}
-    while len(res) < world:
-        rank, _, digest, losses, stats = q.get(timeout=120)
-        res[rank] = (digest, losses, stats)
-    # ranks never drift apart, in any mode
-    assert len({v[0] for v in res.values()}) == 1, mode
-    _host_mode_cache[key] = res[0]
-    return res[0]
-
-
 class TestHostSharded:
-    def test_world2_sharded_exact_matches_replicated(self):
-        """Host ring, exact wire: the sharded trajectory tracks the
-        replicated one to float tolerance (the per-slice update is
-        bit-exact; the flat bucket's block padding shifts ring segment
-        boundaries, so the exact all-reduce may associate f32 sums
-        differently — ulp-level only), and ranks stay bit-identical."""
-        rep = _run_host_mode("replicated")
-        sh = _run_host_mode("sharded")
-        np.testing.assert_allclose(sh[1], rep[1], rtol=1e-5, atol=1e-6)
-
-    @pytest.mark.slow
-    def test_world2_sharded_quant_wire_and_stats(self):
-        """Quant wire: trajectory tracks, and CommStats recorded the
-        reduce_scatter/allgather legs at exactly the wire.py accounting
-        (bytes-on-wire is asserted, not narrated). Slow tier: the
-        quant-leg byte accounting is also asserted process-free by
-        TestWireLegSpecs and end to end by the CI bench smoke."""
-        rep = _run_host_mode("replicated")
-        shq = _run_host_mode("sharded_quant", steps=4)
-        np.testing.assert_allclose(shq[1], rep[1], rtol=5e-2, atol=5e-2)
-        stats = shq[2]
-        assert "reduce_scatter" in stats and "allgather" in stats
-        # DummyModel flat bucket at world 2: 4 leaves x 1 block each
-        n_padded = 4 * BLOCK
-        leg = wire.quant_leg_wire_bytes(n_padded, 2) // 2
-        assert stats["reduce_scatter"] == 4 * leg  # 4 steps
-        assert stats["allgather"] == 4 * leg
+    """The world-2 host sharded/quant trajectory + CommStats twins
+    moved to the spec-driven suite (tests/test_front_door.py::
+    TestHostMatrix — the ISSUE 13 collapse). What stays is the native
+    leg bit-parity against the numpy wire spec, which no other door
+    exercises."""
 
     @pytest.mark.slow
     def test_world4_sharded_native_legs_match_numpy_spec(self):
