@@ -8,6 +8,11 @@ line for tooling). Retracted rows are listed by stage + reason so the
 retraction trail stays visible.
 
 Usage: python benchmarks/report.py [--log FILE] [--write-baseline]
+       [--trace-log FILE]
+
+--trace-log renders the dpxtrace observability section from a span log
+(per-op per-rank duration summary + the k*IQR straggler verdict —
+docs/observability.md), appended after the measured-results section.
 
 --write-baseline splices the rendered section into BASELINE.md between
 the BEGIN/END MEASURED AUTO markers (the watcher runs this after every
@@ -35,16 +40,53 @@ DEFAULT_LOG = os.path.join(REPO, "benchmarks", "tpu_results.jsonl")
 
 _PB_RECORD = None
 
+#: Private root the file-based loader fabricates modules under. ONE
+#: root for everything report.py loads (perfbench AND obs), so shared
+#: dependencies (obs.detect -> ..perfbench.stats) resolve to a single
+#: module instance instead of loading twice under separate roots.
+_PRIVATE_ROOT = "_report_dpx"
+
+
+def _load_private(modules):
+    """Load package modules file-based under :data:`_PRIVATE_ROOT`,
+    WITHOUT importing the real package: run_all_tpu's watcher shells
+    out to report.py on a 60s budget precisely because report is
+    jax-free and cannot hang on a wedged tunnel — the heavy package
+    ``__init__`` (api → jax) must never be pulled here, and the genuine
+    package must be neither imported nor shadowed.
+
+    ``modules`` is an ordered sequence of ``(pkg, sub)`` pairs (the
+    dependency order matters: errors → stats → record); already-loaded
+    names are reused. Returns the loaded modules, in order."""
+    import importlib.util
+    import types
+
+    pkg_dir = os.path.join(REPO, "distributed_pytorch_tpu")
+    if _PRIVATE_ROOT not in sys.modules:
+        root = types.ModuleType(_PRIVATE_ROOT)
+        root.__path__ = [pkg_dir]
+        sys.modules[_PRIVATE_ROOT] = root
+    out = []
+    for pkg, sub in modules:
+        parent = f"{_PRIVATE_ROOT}.{pkg}"
+        if parent not in sys.modules:
+            mod = types.ModuleType(parent)
+            mod.__path__ = [os.path.join(pkg_dir, pkg)]
+            sys.modules[parent] = mod
+        name = f"{parent}.{sub}"
+        if name not in sys.modules:
+            spec = importlib.util.spec_from_file_location(
+                name, os.path.join(pkg_dir, pkg, sub + ".py"))
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[name] = mod
+            spec.loader.exec_module(mod)
+        out.append(sys.modules[name])
+    return out
+
 
 def _perfbench_record():
-    """The perfbench record module, loaded WITHOUT importing the real
-    package: run_all_tpu's watcher shells out to report.py on a 60s
-    budget precisely because report is jax-free and cannot hang on a
-    wedged tunnel — the heavy package __init__ (api → jax) must never
-    be pulled here.  When the real module is already in sys.modules
-    (in-process test use) it is reused; otherwise the stdlib-only
-    perfbench modules are loaded file-based under a PRIVATE package
-    name, so the genuine package is neither imported nor shadowed."""
+    """The perfbench record module: the real one when already imported
+    (in-process test use), else file-based under the private root."""
     global _PB_RECORD
     if _PB_RECORD is not None:
         return _PB_RECORD
@@ -52,27 +94,9 @@ def _perfbench_record():
     if real is not None:
         _PB_RECORD = real
         return _PB_RECORD
-    import importlib.util
-    import types
-
-    pdir = os.path.join(REPO, "distributed_pytorch_tpu", "perfbench")
-    pkg_name = "_report_perfbench"
-    if pkg_name not in sys.modules:
-        pkg = types.ModuleType(pkg_name)
-        pkg.__path__ = [pdir]
-        sys.modules[pkg_name] = pkg
-    # record's relative imports resolve inside the private package;
-    # dependency order matters (errors -> stats -> record)
-    for sub in ("errors", "stats", "record"):
-        name = f"{pkg_name}.{sub}"
-        if name in sys.modules:
-            continue
-        spec = importlib.util.spec_from_file_location(
-            name, os.path.join(pdir, sub + ".py"))
-        mod = importlib.util.module_from_spec(spec)
-        sys.modules[name] = mod
-        spec.loader.exec_module(mod)
-    _PB_RECORD = sys.modules[f"{pkg_name}.record"]
+    *_, _PB_RECORD = _load_private(
+        [("perfbench", "errors"), ("perfbench", "stats"),
+         ("perfbench", "record")])
     return _PB_RECORD
 
 
@@ -440,6 +464,69 @@ def render(rows) -> str:
     return "\n".join(lines)
 
 
+_OBS = None
+
+
+def _obs_modules():
+    """obs.export/detect — the real modules when already imported
+    (in-process test use), else file-based under the SAME private root
+    as :func:`_perfbench_record` (obs.detect's relative import of
+    ``..perfbench.stats`` then resolves to the one already-loaded
+    private stats instance)."""
+    global _OBS
+    if _OBS is not None:
+        return _OBS
+    real = sys.modules.get("distributed_pytorch_tpu.obs.export")
+    if real is not None:
+        _OBS = (real,
+                sys.modules["distributed_pytorch_tpu.obs.detect"])
+        return _OBS
+    _, export_mod, detect_mod = _load_private(
+        [("perfbench", "stats"), ("obs", "export"), ("obs", "detect")])
+    _OBS = (export_mod, detect_mod)
+    return _OBS
+
+
+def render_trace(path: str) -> str:
+    """The observability section: per-op per-rank span summary + the
+    straggler verdict from one span log (``dpxtrace summarize`` /
+    ``stragglers`` as markdown)."""
+    export, detect = _obs_modules()
+    try:
+        records, malformed = export.read_log(path)
+    except OSError as e:
+        return f"## Trace\n\n(cannot read {path}: {e})\n"
+    spans = export.collect_spans(records)
+    lines = ["## Trace (dpxtrace)", "",
+             f"Source: `{os.path.basename(path)}` — {len(spans)} "
+             f"span(s), {len(malformed)} malformed line(s)", ""]
+    rows = detect.summarize_ops(spans)
+    if not rows:
+        lines += ["(no spans recorded — set `DPX_TRACE=1`)", ""]
+        return "\n".join(lines)
+    lines += ["| op | rank | count | median ms | IQR ms | total ms |",
+              "|---|---|---|---|---|---|"]
+    for r in rows:
+        lines.append(f"| `{r['op']}` | {r['rank']} | {r['count']} | "
+                     f"{r['median_ms']} | {r['iqr_ms']} | "
+                     f"{r['total_ms']} |")
+    lines.append("")
+    found = detect.stragglers(spans)
+    if not found:
+        lines += ["Stragglers: none flagged "
+                  f"(k·IQR gate, k={detect.IQR_K})", ""]
+    else:
+        lines += ["**Stragglers flagged** (per-rank median outside "
+                  f"k·IQR, k={detect.IQR_K}):", ""]
+        for f in found:
+            lines.append(
+                f"- `{f['op']}` rank {f['rank']}: {f['median_ms']} ms "
+                f"vs world median {f['world_median_ms']} ms "
+                f"({f['excess_x']}x, threshold {f['threshold_ms']} ms)")
+        lines.append("")
+    return "\n".join(lines)
+
+
 BASELINE_PATH = os.path.join(REPO, "BASELINE.md")
 MARK_BEGIN = ("<!-- BEGIN MEASURED AUTO (regenerated by "
               "benchmarks/report.py --write-baseline; do not edit by "
@@ -485,6 +572,13 @@ def main(argv):
               f"{reason}", file=sys.stderr)
     md = render(rows)
     print(md)
+    if "--trace-log" in argv:
+        i = argv.index("--trace-log")
+        if i + 1 >= len(argv):
+            print("usage: report.py [--trace-log FILE]",
+                  file=sys.stderr)
+            return 2
+        print(render_trace(argv[i + 1]))
     rc = 0
     if "--write-baseline" in argv:
         ok = write_baseline(md)

@@ -36,6 +36,16 @@ carry attribution, threads are named. Each is now a machine-checked rule
   state buffers — a copying build silently doubles peak memory every
   step. Inline-waivable like the others (eval steps and grad-only
   jits legitimately don't own their inputs).
+* **DPX007** — ``time.time()`` used for DURATION measurement (the
+  ``t1 - t0`` pattern) inside the package. Wall clock steps under NTP,
+  so a wall-clock difference is not a duration — ``time.perf_counter``
+  / ``perf_counter_ns`` (or ``time.monotonic`` for deadlines) is.
+  Flags a subtraction whose operand is a direct ``time.time()`` call,
+  a local name assigned from one, or an attribute assigned from one
+  anywhere in the file. Legitimate WALL-CLOCK sites (cross-process
+  staleness against a timestamp another process wrote) are
+  inline-waived with a reason; ``obs/trace.py``'s single anchor read
+  is not a subtraction and does not trigger.
 
 Suppression: append ``# dpxlint: disable=DPXnnn <reason>`` to the
 offending line (or the line above); ``# dpxlint: disable-file=DPXnnn
@@ -58,7 +68,8 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from .schedule import FRONT_DOOR_SURFACE, NATIVE_OPS
 
-RULES = ("DPX001", "DPX002", "DPX003", "DPX004", "DPX005", "DPX006")
+RULES = ("DPX001", "DPX002", "DPX003", "DPX004", "DPX005", "DPX006",
+         "DPX007")
 
 #: DPX006: a jit call inside a function whose name matches this is a
 #: step/decode-builder site and must carry ``donate_argnums``.
@@ -199,6 +210,7 @@ class _FileChecker:
         self._check_typed_raises(tree)         # DPX004
         self._check_thread_names(tree)         # DPX005
         self._check_jit_donation(tree)         # DPX006
+        self._check_wall_clock_durations(tree)  # DPX007
         return self.findings
 
     # -- DPX001 ------------------------------------------------------------
@@ -443,6 +455,123 @@ class _FileChecker:
                 walk(child, owner)
 
         walk(tree, None)
+
+
+    # -- DPX007 ------------------------------------------------------------
+
+    def _check_wall_clock_durations(self, tree: ast.Module) -> None:
+        """``time.time()`` in a subtraction — duration math on the wall
+        clock. Wall time steps (NTP) and a stepped clock turns a
+        "duration" negative or wildly wrong; ``perf_counter`` exists
+        for exactly this. Tracked taint: direct ``time.time()`` calls
+        (any alias spelling), local names assigned from one (per
+        function scope), and attributes assigned from one (module-wide
+        — ``self.start_time = time.time()`` subtracted in another
+        method is the classic offender)."""
+        if not self._in_package():
+            return
+
+        # alias spellings: `import time as t` → t.time(); `from time
+        # import time [as now]` → now()
+        time_mod_aliases: Set[str] = set()
+        time_fn_aliases: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "time":
+                        time_mod_aliases.add(alias.asname or "time")
+            elif (isinstance(node, ast.ImportFrom)
+                    and node.module == "time"):
+                for alias in node.names:
+                    if alias.name == "time":
+                        time_fn_aliases.add(alias.asname or "time")
+
+        def is_wall_call(node: ast.AST) -> bool:
+            if not isinstance(node, ast.Call):
+                return False
+            fn = node.func
+            if (isinstance(fn, ast.Attribute) and fn.attr == "time"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in time_mod_aliases):
+                return True
+            return (isinstance(fn, ast.Name)
+                    and fn.id in time_fn_aliases)
+
+        # module-wide attribute taint: self.X = time.time() anywhere
+        tainted_attrs: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and is_wall_call(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        tainted_attrs.add(tgt.attr)
+
+        def scope_walk(root: ast.AST, skip_defs: bool):
+            """ast.walk, optionally not descending into nested function
+            defs — the MODULE scope must not inherit a sibling
+            function's local taint (a `start = time.time()` in one def
+            must never flag another def's perf_counter `end - start`).
+            Function scopes keep nested defs (closure taint only ADDS
+            coverage; duplicates dedupe via `flagged`)."""
+            stack = [root]
+            while stack:
+                node = stack.pop()
+                if node is not root and skip_defs and isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                yield node
+                stack.extend(ast.iter_child_nodes(node))
+
+        def scope_names(fn_node: ast.AST, skip_defs: bool) -> Set[str]:
+            names: Set[str] = set()
+            for node in scope_walk(fn_node, skip_defs):
+                if (isinstance(node, ast.Assign)
+                        and is_wall_call(node.value)):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            names.add(tgt.id)
+                elif (isinstance(node, (ast.AnnAssign, ast.NamedExpr))
+                        and node.value is not None
+                        and is_wall_call(node.value)
+                        and isinstance(node.target, ast.Name)):
+                    names.add(node.target.id)
+            return names
+
+        flagged: Set[int] = set()   # node ids — scopes overlap (a def
+        # is walked by its own scope AND enclosing ones); emit once
+
+        def check_scope(fn_node: ast.AST, skip_defs: bool = False) -> None:
+            tainted = scope_names(fn_node, skip_defs)
+
+            def is_wall(node: ast.AST) -> bool:
+                if is_wall_call(node):
+                    return True
+                if isinstance(node, ast.Name) and node.id in tainted:
+                    return True
+                return (isinstance(node, ast.Attribute)
+                        and node.attr in tainted_attrs)
+
+            for node in scope_walk(fn_node, skip_defs):
+                if (isinstance(node, ast.BinOp)
+                        and isinstance(node.op, ast.Sub)
+                        and id(node) not in flagged
+                        and (is_wall(node.left) or is_wall(node.right))):
+                    flagged.add(id(node))
+                    self._emit(
+                        "DPX007", node,
+                        "time.time() used for duration measurement "
+                        "(t1 - t0) — wall clock steps under NTP; use "
+                        "time.perf_counter/perf_counter_ns (or the "
+                        "obs.trace wall anchor for monotone wall "
+                        "stamps), or waive a legitimate cross-process "
+                        "wall-clock comparison with a reason")
+
+        # one scope per function def + the module top level; the module
+        # pass skips function bodies entirely so one function's local
+        # wall-clock name can never taint a sibling's duration math
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                check_scope(node)
+        check_scope(tree, skip_defs=True)
 
 
 def _call_name(call: ast.Call) -> Optional[str]:

@@ -45,6 +45,7 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import trace as _dpxtrace
 from . import writer as _writer
 from .errors import CkptError
 from .reader import ReadStats, Target  # noqa: F401  (re-exported surface)
@@ -69,6 +70,10 @@ def clear_trace() -> None:
 def _mark(phase: str) -> None:
     with _trace_lock:
         _trace.append((phase, threading.current_thread().name))
+    # the same phase on the dpxtrace timeline (obs/trace.py): instant
+    # markers for phase ENTRY; the enclosing save/io/commit spans carry
+    # the durations (no-ops unless DPX_TRACE)
+    _dpxtrace.event(f"ckpt.{phase}")
 
 
 def _snapshot(tree):
@@ -179,40 +184,52 @@ class CheckpointManager:
         json.dumps(extra or {})  # reject unserializable extras up front
         rank, world = self._topo()
         t0 = time.perf_counter()
-        _mark("d2h")
-        from ..runtime import context
-        live_replica = self.sharded and context.get_host_comm() is not None
-        if (self.sharded and not live_replica) or \
-                (not self.sharded and rank == 0):
-            # single-controller D2H (or primary-only full-replica copy);
-            # under the host front door the sharded path skips the full
-            # defensive copy — snapshot_owned cuts private copies of
-            # exactly the 1/world of the state this rank writes
-            params = _snapshot(params)
-            if opt_state is not None:
-                opt_state = _snapshot(opt_state)
-        tmp = self._prepare_tmp(step, rank)
-        if self.sharded:
-            plan = self._plan(params, opt_state, world)
-            _writer.snapshot_owned(plan, rank, force_copy=live_replica)
-            job = lambda: self._io_sharded(tmp, rank, plan)
-        else:
-            plan = None
-            job = (lambda: self._io_full(tmp, step, params, opt_state,
-                                         extra)) if rank == 0 else None
-        pend = _Pending(step, tmp, plan, extra)
-        pend.io_stats["snapshot_s"] = time.perf_counter() - t0
-        self._pending = pend
-        if job is not None:
-            if self.async_save:
-                self._thread = threading.Thread(
-                    target=self._run_io, args=(job, pend),
-                    name="ckpt-io", daemon=True)
-                self._thread.start()
+        # the control-thread half of the save on the trace timeline:
+        # D2H snapshot + staging (sync mode runs IO inside it too); the
+        # async IO span opens on the ckpt-io thread in _run_io, the
+        # commit span in _finish_pending — together the ckpt phases,
+        # per rank, on the one cross-rank timeline (obs/trace.py)
+        with _dpxtrace.span("ckpt.save", step=step, rank=rank,
+                            sharded=self.sharded,
+                            async_save=self.async_save):
+            _mark("d2h")
+            from ..runtime import context
+            live_replica = (self.sharded
+                            and context.get_host_comm() is not None)
+            if (self.sharded and not live_replica) or \
+                    (not self.sharded and rank == 0):
+                # single-controller D2H (or primary-only full-replica
+                # copy); under the host front door the sharded path
+                # skips the full defensive copy — snapshot_owned cuts
+                # private copies of exactly the 1/world of the state
+                # this rank writes
+                params = _snapshot(params)
+                if opt_state is not None:
+                    opt_state = _snapshot(opt_state)
+            tmp = self._prepare_tmp(step, rank)
+            if self.sharded:
+                plan = self._plan(params, opt_state, world)
+                _writer.snapshot_owned(plan, rank,
+                                       force_copy=live_replica)
+                job = lambda: self._io_sharded(tmp, rank, plan)
             else:
-                self._run_io(job, pend)
-        if not self.async_save:
-            self._finish_pending()
+                plan = None
+                job = (lambda: self._io_full(tmp, step, params,
+                                             opt_state, extra)) \
+                    if rank == 0 else None
+            pend = _Pending(step, tmp, plan, extra)
+            pend.io_stats["snapshot_s"] = time.perf_counter() - t0
+            self._pending = pend
+            if job is not None:
+                if self.async_save:
+                    self._thread = threading.Thread(
+                        target=self._run_io, args=(job, pend),
+                        name="ckpt-io", daemon=True)
+                    self._thread.start()
+                else:
+                    self._run_io(job, pend)
+            if not self.async_save:
+                self._finish_pending()
         return True
 
     def _plan(self, params, opt_state, world):
@@ -242,11 +259,14 @@ class CheckpointManager:
         return tmp
 
     def _run_io(self, job, pend: _Pending) -> None:
-        _mark("io")
-        try:
-            pend.io_stats.update(job() or {})
-        except BaseException as e:  # surfaced on the control thread
-            self._error = e
+        # a fresh span (ckpt-io thread in async mode): its tid on the
+        # timeline IS the proof serialization left the control thread
+        with _dpxtrace.span("ckpt.io", step=pend.step):
+            _mark("io")
+            try:
+                pend.io_stats.update(job() or {})
+            except BaseException as e:  # surfaced on the control thread
+                self._error = e
 
     def _io_sharded(self, tmp: str, rank: int, plan) -> Dict[str, Any]:
         stats = _writer.write_shards(tmp, rank, plan)
@@ -280,27 +300,29 @@ class CheckpointManager:
             return
         pend, self._pending = self._pending, None
         rank, world = self._topo()
-        self._barrier()  # every writer's fragment is durable
-        if rank == 0:
-            _mark("commit")
-            from ..utils import checkpoint as _ck
-            from ..utils.logging import append_event
-            if self.sharded:
-                _writer.commit(self.ckpt_dir, pend.step, pend.tmp,
-                               pend.plan, pend.extra,
-                               self._resolved_axes(), world,
-                               keep=self.keep, rank=rank)
-            else:
-                _ck._commit_full(self.ckpt_dir, pend.step, pend.tmp,
-                                 keep=self.keep, rank=rank)
-            append_event(
-                "ckpt_save", step=pend.step, rank=rank, world=world,
-                sharded=self.sharded, async_save=self.async_save,
-                bytes=pend.io_stats.get("bytes"),
-                shards=pend.io_stats.get("shards"),
-                io_s=round(pend.io_stats.get("duration_s", 0.0), 6),
-                snapshot_s=round(pend.io_stats.get("snapshot_s", 0.0), 6))
-        self._barrier()  # commit visible on every rank
+        with _dpxtrace.span("ckpt.commit", step=pend.step, rank=rank):
+            self._barrier()  # every writer's fragment is durable
+            if rank == 0:
+                _mark("commit")
+                from ..utils import checkpoint as _ck
+                from ..utils.logging import append_event
+                if self.sharded:
+                    _writer.commit(self.ckpt_dir, pend.step, pend.tmp,
+                                   pend.plan, pend.extra,
+                                   self._resolved_axes(), world,
+                                   keep=self.keep, rank=rank)
+                else:
+                    _ck._commit_full(self.ckpt_dir, pend.step, pend.tmp,
+                                     keep=self.keep, rank=rank)
+                append_event(
+                    "ckpt_save", step=pend.step, rank=rank, world=world,
+                    sharded=self.sharded, async_save=self.async_save,
+                    bytes=pend.io_stats.get("bytes"),
+                    shards=pend.io_stats.get("shards"),
+                    io_s=round(pend.io_stats.get("duration_s", 0.0), 6),
+                    snapshot_s=round(
+                        pend.io_stats.get("snapshot_s", 0.0), 6))
+            self._barrier()  # commit visible on every rank
 
     def wait(self) -> None:
         """Join in-flight IO and commit the pending step (collective)."""

@@ -1,0 +1,29 @@
+"""obs — cross-rank observability: span tracing, flight recorder,
+Chrome-trace export, straggler detection (docs/observability.md).
+
+* :mod:`.trace`  — the span API + per-rank flight recorder every
+  instrumented seam (comm ops, host train step, serve lifecycle, ckpt
+  phases, fault injections) writes through; also the process wall
+  anchor behind ``utils.logging``'s monotone timestamps.
+* :mod:`.export` — merge per-rank line-JSON span logs into Chrome
+  trace-event JSON (rank→pid, thread→tid, clock alignment at
+  collective exits) + the metrics-log vocabulary/validator.
+* :mod:`.detect` — per-op per-rank duration medians, k·IQR straggler
+  flagging (the ``perfbench/stats`` policy).
+
+CLI: ``python -m tools.dpxtrace`` (merge/export/summarize/stragglers/
+check) — stdlib-only, loads without the heavy package ``__init__``.
+
+Every module here is stdlib-only with lazy cross-package imports, the
+``analysis/lint.py`` contract.
+"""
+
+from . import detect, export, trace  # noqa: F401
+from .trace import (enabled, event, flight_dump, flight_snapshot,  # noqa: F401
+                    new_trace_id, on_typed_failure, span, wall_now)
+
+__all__ = [
+    "trace", "export", "detect",
+    "span", "event", "enabled", "new_trace_id", "wall_now",
+    "flight_dump", "flight_snapshot", "on_typed_failure",
+]

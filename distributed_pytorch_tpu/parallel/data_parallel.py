@@ -267,6 +267,7 @@ def _make_host_train_step(loss_fn: Callable, optimizer: Optimizer,
     import numpy as np
 
     from ..comm import host_backend as _hb
+    from ..obs import trace as _dpxtrace
     from ..ops.quant import ErrorFeedback
     from ..runtime import env as _envmod
 
@@ -324,24 +325,37 @@ def _make_host_train_step(loss_fn: Callable, optimizer: Optimizer,
 
     if not overlap:
         def step(params, opt_state, batch):
-            (loss, metrics), grads = vg(params, batch)
-            leaves, tree = jax.tree_util.tree_flatten(grads)
-            bits = chooser.width if chooser is not None else (width or 8)
-            flat = np.concatenate(
-                [np.asarray(l, dtype=np.float32).ravel()
-                 for l in leaves])
-            if on_bucket_ready is not None:
-                on_bucket_ready(0, 1, flat.nbytes)
-            flat = _reduce_bucket(0, flat, bits, False)
-            _observe([flat])
-            outs, off = [], 0
-            for l in leaves:
-                outs.append(jnp.asarray(
-                    flat[off:off + l.size].reshape(l.shape),
-                    dtype=l.dtype))
-                off += l.size
-            grads = jax.tree_util.tree_unflatten(tree, outs)
-            params, opt_state = upd(grads, opt_state, params)
+            # dpxtrace spans (obs/trace.py, no-ops unless DPX_TRACE):
+            # host_step > backward / bucket(wire nests inside) / update
+            # is the bucket→wire→update breakdown the cross-rank
+            # timeline renders per rank
+            with _dpxtrace.span("host_step", wire=grad_reduce,
+                                buckets=1):
+                with _dpxtrace.span("backward"):
+                    (loss, metrics), grads = vg(params, batch)
+                    leaves, tree = jax.tree_util.tree_flatten(grads)
+                    bits = (chooser.width if chooser is not None
+                            else (width or 8))
+                    # the concat materializes the grads: backward time
+                    # is attributed here, not to the async dispatch
+                    flat = np.concatenate(
+                        [np.asarray(l, dtype=np.float32).ravel()
+                         for l in leaves])
+                if on_bucket_ready is not None:
+                    on_bucket_ready(0, 1, flat.nbytes)
+                with _dpxtrace.span("bucket", b=0, nbytes=flat.nbytes,
+                                    bits=bits):
+                    flat = _reduce_bucket(0, flat, bits, False)
+                _observe([flat])
+                outs, off = [], 0
+                for l in leaves:
+                    outs.append(jnp.asarray(
+                        flat[off:off + l.size].reshape(l.shape),
+                        dtype=l.dtype))
+                    off += l.size
+                grads = jax.tree_util.tree_unflatten(tree, outs)
+                with _dpxtrace.span("update"):
+                    params, opt_state = upd(grads, opt_state, params)
             return StepOutput(params, opt_state,
                               jnp.asarray(loss)[None], metrics)
 
@@ -374,55 +388,68 @@ def _make_host_train_step(loss_fn: Callable, optimizer: Optimizer,
         return False
 
     def step(params, opt_state, batch):
-        (loss, metrics), grads = vg(params, batch)
-        gleaves, gtree = jax.tree_util.tree_flatten(grads)
-        pleaves = jax.tree_util.tree_leaves(params)
-        groups = _partition_contiguous([l.size for l in gleaves],
-                                       n_buckets)
-        # a LIST specifically: optimizer states are NamedTuples/dicts/
-        # bare tuples, so requiring the exact container init_opt_state
-        # returns keeps a full-tree state from ever being indexed as
-        # per-bucket states (an AdamWState IS a 3-tuple — a len check
-        # alone can collide with a 3-bucket partition)
-        if not isinstance(opt_state, list) \
-                or len(opt_state) != len(groups):
-            raise TypeError(
-                "the overlapped host step keeps PER-BUCKET optimizer "
-                "states — build opt_state with step.init_opt_state("
-                "params), not optimizer.init")
-        bits = chooser.width if chooser is not None else (width or 8)
-        new_p = [None] * len(gleaves)
-        new_states = [None] * len(groups)
-        pending = []   # dispatched, unfenced update outputs
-        reduced = []
-        for b, idx in enumerate(groups):
-            flat = np.concatenate(
-                [np.asarray(gleaves[i], dtype=np.float32).ravel()
-                 for i in idx])
-            if on_bucket_ready is not None:
-                on_bucket_ready(b, len(groups), flat.nbytes)
-            hidden = _outstanding(pending)
-            flat = _reduce_bucket(b, flat, bits, hidden)
-            reduced.append(flat)
-            g_sub, off = [], 0
-            for i in idx:
-                n = gleaves[i].size
-                g_sub.append(jnp.asarray(
-                    flat[off:off + n].reshape(gleaves[i].shape),
-                    dtype=gleaves[i].dtype))
-                off += n
-            # dispatch this bucket's update and DON'T fence it: the
-            # device chews on it while the next bucket's ring traffic
-            # blocks the control thread — that concurrency is what the
-            # is_ready probe above measures into overlapped_s
-            out_p, out_state = upd(g_sub, opt_state[b],
-                                   [pleaves[i] for i in idx])
-            pending.extend(out_p)
-            for j, i in enumerate(idx):
-                new_p[i] = out_p[j]
-            new_states[b] = out_state
-        _observe(reduced)
-        params = jax.tree_util.tree_unflatten(gtree, new_p)
+        with _dpxtrace.span("host_step", wire=grad_reduce,
+                            buckets=n_buckets, overlap=True):
+            with _dpxtrace.span("backward"):
+                (loss, metrics), grads = vg(params, batch)
+                gleaves, gtree = jax.tree_util.tree_flatten(grads)
+            pleaves = jax.tree_util.tree_leaves(params)
+            groups = _partition_contiguous([l.size for l in gleaves],
+                                           n_buckets)
+            # a LIST specifically: optimizer states are NamedTuples/
+            # dicts/bare tuples, so requiring the exact container
+            # init_opt_state returns keeps a full-tree state from ever
+            # being indexed as per-bucket states (an AdamWState IS a
+            # 3-tuple — a len check alone can collide with a 3-bucket
+            # partition)
+            if not isinstance(opt_state, list) \
+                    or len(opt_state) != len(groups):
+                raise TypeError(
+                    "the overlapped host step keeps PER-BUCKET "
+                    "optimizer states — build opt_state with "
+                    "step.init_opt_state(params), not optimizer.init")
+            bits = chooser.width if chooser is not None else (width or 8)
+            new_p = [None] * len(gleaves)
+            new_states = [None] * len(groups)
+            pending = []   # dispatched, unfenced update outputs
+            reduced = []
+            for b, idx in enumerate(groups):
+                flat = np.concatenate(
+                    [np.asarray(gleaves[i], dtype=np.float32).ravel()
+                     for i in idx])
+                if on_bucket_ready is not None:
+                    on_bucket_ready(b, len(groups), flat.nbytes)
+                hidden = _outstanding(pending)
+                # the bucket span carries the MEASURED overlap verdict
+                # (hidden = a prior bucket's update was genuinely still
+                # executing at comm-issue time); the wire span nests
+                # inside via CommStats.timed
+                with _dpxtrace.span("bucket", b=b,
+                                    nbytes=flat.nbytes, bits=bits,
+                                    hidden=hidden):
+                    flat = _reduce_bucket(b, flat, bits, hidden)
+                reduced.append(flat)
+                g_sub, off = [], 0
+                for i in idx:
+                    n = gleaves[i].size
+                    g_sub.append(jnp.asarray(
+                        flat[off:off + n].reshape(gleaves[i].shape),
+                        dtype=gleaves[i].dtype))
+                    off += n
+                # dispatch this bucket's update and DON'T fence it: the
+                # device chews on it while the next bucket's ring
+                # traffic blocks the control thread — that concurrency
+                # is what the is_ready probe above measures into
+                # overlapped_s
+                with _dpxtrace.span("update", b=b):
+                    out_p, out_state = upd(g_sub, opt_state[b],
+                                           [pleaves[i] for i in idx])
+                pending.extend(out_p)
+                for j, i in enumerate(idx):
+                    new_p[i] = out_p[j]
+                new_states[b] = out_state
+            _observe(reduced)
+            params = jax.tree_util.tree_unflatten(gtree, new_p)
         return StepOutput(params, new_states,
                           jnp.asarray(loss)[None], metrics)
 

@@ -302,6 +302,22 @@ register("DPX_REMAT", "str", "none",
 register("DPX_METRICS_LOG", "str", None,
          "Line-JSON file receiving structured events (worker failures, "
          "ckpt saves, schedule digests) from every rank and supervisor.")
+register("DPX_TRACE", "bool", False,
+         "Enable dpxtrace span recording (obs/trace.py): comm ops, the "
+         "host train step, the serve request lifecycle and ckpt phases "
+         "emit trace_span events + feed the per-rank flight recorder "
+         "(docs/observability.md). Off = near-zero overhead, gated in "
+         "the bench smoke.")
+register("DPX_TRACE_RING", "int", 256,
+         "Flight-recorder capacity in spans: the bounded per-process "
+         "ring whose last-N spans every typed failure path dumps as a "
+         "flight_recorder event (0 disables the ring; drops are "
+         "counted, never silent).")
+register("DPX_TRACE_LOG", "str", None,
+         "Span sink path for trace_span events (default: the "
+         "DPX_METRICS_LOG stream, so spans ride the same multi-writer "
+         "line-JSON channel as failure events; tools/dpxtrace.py "
+         "merges and exports them).")
 
 # -- faults / elastic -------------------------------------------------------
 register("DPX_FAULT", "str", None,

@@ -260,7 +260,17 @@ def _fire(spec: FaultSpec, site: str, rank: Optional[int], comm) -> None:
     _log.append(f"{spec.action}@{site}")
     print(f"# fault-injection: {spec.action} firing at {site} "
           f"(rank {rank})", file=sys.stderr, flush=True)
+    # annotate the injection on the trace timeline (obs/trace.py): the
+    # chaos campaign's "what was injected, where" lands next to the
+    # spans it perturbs, so a postmortem needs no spec cross-reference
+    from ..obs import trace as _dpxtrace
+    _dpxtrace.event("fault_injected", action=spec.action, site=site,
+                    rank=rank)
     if spec.action == "kill":
+        # the dying rank ships its own postmortem timeline BEFORE the
+        # hard exit — survivors dump from their typed failure paths,
+        # this is the victim's last word (best-effort; os._exit next)
+        _dpxtrace.flight_dump("fault_kill", rank=rank, site=site)
         os._exit(KILL_EXIT_CODE)  # hard death: no cleanup, like SIGKILL
     elif spec.action == "delay":
         time.sleep((spec.ms or 0) / 1000.0)

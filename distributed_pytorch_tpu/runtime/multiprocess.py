@@ -145,6 +145,12 @@ def launch_multiprocess(worker_fn: Callable, nprocs: int, *args,
             append_event("worker_failure", rank=e.rank, op=e.op,
                          kind=e.kind, exitcode=e.exitcode, world=nprocs,
                          tag=tag)
+            # flight recorder (obs/trace.py): if this supervisor process
+            # traced any spans, ship them with the failure — no-op when
+            # the ring is empty (the common supervisor case; each rank
+            # process ships its own timeline from its typed error path)
+            from ..obs import trace as _dpxtrace
+            _dpxtrace.on_typed_failure(e)
             # schedule verifier: when the dying ranks flushed divergent
             # collective schedules, name the odd rank/op/seq alongside
             # the timeout instead of leaving a bare CommTimeout

@@ -235,7 +235,12 @@ class HostComm:
         from . import faults as _faults
         from ..analysis.schedule import RankSchedule
         from ..comm import wire as _wire
+        from ..obs import trace as _dpxtrace
         from ..utils.profiler import CommStats
+
+        self._dpxtrace = _dpxtrace
+        # every span this process records from here on is rank-attributed
+        _dpxtrace.set_rank(rank)
 
         self._wire = _wire
         self._faults = _faults
@@ -314,20 +319,26 @@ class HostComm:
         where = f"(rank {self.rank}, op {what}"
         where += f", peer {peer})" if peer >= 0 else ")"
         if rc == _RC_PEER_CLOSED:
-            raise CommPeerDied(
+            exc = CommPeerDied(
                 f"peer closed connection mid-collective {where}",
                 op=what, rank=self.rank, peer=peer)
-        if rc == _RC_TIMEOUT:
-            raise CommTimeout(
+        elif rc == _RC_TIMEOUT:
+            exc = CommTimeout(
                 f"deadline {self.op_timeout_ms}ms exceeded {where}",
                 op=what, rank=self.rank, peer=peer,
                 deadline_ms=self.op_timeout_ms)
-        if rc == _RC_CORRUPT:
-            raise CommCorrupt(
+        elif rc == _RC_CORRUPT:
+            exc = CommCorrupt(
                 f"framed quant payload failed CRC32 {where}",
                 op=what, rank=self.rank, peer=peer)
-        raise CommError(f"native {what} failed {where} rc={rc}",
-                        op=what, rank=self.rank, peer=peer)
+        else:
+            exc = CommError(f"native {what} failed {where} rc={rc}",
+                            op=what, rank=self.rank, peer=peer)
+        # flight recorder: the last-N spans of this rank's timeline ride
+        # out alongside the typed error (obs/trace.py) — the postmortem
+        # every chaos survivor ships; best-effort, never masks `exc`
+        self._dpxtrace.on_typed_failure(exc)
+        raise exc
 
     def allreduce(self, arr: np.ndarray, op: str = "sum",
                   hidden: bool = False) -> np.ndarray:

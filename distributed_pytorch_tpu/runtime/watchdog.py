@@ -263,6 +263,7 @@ class HeartbeatMonitor:
         out = []
         for rank in range(self.world_size):
             last = beats.get(rank, self.start_time)
+            # dpxlint: disable=DPX007 cross-process staleness: compares wall stamps WRITTEN BY OTHER RANKS' beat() — monotonic clocks don't align across processes
             if now - last > timeout_s:
                 out.append(rank)
         return out

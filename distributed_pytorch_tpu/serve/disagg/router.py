@@ -37,10 +37,11 @@ import jax
 import numpy as np
 
 from ...models.generate import _check_attn_compatible, _model_window
+from ...obs import trace as dpxtrace
 from ...runtime import env as dpxenv
 from ...utils.logging import MetricsLogger
 from ..engine import _default_buckets
-from ..metrics import request_record
+from ..metrics import emit_request_trace, request_record
 from ..scheduler import AdmissionScheduler
 from ..types import (FAILED, FINISHED, AdmissionRejected, EngineStopped,
                      HandoffCorrupt, HandoffTimeout, PrefillEngineDied,
@@ -196,7 +197,8 @@ class DisaggEngine:
                       deadline_t=(now + sp.deadline_ms / 1e3
                                   if sp.deadline_ms is not None
                                   else None),
-                      on_token=on_token, stage="prefill_queue")
+                      on_token=on_token, stage="prefill_queue",
+                      trace_id=dpxtrace.new_trace_id())
         req.handle = RequestHandle(req)
         with self._lock:
             if self._stop:
@@ -291,6 +293,7 @@ class DisaggEngine:
         req.handle.metrics = rec
         if self.metrics is not None:
             self.metrics.event("serve_request", **rec)
+        emit_request_trace(req, "ok")
         req.handle.future.set_result(
             np.asarray(req.out_tokens, np.int32))
 
@@ -304,6 +307,12 @@ class DisaggEngine:
         req.handle.metrics = rec
         if self.metrics is not None:
             self.metrics.event("serve_request", **rec)
+        emit_request_trace(req, outcome)
+        from ..types import HandoffError, PagePoolExhausted
+        if isinstance(exc, (HandoffError, PagePoolExhausted)):
+            # infra-failure postmortem (obs/trace.py): the split's
+            # recent span timeline rides out with the typed error
+            dpxtrace.on_typed_failure(exc)
         req.handle.future.set_exception(exc)
 
     def fail_queued_deadline(self, req: Request) -> None:

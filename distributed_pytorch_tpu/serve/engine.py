@@ -38,11 +38,12 @@ import numpy as np
 
 from ..models.generate import (_check_attn_compatible, _model_window,
                                _sample)
+from ..obs import trace as dpxtrace
 from ..runtime import env as dpxenv
 from ..runtime import faults
 from ..utils.logging import MetricsLogger
 from .cache import SlotPool
-from .metrics import request_record
+from .metrics import emit_request_trace, request_record
 from .pages import PagedSlotPool
 from .scheduler import AdmissionScheduler
 from .types import (FAILED, FINISHED, QUEUED, RUNNING, AdmissionRejected,
@@ -199,7 +200,8 @@ class InferenceEngine:
                       submit_t=now,
                       deadline_t=(now + sp.deadline_ms / 1e3
                                   if sp.deadline_ms is not None else None),
-                      on_token=on_token)
+                      on_token=on_token,
+                      trace_id=dpxtrace.new_trace_id())
         req.handle = RequestHandle(req)
         # enqueue under the same lock the stop flag lives behind: a
         # submit that races shutdown either sees _stop and raises, or
@@ -497,6 +499,7 @@ class InferenceEngine:
         req.handle.metrics = rec
         if self.metrics is not None:
             self.metrics.event("serve_request", **rec)
+        emit_request_trace(req, "ok")
         req.handle.future.set_result(
             np.asarray(req.out_tokens, np.int32))
 
@@ -509,6 +512,11 @@ class InferenceEngine:
         req.handle.metrics = rec
         if self.metrics is not None:
             self.metrics.event("serve_request", **rec)
+        emit_request_trace(req, outcome)
+        if isinstance(exc, PagePoolExhausted):
+            # infra-failure postmortem: ship the engine's recent span
+            # timeline with the typed error (obs/trace.py, best-effort)
+            dpxtrace.on_typed_failure(exc)
         req.handle.future.set_exception(exc)
 
     def _drain_on_stop(self) -> None:

@@ -26,8 +26,10 @@ land in the same stream as training metrics and failure events.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
+from ..obs import trace as _dpxtrace
 from .types import Request
 
 
@@ -41,6 +43,7 @@ def request_record(req: Request, outcome: str) -> Dict:
         if n > 1 and req.last_token_t is not None:
             tpot_ms = (req.last_token_t - req.first_token_t) * 1e3 / (n - 1)
     rec = {"request_id": req.request_id, "outcome": outcome,
+           "trace_id": req.trace_id,
            "prompt_len": int(len(req.prompt)), "n_tokens": n,
            "ttft_ms": ttft_ms, "tpot_ms": tpot_ms,
            "queue_ms": ((req.admit_t - req.submit_t) * 1e3
@@ -65,6 +68,55 @@ def request_record(req: Request, outcome: str) -> Dict:
                             and req.first_token_t is not None else None)
         rec["handoff_bytes"] = req.handoff_bytes
     return rec
+
+
+def emit_request_trace(req: Request, outcome: str) -> None:
+    """Synthesize the request's dpxtrace span tree at retirement
+    (obs/trace.py; no-op unless ``DPX_TRACE``).
+
+    The lifecycle timestamps the engines already stamp on the
+    :class:`~.types.Request` (``submit_t``/``admit_t``/
+    ``handoff_send_t``/``handoff_recv_t``/``first_token_t``/
+    ``last_token_t``, all ``time.monotonic``) become one span tree
+    under a root ``serve.request`` carrying the request's ONE
+    ``trace_id`` — so a disaggregated request renders as a single
+    connected timeline across the prefill→handoff→decode split, and
+    the child spans are BY CONSTRUCTION the TTFT decomposition this
+    module's :func:`request_record` asserts (queue + prefill + handoff
+    + decode telescope to ``first_token_t - submit_t``)."""
+    if not _dpxtrace.enabled():
+        return
+    w = _dpxtrace.wall_from_mono
+    now = time.monotonic()
+    root = _dpxtrace.emit_span(
+        "serve.request", w(req.submit_t), w(now),
+        trace_id=req.trace_id, request_id=req.request_id,
+        outcome=outcome, n_tokens=len(req.out_tokens),
+        prompt_len=int(len(req.prompt)))
+    spans = []
+    if req.admit_t is not None:
+        spans.append(("serve.queue", req.submit_t, req.admit_t))
+        if req.handoff_send_t is not None:
+            # the disagg decomposition: prefill → handoff → decode
+            spans.append(("serve.prefill", req.admit_t,
+                          req.handoff_send_t))
+            if req.handoff_recv_t is not None:
+                spans.append(("serve.handoff", req.handoff_send_t,
+                              req.handoff_recv_t))
+                if req.first_token_t is not None:
+                    spans.append(("serve.decode", req.handoff_recv_t,
+                                  req.first_token_t))
+        elif req.first_token_t is not None:
+            # monolithic: admission prefill + first sample, one leg
+            spans.append(("serve.prefill", req.admit_t,
+                          req.first_token_t))
+    if (req.first_token_t is not None and req.last_token_t is not None
+            and len(req.out_tokens) > 1):
+        spans.append(("serve.stream", req.first_token_t,
+                      req.last_token_t))
+    for name, t0, t1 in spans:
+        _dpxtrace.emit_span(name, w(t0), w(t1), trace_id=req.trace_id,
+                            parent_id=root, request_id=req.request_id)
 
 
 def percentile(xs: List[float], q: float) -> Optional[float]:

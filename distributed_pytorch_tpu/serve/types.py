@@ -194,6 +194,11 @@ class Request:
     #: coarse lifecycle location for the disagg router's failure
     #: attribution: "prefill_queue" | "prefill" | "handoff" | "decode"
     stage: Optional[str] = None
+    #: dpxtrace lineage (obs/trace.py): ONE trace id assigned at submit
+    #: that every lifecycle span carries — across the monolithic engine
+    #: thread AND across the disagg prefill→handoff→decode split, so a
+    #: request renders as one connected timeline (docs/observability.md)
+    trace_id: Optional[str] = None
 
     @property
     def done(self) -> bool:

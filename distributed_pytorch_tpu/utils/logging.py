@@ -8,9 +8,9 @@ import json
 import os
 import sys
 import threading
-import time
 from typing import Any, Dict, Optional
 
+from ..obs import trace as _dpxtrace
 from ..runtime import context
 from ..runtime import env as _env
 
@@ -36,11 +36,17 @@ def append_event(event: str, path: Optional[str] = None, **fields: Any
     stream, so each record is emitted as a single O_APPEND write under a
     process-local lock (one ``write`` per line keeps lines intact across
     processes too — POSIX appends of this size don't interleave).
+
+    Timestamps are ``obs.trace.wall_now()`` — the process wall anchor
+    plus elapsed ``perf_counter_ns`` — so within one process, event
+    times are MONOTONE NON-DECREASING even when the system clock steps
+    (``time.time()`` per event could order a later record earlier; the
+    schedule verifier and dpxtrace joins both sort by time).
     """
     path = path or _env.get(METRICS_LOG_ENV)
     if not path:
         return False
-    rec = {"event": event, "time": time.time(), **fields}
+    rec = {"event": event, "time": _dpxtrace.wall_now(), **fields}
     data = (json.dumps(rec, default=str) + "\n").encode()
     try:
         with _event_lock:
@@ -86,7 +92,8 @@ class MetricsLogger:
     def log(self, step: int, **metrics: Any) -> None:
         if not is_primary():
             return
-        rec: Dict[str, Any] = {"step": step, "time": time.time(), **metrics}
+        rec: Dict[str, Any] = {"step": step,
+                               "time": _dpxtrace.wall_now(), **metrics}
         line = json.dumps(rec, default=float)
         with self._lock:
             if self._fh is not None:
@@ -102,8 +109,8 @@ class MetricsLogger:
         """Structured non-step event (failure, relaunch, resume) into the
         same line-JSON stream; written on EVERY rank — failures are
         precisely the records the primary may not live to write."""
-        rec: Dict[str, Any] = {"event": event, "time": time.time(),
-                               **fields}
+        rec: Dict[str, Any] = {"event": event,
+                               "time": _dpxtrace.wall_now(), **fields}
         line = json.dumps(rec, default=str)
         with self._lock:
             if self._fh is not None:

@@ -27,6 +27,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from ..obs import trace as _dpxtrace
+
 
 # ---------------------------------------------------------------------------
 # device traces (XPlane / XProf)
@@ -103,12 +105,18 @@ class CommStats:
     @contextlib.contextmanager
     def timed(self, op: str, nbytes: int, hidden: bool = False):
         """Time a collective and record its wire bytes; also emits a
-        trace annotation so the op shows on XProf timelines. ``hidden``
+        trace annotation so the op shows on XProf timelines, and — with
+        ``DPX_TRACE`` on — a dpxtrace span (obs/trace.py), which is how
+        EVERY comm op (quantized/hier ring legs, the disagg
+        handoff_send/recv transport included) lands on the cross-rank
+        timeline with its overlapped-vs-exposed attribution. ``hidden``
         routes the wall time into the overlapped (vs exposed) bucket."""
         t0 = time.perf_counter()
         try:
             with annotate(f"comm:{op}"):
-                yield
+                with _dpxtrace.span(f"comm:{op}", bytes=int(nbytes),
+                                    hidden=hidden):
+                    yield
         finally:
             self.record(op, nbytes, time.perf_counter() - t0,
                         hidden=hidden)

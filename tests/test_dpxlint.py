@@ -251,6 +251,100 @@ class TestRules:
         assert _lint_snippet(tmp_path, waived) == []
 
 
+    def test_dpx007_time_time_duration_pattern(self, tmp_path):
+        bad = """
+            import time
+
+            def f():
+                t0 = time.time()
+                work()
+                return time.time() - t0
+        """
+        rules = _rules(_lint_snippet(tmp_path, bad))
+        # both the direct-call subtraction and the tainted-name operand
+        # are the same BinOp — one finding
+        assert rules == ["DPX007"]
+
+    def test_dpx007_attribute_taint_across_methods(self, tmp_path):
+        bad = """
+            import time
+
+            class Monitor:
+                def __init__(self):
+                    self.start_time = time.time()
+
+                def elapsed(self):
+                    now = time.time()
+                    return now - self.start_time
+        """
+        assert "DPX007" in _rules(_lint_snippet(tmp_path, bad))
+
+    def test_dpx007_aliased_from_import(self, tmp_path):
+        bad = """
+            from time import time as now
+
+            def f():
+                t0 = now()
+                return now() - t0
+        """
+        assert "DPX007" in _rules(_lint_snippet(tmp_path, bad))
+
+    def test_dpx007_perf_counter_and_plain_wall_ok(self, tmp_path):
+        good = """
+            import time
+
+            STAMP = time.time()   # a single wall stamp: not a duration
+
+            def f():
+                t0 = time.perf_counter()
+                work()
+                dt = time.perf_counter() - t0
+                ns = time.perf_counter_ns() - 5
+                return dt, ns, time.time()
+        """
+        assert _lint_snippet(tmp_path, good) == []
+
+    def test_dpx007_no_cross_function_taint_leak(self, tmp_path):
+        # one function's (waived) wall-clock name must NOT taint a
+        # sibling function's perf_counter duration math through the
+        # module-level pass — the baseline-ZERO gate lives on no
+        # false positives
+        good = """
+            import time
+
+            def wall_site(last):
+                start = time.time()
+                # dpxlint: disable=DPX007 cross-process comparison
+                return start - last
+
+            def timed():
+                start = time.perf_counter()
+                end = time.perf_counter()
+                return end - start
+        """
+        assert _lint_snippet(tmp_path, good) == []
+
+    def test_dpx007_scoped_to_package_and_waivable(self, tmp_path):
+        outside = """
+            import time
+
+            def f():
+                t0 = time.time()
+                return time.time() - t0
+        """
+        assert _lint_snippet(tmp_path, outside,
+                             rel="benchmarks/mod.py") == []
+        waived = """
+            import time
+
+            def staleness(last_beat):
+                now = time.time()
+                # dpxlint: disable=DPX007 cross-process wall comparison
+                return now - last_beat
+        """
+        assert _lint_snippet(tmp_path, waived) == []
+
+
 class TestAllowlist:
     def test_inline_disable_same_line_and_line_above(self, tmp_path):
         src = """

@@ -1,0 +1,202 @@
+"""dpxtrace CLI — merge, export, summarize and police the cross-rank
+span logs (obs/ — docs/observability.md).
+
+Usage::
+
+    python -m tools.dpxtrace export LOG [LOG ...] -o trace.json
+                                        # merged Chrome trace-event JSON
+                                        # (chrome://tracing / Perfetto)
+    python -m tools.dpxtrace merge LOG [LOG ...] -o merged.jsonl
+                                        # concatenate per-rank line-JSON
+                                        # logs (validated, line-attributed)
+    python -m tools.dpxtrace summarize LOG [LOG ...]
+                                        # per-op per-rank duration table
+    python -m tools.dpxtrace stragglers LOG [LOG ...] [--k 3.0]
+                                        # ranks outside k*IQR per op
+    python -m tools.dpxtrace check LOG  # strict metrics-log validator:
+                                        # malformed lines (with line
+                                        # numbers), unknown event names,
+                                        # rank-unattributed failure
+                                        # events; exit 1 on any issue
+
+``--check LOG`` is accepted as an alias for the ``check`` subcommand.
+
+Exit codes: 0 = ok, 1 = issues found (check) / stragglers flagged with
+``--fail-on-straggler``, 2 = usage or unreadable input.
+
+Like ``tools/dpxlint.py`` and ``tools/benchdiff.py``, this deliberately
+avoids the heavy package ``__init__`` (which pulls jax): the obs and
+perfbench modules are stdlib-only and load against fabricated
+lightweight parent packages, so the CLI runs in a bare venv in
+milliseconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load_obs():
+    """Import ``distributed_pytorch_tpu.obs``: the REAL package first
+    (in-process test use), else fabricated lightweight parents so the
+    stdlib-only obs/perfbench modules resolve against the source tree
+    (the benchdiff loader contract)."""
+    import importlib
+    import types
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    try:
+        return importlib.import_module("distributed_pytorch_tpu.obs")
+    except Exception:  # noqa: BLE001 — bare venv: the __init__ chain needs jax
+        pass
+    pkg_dir = os.path.join(root, "distributed_pytorch_tpu")
+    for name, sub in (("distributed_pytorch_tpu", ""),
+                      ("distributed_pytorch_tpu.runtime", "runtime"),
+                      ("distributed_pytorch_tpu.utils", "utils")):
+        if name not in sys.modules:
+            pkg = types.ModuleType(name)
+            pkg.__path__ = [os.path.join(pkg_dir, sub) if sub
+                            else pkg_dir]
+            sys.modules[name] = pkg
+    return importlib.import_module("distributed_pytorch_tpu.obs")
+
+
+def _read_all(obs, paths):
+    """(records, malformed-with-path) across the given logs, in path
+    order then line order — the merge."""
+    records, malformed = [], []
+    for path in paths:
+        try:
+            recs, bad = obs.export.read_log(path)
+        except OSError as e:
+            print(f"dpxtrace: cannot read {path}: {e}", file=sys.stderr)
+            raise SystemExit(2)
+        for r in recs:
+            r["_path"] = path
+        records.extend(recs)
+        malformed.extend((path, ln, why) for ln, why in bad)
+    return records, malformed
+
+
+def _fmt_table(rows, cols):
+    if not rows:
+        return "(no spans)"
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in cols}
+    head = "  ".join(c.ljust(widths[c]) for c in cols)
+    sep = "  ".join("-" * widths[c] for c in cols)
+    body = "\n".join("  ".join(str(r.get(c, "")).ljust(widths[c])
+                               for c in cols) for r in rows)
+    return "\n".join([head, sep, body])
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # --check LOG alias (the ISSUE-facing spelling)
+    if argv and argv[0] == "--check":
+        argv = ["check"] + argv[1:]
+
+    ap = argparse.ArgumentParser(prog="dpxtrace", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name in ("export", "merge", "summarize", "stragglers", "check"):
+        p = sub.add_parser(name)
+        p.add_argument("logs", nargs="+",
+                       help="line-JSON metrics/span log(s)")
+        if name in ("export", "merge"):
+            p.add_argument("-o", "--out", default="-",
+                           help="output file (default: stdout)")
+        if name == "export":
+            p.add_argument("--no-align", action="store_true",
+                           help="skip cross-rank clock alignment")
+        if name == "stragglers":
+            p.add_argument("--k", type=float, default=None,
+                           help="IQR multiplier (default 3.0)")
+            p.add_argument("--fail-on-straggler", action="store_true",
+                           help="exit 1 when any rank is flagged")
+    args = ap.parse_args(argv)
+
+    obs = _load_obs()
+    records, malformed = _read_all(obs, args.logs)
+
+    if args.cmd == "check":
+        issues = []
+        for path, ln, why in malformed:
+            issues.append(f"{path}:{ln}: malformed line: {why}")
+        for rec in records:
+            found = obs.export.check_log([rec], [])
+            for ln, msg in found:
+                issues.append(f"{rec.get('_path')}:{ln}: {msg}")
+        for msg in issues:
+            print(msg)
+        if issues:
+            print(f"dpxtrace check: {len(issues)} issue(s)",
+                  file=sys.stderr)
+            return 1
+        print(f"dpxtrace check: clean ({len(records)} record(s) across "
+              f"{len(args.logs)} log(s))")
+        return 0
+
+    for path, ln, why in malformed:
+        print(f"# dpxtrace: skipping malformed line {path}:{ln}: {why}",
+              file=sys.stderr)
+
+    if args.cmd == "merge":
+        out = (sys.stdout if args.out == "-"
+               else open(args.out, "w", encoding="utf-8"))
+        try:
+            for rec in records:
+                rec = {k: v for k, v in rec.items()
+                       if k not in ("_line", "_path")}
+                out.write(json.dumps(rec, default=str) + "\n")
+        finally:
+            if out is not sys.stdout:
+                out.close()
+        print(f"# dpxtrace: merged {len(records)} record(s)",
+              file=sys.stderr)
+        return 0
+
+    if args.cmd == "export":
+        trace = obs.export.chrome_trace(records,
+                                        align=not args.no_align)
+        text = json.dumps(trace, default=str)
+        if args.out == "-":
+            print(text)
+        else:
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(text)
+            n = trace["otherData"]["n_spans"]
+            print(f"# dpxtrace: wrote {n} span(s) to {args.out}",
+                  file=sys.stderr)
+        return 0
+
+    spans = obs.export.collect_spans(records)
+    if args.cmd == "summarize":
+        rows = obs.detect.summarize_ops(spans)
+        print(_fmt_table(rows, ("op", "rank", "count", "median_ms",
+                                "iqr_ms", "total_ms")))
+        return 0
+
+    # stragglers
+    found = obs.detect.stragglers(spans, k=args.k)
+    if not found:
+        print("dpxtrace: no stragglers flagged")
+        return 0
+    print(_fmt_table(found, ("op", "rank", "median_ms",
+                             "world_median_ms", "threshold_ms",
+                             "excess_x", "n_ranks")))
+    return 1 if args.fail_on_straggler else 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        # `dpxtrace summarize | head` is a legitimate spelling — exit
+        # quietly on a closed pipe instead of tracebacking
+        import os as _os
+        _os.close(2)
+        raise SystemExit(0)
