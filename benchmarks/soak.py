@@ -1,0 +1,336 @@
+"""The composed soak harness — ``bench.py --soak`` (ROADMAP item 5's
+on-ramp; docs/observability.md "Live monitoring & soak").
+
+Every scaling feature existed only in isolation until now; this arm is
+the first that runs them COMPOSED as one long-lived job and gates the
+result on live telemetry instead of a post-hoc trace merge:
+
+* **the composed step** — host front door at ``DPX_SOAK_WORLD`` (one OS
+  process per rank), ``grad_reduce="adaptive"`` (per-bucket q4/q8
+  WidthChooser) over the TWO-LEVEL hierarchical ring
+  (``DPX_HIER_RING=2``) with bucketed comm/update OVERLAP
+  (``overlap=True``) — hier × adaptive × overlap in one step. The
+  ZeRO-1 sharded weight UPDATE is wire-incompatible with the adaptive
+  chooser by the documented front-door contract (its gather-leg error
+  feedback owns the fixed q8 grid — docs/front_door.md), so the
+  composition's "sharded" leg is the SHARDED ELASTIC CHECKPOINT: every
+  rank writes only the shards it owns (``CheckpointManager
+  (sharded=True)``, format 2) and the elastic relaunch restores from
+  it mid-campaign.
+* **chaos + elastic** — ``DPX_FAULT`` kills a rank mid-run on attempt
+  0; ``elastic_run`` reaps the world and relaunches; the relaunch
+  resumes from the sharded checkpoint and finishes.
+* **live telemetry + gating** — every rank's instrumented step emits
+  rank-attributed ``metrics_snapshot`` events on the ``DPX_MON_EVERY``
+  cadence (comm bytes/exposed-vs-overlapped via the CommStats
+  provider, step cadence, RSS, ckpt phase durations, flight-recorder
+  drops); a live :class:`~distributed_pytorch_tpu.obs.health
+  .HealthMonitor` follows the log from the supervisor and lands
+  ``health_transition`` events as they happen (the kill shows as
+  ok → degraded, the resumed snapshots as degraded → ok). The arm's
+  verdict IS dpxmon's: ``tools/dpxmon.py replay`` must validate every
+  snapshot strictly and exit 0, ``tools/dpxtrace.py check`` must hold
+  the event vocabulary, the degraded → recovered transitions must be
+  present with rank+rule attribution — and a seeded SLO-violation log
+  must make dpxmon exit 1 (the gate can fail, so its green means
+  something).
+
+``--smoke`` pins a seconds-scale configuration (the CI soak-smoke
+step); the full arm takes ``DPX_SOAK_STEPS`` / ``DPX_SOAK_SECONDS``
+for hours-long runs with the same machinery.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# smoke shape: world 4 (2 "hosts" x 2 ranks), kill rank 1 mid-run on
+# attempt 0, resume from the sharded ckpt and finish
+SMOKE_STEPS = 24
+SMOKE_KILL_STEP = 12
+CKPT_EVERY = 4
+HIER_LOCAL = 2
+MON_EVERY = 2
+
+#: The seeded-violation rule dpxmon's default set must catch: pool
+#: occupancy pinned above the 0.98 saturation ceiling long enough to
+#: escalate ok -> degraded -> critical.
+_SEEDED_METRIC = "serve.pool_occupancy"
+
+
+def _progress(msg: str) -> None:
+    print(f"# soak: {msg}", file=sys.stderr, flush=True)
+
+
+def _soak_worker(rank: int, world: int, workdir: str, steps: int,
+                 seconds: float) -> None:
+    """One rank of the composed arm (module-level: spawn-picklable)."""
+    import jax
+    import numpy as np
+
+    import distributed_pytorch_tpu as dist
+    from distributed_pytorch_tpu import models, optim
+    from distributed_pytorch_tpu.ckpt import CheckpointManager
+    from distributed_pytorch_tpu.ops.losses import cross_entropy
+    from distributed_pytorch_tpu.parallel import (fsdp_param_specs,
+                                                  make_train_step)
+    from distributed_pytorch_tpu.runtime import faults
+    from distributed_pytorch_tpu.utils.checkpoint import (
+        latest_step, restore_checkpoint)
+    from jax.sharding import PartitionSpec as P
+
+    dist.init_process_group(rank, world)
+    try:
+        model = models.DummyModel(in_dim=16, hidden_dim=128, n_classes=8)
+        opt = optim.adamw(1e-3)
+
+        def loss_fn(p, batch):
+            x, y = batch
+            return cross_entropy(model.apply(p, x), y), {}
+
+        # hier x adaptive x overlap in ONE step (DPX_HIER_RING set by
+        # the harness env); per-bucket opt states from init_opt_state
+        step_fn = make_train_step(loss_fn, opt, grad_reduce="adaptive",
+                                  overlap=True, comm_buckets=2)
+        params = model.init(jax.random.PRNGKey(0))
+        opt_state = step_fn.init_opt_state(params)
+
+        # sharded elastic checkpointing: every rank writes only the
+        # shards it owns. Moment specs mirror the param specs (same
+        # shapes); scalar counters replicate (P()).
+        specs = fsdp_param_specs(params, world, min_size=64)
+        shape_spec = {np.shape(l): s for l, s in zip(
+            jax.tree_util.tree_leaves(params),
+            jax.tree_util.tree_leaves(specs))}
+        opt_specs = jax.tree_util.tree_map(
+            lambda x: shape_spec.get(np.shape(x), P()), opt_state)
+        ckdir = os.path.join(workdir, "ckpt")
+        start = 0
+        ck = None
+        if latest_step(ckdir) is not None:
+            ck = restore_checkpoint(ckdir, like_params=params,
+                                    like_opt_state=opt_state)
+            params, opt_state, start = ck.params, ck.opt_state, ck.step
+
+        rng = np.random.default_rng(7)
+        batches = [(rng.random((8, 16), dtype=np.float32),
+                    rng.integers(0, 8, size=(8,)).astype(np.int32))
+                   for _ in range(min(steps, 64))]
+        t_end = (time.monotonic() + seconds) if seconds else None
+        with CheckpointManager(ckdir, interval=CKPT_EVERY, keep=2,
+                               sharded=True, param_specs=specs,
+                               opt_specs=opt_specs,
+                               axis_sizes={"dp": world}) as mgr:
+            for s in range(start, steps):
+                faults.on_step(s, rank=rank)
+                out = step_fn(params, opt_state,
+                              batches[s % len(batches)])
+                params, opt_state = out.params, out.opt_state
+                mgr.save(s + 1, params, opt_state)
+                if t_end is not None and time.monotonic() >= t_end:
+                    break
+    finally:
+        dist.cleanup()
+
+
+def _soak_target(workdir: str, steps: int, seconds: float) -> None:
+    """The elastically supervised unit (module-level: spawn-picklable):
+    one full world launch of the composed arm."""
+    from distributed_pytorch_tpu.runtime import env as _env
+    from distributed_pytorch_tpu.runtime.multiprocess import (
+        launch_multiprocess)
+    launch_multiprocess(_soak_worker, int(_env.get("DPX_SOAK_WORLD")),
+                        workdir, steps, seconds)
+
+
+def _seed_violation_log(path: str) -> None:
+    """A synthetic SLO-violation stream: valid, rank-attributed
+    snapshots whose pool occupancy sits pinned above the default
+    saturation ceiling — the dpxmon replay over it MUST exit 1, or the
+    soak gate is a rubber stamp."""
+    from distributed_pytorch_tpu.obs import trace as _trace
+    with open(path, "w", encoding="utf-8") as f:
+        for i in range(6):
+            f.write(json.dumps({
+                "event": "metrics_snapshot",
+                "time": _trace.wall_now() + i,
+                "rank": 0, "step": i, "source": "seeded",
+                "metrics": {_SEEDED_METRIC: 0.999,
+                            "train.steps": i}}) + "\n")
+
+
+def _run_cli(module: str, args, timeout_s: int = 120):
+    proc = subprocess.run(
+        [sys.executable, "-m", module, *args],
+        capture_output=True, text=True, timeout=timeout_s,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return proc.returncode, proc.stdout + proc.stderr
+
+
+def run_soak(smoke: bool = False) -> int:
+    """Run the composed arm end to end; returns the exit code (0 =
+    every gate held). Prints one JSON summary line."""
+    from distributed_pytorch_tpu.obs import health
+    from distributed_pytorch_tpu.runtime import elastic
+    from distributed_pytorch_tpu.runtime import env as _env
+
+    seconds = float(_env.get("DPX_SOAK_SECONDS"))
+    steps = int(_env.get("DPX_SOAK_STEPS"))
+    if not steps:
+        # purely time-bounded runs must not be silently capped at the
+        # smoke's step count — the wall budget is the only bound then
+        steps = 10 ** 9 if seconds else SMOKE_STEPS
+    world = int(_env.get("DPX_SOAK_WORLD"))
+    workdir = tempfile.mkdtemp(prefix="dpx_soak_")
+    log = os.path.join(workdir, "soak_metrics.jsonl")
+    _progress(f"composed soak: world {world} (hier {HIER_LOCAL}x"
+              f"{world // HIER_LOCAL}), adaptive wire, overlap on, "
+              f"sharded ckpt every {CKPT_EVERY}; kill rank 1 at step "
+              f"{SMOKE_KILL_STEP} attempt 0; log {log}")
+
+    # live health following from the supervisor: transitions land as
+    # rank-attributed health_transition events WHILE the job runs.
+    # The live rule set keeps drift/growth evaluation real but damps
+    # this container's neighbor noise (floor=0.5: only a sustained
+    # 2x+ throughput collapse fires) — the DETERMINISTIC degraded
+    # signal the gates rely on is the built-in worker-failure rule
+    live_rules = health.parse_rules(
+        "drift(train.steps_per_sec)@k=3,floor=0.5;"
+        "growth(proc.rss_bytes)@window=8,grow=0.25")
+    monitor = health.HealthMonitor(live_rules, emit_path=log,
+                                   critical_after=5)
+    follower = health.LogFollower(log, monitor)
+    stop = threading.Event()
+
+    def _follow():
+        while not stop.is_set():
+            follower.poll()
+            stop.wait(0.5)
+
+    t = threading.Thread(target=_follow, name="dpx-soak-health",
+                         daemon=True)
+    t.start()
+
+    child_env = {
+        "DPX_METRICS_LOG": log,
+        "DPX_TRACE": "1",
+        "DPX_MON": "1",
+        "DPX_MON_EVERY": str(MON_EVERY),
+        "DPX_HIER_RING": str(HIER_LOCAL),
+        "DPX_FAULT": f"kill@step={SMOKE_KILL_STEP},rank=1,attempt=0",
+        "DPX_COMM_TIMEOUT_MS": "60000",
+    }
+    # the supervisor writes elastic/worker events into the same stream
+    saved = _env.snapshot(["DPX_METRICS_LOG"])
+    _env.set("DPX_METRICS_LOG", log)
+    t0 = time.perf_counter()
+    try:
+        res = elastic.elastic_run(_soak_target, (workdir, steps, seconds),
+                                  max_restarts=2, backoff_s=0.2,
+                                  env=child_env)
+    finally:
+        _env.restore(saved)
+        stop.set()
+        t.join(timeout=10)
+    follower.poll()   # drain the tail written after the last poll
+    wall_s = time.perf_counter() - t0
+    _progress(f"elastic run done in {wall_s:.1f}s: restarts="
+              f"{res.restarts} exitcodes={list(res.exitcodes)}")
+
+    failures = []
+
+    def gate(ok: bool, what: str) -> None:
+        # explicit checks, NOT assert (-O/PYTHONOPTIMIZE safe)
+        if not ok:
+            failures.append(what)
+            _progress(f"GATE FAILED: {what}")
+
+    gate(res.restarts >= 1, "the injected kill never caused a relaunch")
+    gate(res.exitcodes[-1] == 0, "the relaunched attempt did not finish")
+
+    # the LIVE monitor must have seen the failure degrade health and
+    # the resumed snapshots recover it — with rank+rule attribution
+    trs = monitor.transitions
+    degraded = [x for x in trs if x["to"] == "degraded"]
+    recovered = [x for x in trs
+                 if x["from"] == "degraded" and x["to"] == "ok"]
+    gate(bool(degraded), "no ok->degraded transition observed live")
+    gate(bool(recovered), "no degraded->ok (recovered) transition")
+    # the killed rank's failure must have breached the worker-failure
+    # stream (the monitor may already have been degraded by another
+    # rule when the event arrived — the stream audit, not the
+    # transition list, is the order-independent check) AND that stream
+    # must have recovered once the relaunched rank reported again
+    fail_streams = [s for s in monitor.stream_states()
+                    if s["rule"] == health.FAILURE_RULE
+                    and s["rank"] == 1]
+    gate(bool(fail_streams) and fail_streams[0]["total_breaches"] >= 1,
+         "the killed rank never breached the worker-failure rule")
+    gate(bool(fail_streams) and fail_streams[0]["state"] == "ok",
+         "the killed rank's failure stream never recovered after the "
+         "relaunch")
+
+    # dpxmon replay: strict snapshot validation + re-derived health
+    # trajectory over the whole log, exit 0 (the composed stack's
+    # health verdict)
+    rc, out = _run_cli("tools.dpxmon", ["replay", log])
+    gate(rc == 0, f"dpxmon replay over the soak log exited {rc}")
+    gate("degraded" in out, "dpxmon replay reports no degraded leg")
+
+    # the event vocabulary stays strict over soak logs (dpxtrace check)
+    rc2, out2 = _run_cli("tools.dpxtrace", ["check", log])
+    gate(rc2 == 0,
+         f"dpxtrace check over the soak log exited {rc2}: "
+         f"{out2.strip()[:300]}")
+
+    # the gate can FAIL: a seeded SLO-violation log must exit 1
+    seeded = os.path.join(workdir, "seeded_violation.jsonl")
+    _seed_violation_log(seeded)
+    rc3, out3 = _run_cli("tools.dpxmon", ["replay", seeded])
+    gate(rc3 == 1, f"seeded SLO-violation log exited {rc3}, wanted 1")
+    gate("CRITICAL" in out3.upper(),
+         "seeded replay did not report a critical verdict")
+
+    snapshots = monitor.snapshots_seen
+    summary = {
+        "soak": "composed",
+        "ok": not failures,
+        "world": world,
+        "steps": steps if steps < 10 ** 9 else None,
+        "seconds": seconds or None,
+        "wall_s": round(wall_s, 1),
+        "restarts": res.restarts,
+        "exitcodes": list(res.exitcodes),
+        "snapshots_evaluated": snapshots,
+        "transitions": [{k: x[k] for k in ("from", "to", "rule", "rank")}
+                        for x in trs],
+        "dpxmon_replay_rc": rc,
+        "dpxtrace_check_rc": rc2,
+        "seeded_violation_rc": rc3,
+        "log": log,
+        **({"failures": failures} if failures else {}),
+    }
+    print(json.dumps(summary))
+    if not failures and smoke:
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif failures:
+        _progress(f"artifacts kept for inspection: {workdir}")
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    smoke = "--smoke" in (sys.argv[1:] if argv is None else argv)
+    return run_soak(smoke=smoke)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

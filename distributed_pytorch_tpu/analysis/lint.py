@@ -46,6 +46,14 @@ carry attribution, threads are named. Each is now a machine-checked rule
   staleness against a timestamp another process wrote) are
   inline-waived with a reason; ``obs/trace.py``'s single anchor read
   is not a subtraction and does not trigger.
+* **DPX008** — ``append_event`` called with a literal event name
+  outside the registered ``KNOWN_EVENTS`` vocabulary
+  (``obs/export.py``). The strict validators (``dpxtrace check`` /
+  ``dpxmon check``) flag unknown names in the LOG; this rule catches
+  the typo at the write site, before a soak run ships a week of
+  invisible events. ``tests/`` are exempt (they stage unknown names to
+  test the validators). Register the name in ``KNOWN_EVENTS`` or waive
+  a deliberately-foreign stream with a reason.
 
 Suppression: append ``# dpxlint: disable=DPXnnn <reason>`` to the
 offending line (or the line above); ``# dpxlint: disable-file=DPXnnn
@@ -66,10 +74,11 @@ import re
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
+from ..obs.export import KNOWN_EVENTS
 from .schedule import FRONT_DOOR_SURFACE, NATIVE_OPS
 
 RULES = ("DPX001", "DPX002", "DPX003", "DPX004", "DPX005", "DPX006",
-         "DPX007")
+         "DPX007", "DPX008")
 
 #: DPX006: a jit call inside a function whose name matches this is a
 #: step/decode-builder site and must carry ``donate_argnums``.
@@ -211,6 +220,7 @@ class _FileChecker:
         self._check_thread_names(tree)         # DPX005
         self._check_jit_donation(tree)         # DPX006
         self._check_wall_clock_durations(tree)  # DPX007
+        self._check_event_vocabulary(tree)     # DPX008
         return self.findings
 
     # -- DPX001 ------------------------------------------------------------
@@ -572,6 +582,32 @@ class _FileChecker:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 check_scope(node)
         check_scope(tree, skip_defs=True)
+
+
+    # -- DPX008 ------------------------------------------------------------
+
+    def _check_event_vocabulary(self, tree: ast.Module) -> None:
+        """``append_event("name", ...)`` with a literal name outside
+        the ``KNOWN_EVENTS`` vocabulary (obs/export.py). Variable names
+        are out of scope (``MetricsLogger.event`` forwards its caller's
+        name — the caller's own literal is the checked site)."""
+        if self.rel.startswith("tests/"):
+            return  # tests stage unknown names to test the validators
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _call_name(node) == "append_event"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            name = node.args[0].value
+            if name not in KNOWN_EVENTS:
+                self._emit(
+                    "DPX008", node,
+                    f"append_event({name!r}) is outside the registered "
+                    f"KNOWN_EVENTS vocabulary (obs/export.py) — the "
+                    f"strict log validators would flag every line it "
+                    f"writes; register the name or waive with a reason")
 
 
 def _call_name(call: ast.Call) -> Optional[str]:

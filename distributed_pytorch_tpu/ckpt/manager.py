@@ -302,6 +302,17 @@ class CheckpointManager:
         rank, world = self._topo()
         with _dpxtrace.span("ckpt.commit", step=pend.step, rank=rank):
             self._barrier()  # every writer's fragment is durable
+            # dpxmon (obs/metrics.py): the ckpt phase durations land in
+            # every writer's own rank-attributed snapshot stream —
+            # blocking-save creep over a soak is a health signal
+            # (growth/ceiling rules), not just a post-hoc event field
+            from ..obs import metrics as _dpxmon
+            _dpxmon.inc("ckpt.saves")
+            _dpxmon.observe("ckpt.snapshot_ms",
+                            pend.io_stats.get("snapshot_s", 0.0) * 1e3)
+            if "duration_s" in pend.io_stats:
+                _dpxmon.observe("ckpt.io_ms",
+                                pend.io_stats["duration_s"] * 1e3)
             if rank == 0:
                 _mark("commit")
                 from ..utils import checkpoint as _ck

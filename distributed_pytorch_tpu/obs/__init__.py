@@ -1,5 +1,6 @@
 """obs — cross-rank observability: span tracing, flight recorder,
-Chrome-trace export, straggler detection (docs/observability.md).
+Chrome-trace export, straggler detection, live metrics + streaming SLO
+health (docs/observability.md).
 
 * :mod:`.trace`  — the span API + per-rank flight recorder every
   instrumented seam (comm ops, host train step, serve lifecycle, ckpt
@@ -10,20 +11,28 @@ Chrome-trace export, straggler detection (docs/observability.md).
   collective exits) + the metrics-log vocabulary/validator.
 * :mod:`.detect` — per-op per-rank duration medians, k·IQR straggler
   flagging (the ``perfbench/stats`` policy).
+* :mod:`.metrics` — the dpxmon live registry: typed counter/gauge/
+  histogram instruments, pull providers (CommStats, RSS, flight drops),
+  rank-attributed ``metrics_snapshot`` events on a cadence.
+* :mod:`.health` — streaming SLO evaluation over snapshot windows:
+  declarative rules (ceilings, drift-vs-trailing-median, monotone
+  growth), a typed ok→degraded→critical state machine with hysteresis,
+  ``health_transition`` events naming the firing rule and metric.
 
-CLI: ``python -m tools.dpxtrace`` (merge/export/summarize/stragglers/
-check) — stdlib-only, loads without the heavy package ``__init__``.
+CLIs: ``python -m tools.dpxtrace`` (merge/export/summarize/stragglers/
+check) and ``python -m tools.dpxmon`` (replay/follow/check) —
+stdlib-only, load without the heavy package ``__init__``.
 
 Every module here is stdlib-only with lazy cross-package imports, the
 ``analysis/lint.py`` contract.
 """
 
-from . import detect, export, trace  # noqa: F401
+from . import detect, export, health, metrics, trace  # noqa: F401
 from .trace import (enabled, event, flight_dump, flight_snapshot,  # noqa: F401
                     new_trace_id, on_typed_failure, span, wall_now)
 
 __all__ = [
-    "trace", "export", "detect",
+    "trace", "export", "detect", "metrics", "health",
     "span", "event", "enabled", "new_trace_id", "wall_now",
     "flight_dump", "flight_snapshot", "on_typed_failure",
 ]

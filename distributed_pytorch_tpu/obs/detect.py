@@ -11,10 +11,13 @@ collects:
   statistical policy every perf number already uses
   (``perfbench/stats.summarize`` — warmup semantics disabled here,
   spans are not benchmark trials);
-* per op name: the across-rank median and IQR of the rank medians; a
-  rank whose median lies above ``median + k·IQR`` (AND above a 5%
-  relative floor — µs-scale jitter on a quiet op must not page anyone)
-  is flagged a straggler.
+* per op name: each rank is fenced against the *other* ranks' medians
+  (leave-one-out): a rank whose median lies above
+  ``median(peers) + k·IQR(peers)`` (AND above a 5% relative floor —
+  µs-scale jitter on a quiet op must not page anyone) is flagged a
+  straggler.  The fence is leave-one-out because the pooled form is
+  degenerate at small n: in a 3-rank world one 90x outlier drags q75
+  toward itself and lifts the pooled fence above its own median.
 
 Stdlib-only; ``perfbench.stats`` is itself stdlib-only by contract, so
 the dpxtrace CLI runs this in a bare venv.
@@ -80,29 +83,33 @@ def summarize_ops(spans: Sequence[Dict[str, Any]]
 
 def stragglers(spans: Sequence[Dict[str, Any]], *,
                k: Optional[float] = None,
-               min_ranks: int = 2) -> List[Dict[str, Any]]:
+               min_ranks: int = 3) -> List[Dict[str, Any]]:
     """Flag (op, rank) pairs whose per-rank median duration lies outside
-    ``across-rank median + k·IQR`` (IQR over the rank medians), with the
-    5% relative floor. Ops seen on fewer than ``min_ranks`` ranks are
-    skipped — there is no "across ranks" to compare against."""
+    the leave-one-out fence ``median(peers) + k·IQR(peers)`` (peers =
+    the other ranks' medians for the same op), with the 5% relative
+    floor.  Ops seen on fewer than ``min_ranks`` ranks are skipped;
+    values below 3 are clamped to 3 — with fewer than two peers there
+    is no spread to fence against (a single-peer "IQR" is 0 and would
+    flag ANY gap), so two-rank worlds never produce a verdict."""
     st = _stats()
     k = IQR_K if k is None else float(k)
     findings: List[Dict[str, Any]] = []
     for name, by_rank in sorted(op_durations(spans).items()):
-        if len(by_rank) < min_ranks:
+        if len(by_rank) < max(min_ranks, 3):
             continue
         medians = {
             r: st.summarize(d, warmup=0,
                             max_spread=float("inf")).median
             for r, d in by_rank.items()}
-        pooled = sorted(medians.values())
-        med = st._quantile(pooled, 0.5)
-        iqr = st._quantile(pooled, 0.75) - st._quantile(pooled, 0.25)
-        if med <= 0:
-            continue
-        threshold = med + k * iqr
         for rank in sorted(medians, key=lambda r: (r is None, r)):
             m = medians[rank]
+            peers = sorted(v for r2, v in medians.items() if r2 != rank)
+            med = st._quantile(peers, 0.5)
+            iqr = (st._quantile(peers, 0.75)
+                   - st._quantile(peers, 0.25))
+            if med <= 0:
+                continue
+            threshold = med + k * iqr
             if m > threshold and (m - med) / med > REL_FLOOR:
                 findings.append({
                     "op": name, "rank": rank,

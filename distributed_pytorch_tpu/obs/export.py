@@ -55,6 +55,9 @@ KNOWN_EVENTS = frozenset({
     "bench_row",
     # observability (this subsystem)
     "trace_span", "flight_recorder", "fault_injected",
+    # dpxmon live monitoring (obs/metrics.py + obs/health.py): per-rank
+    # registry snapshots and the SLO state machine's transitions
+    "metrics_snapshot", "health_transition",
 })
 
 #: Failure-shaped events that MUST carry rank attribution — a failure

@@ -111,6 +111,9 @@ def make_host_sharded_train_step(loss_fn: Callable, optimizer: Optimizer,
                     rlo, rhi = layout.span(layout.ring_segment(r))
                     buf[rlo:rhi] = stacked[r]
         new_params = layout.unflatten_jnp(jnp.asarray(buf))
+        # dpxmon step hook (obs/metrics.py; one global read when off)
+        from ...obs import metrics as _dpxmon
+        _dpxmon.on_train_step("host_step_sharded")
         return StepOutput(new_params, new_state,
                           jnp.asarray(loss)[None], metrics)
 

@@ -267,6 +267,7 @@ def _make_host_train_step(loss_fn: Callable, optimizer: Optimizer,
     import numpy as np
 
     from ..comm import host_backend as _hb
+    from ..obs import metrics as _dpxmon
     from ..obs import trace as _dpxtrace
     from ..ops.quant import ErrorFeedback
     from ..runtime import env as _envmod
@@ -356,6 +357,10 @@ def _make_host_train_step(loss_fn: Callable, optimizer: Optimizer,
                 grads = jax.tree_util.tree_unflatten(tree, outs)
                 with _dpxtrace.span("update"):
                     params, opt_state = upd(grads, opt_state, params)
+            # dpxmon step hook (obs/metrics.py, one global read when
+            # off): steps counter + cadence histogram + the
+            # DPX_MON_EVERY snapshot auto-emission
+            _dpxmon.on_train_step("host_step")
             return StepOutput(params, opt_state,
                               jnp.asarray(loss)[None], metrics)
 
@@ -450,6 +455,7 @@ def _make_host_train_step(loss_fn: Callable, optimizer: Optimizer,
                 new_states[b] = out_state
             _observe(reduced)
             params = jax.tree_util.tree_unflatten(gtree, new_p)
+        _dpxmon.on_train_step("host_step")
         return StepOutput(params, new_states,
                           jnp.asarray(loss)[None], metrics)
 

@@ -67,6 +67,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import metrics as _dpxmon
 from ..optim import Optimizer
 from ..runtime import context
 from ..runtime.context import DATA_AXIS
@@ -239,7 +240,13 @@ class FrontDoorStep:
     # -- call ---------------------------------------------------------------
 
     def __call__(self, params, opt_state, batch):
-        return self._call(params, opt_state, batch)
+        out = self._call(params, opt_state, batch)
+        # dpxmon step hook (obs/metrics.py; one global read when off):
+        # the mesh engines' python wrapper is the per-call seam — the
+        # host-door builders return their own step functions and hook
+        # themselves, so no call is ever double-counted
+        _dpxmon.on_train_step("front_door")
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -318,6 +318,22 @@ register("DPX_TRACE_LOG", "str", None,
          "DPX_METRICS_LOG stream, so spans ride the same multi-writer "
          "line-JSON channel as failure events; tools/dpxtrace.py "
          "merges and exports them).")
+register("DPX_MON", "bool", True,
+         "Enable the dpxmon live metrics registry (obs/metrics.py): "
+         "counters/gauges/histograms record in-process and snapshots "
+         "can be emitted. 0 makes every instrument a no-op costing one "
+         "global read (<= 2 µs/increment, gated in the bench smoke). "
+         "No IO happens either way until a snapshot sink is configured "
+         "(DPX_METRICS_LOG or an explicit path).")
+register("DPX_MON_EVERY", "int", 0,
+         "Auto-emit a rank-attributed metrics_snapshot every N train "
+         "steps from the instrumented step hooks (0 = no automatic "
+         "cadence; explicit obs.metrics.emit_snapshot calls and the "
+         "serve engine's log_every emission are unaffected).")
+register("DPX_MON_RULES", "str", None,
+         "Extra SLO health rules appended to obs/health.py's default "
+         "set, in the rule grammar (docs/observability.md): e.g. "
+         "`serve.ttft_ms.p99<=500;drift(train.steps_per_sec)@k=3`.")
 
 # -- faults / elastic -------------------------------------------------------
 register("DPX_FAULT", "str", None,
@@ -340,6 +356,18 @@ register("DPX_WORKER_TAG", "str", None,
 register("DPX_ELASTIC_TEST_LEAK", "str", None,
          "Test-only canary asserting elastic child env never leaks into "
          "the supervisor (tests/test_elastic.py).")
+register("DPX_SOAK_WORLD", "int", 4,
+         "World size of the composed soak arm (benchmarks/soak.py: "
+         "hier two-level ring x adaptive wire x bucketed overlap x "
+         "sharded elastic checkpointing under chaos + dpxmon gating).")
+register("DPX_SOAK_STEPS", "int", 0,
+         "Total train steps of the soak arm (0 = the mode default: "
+         "the smoke's short step count, or time-bounded via "
+         "DPX_SOAK_SECONDS for long runs).")
+register("DPX_SOAK_SECONDS", "float", 0.0,
+         "Wall-clock budget of a long soak run (0 = step-bounded "
+         "only). The worker checks the budget at step granularity and "
+         "exits cleanly once it is spent.")
 
 # -- serving ----------------------------------------------------------------
 register("DPX_SERVE_PAGE_LEN", "int", 16,

@@ -245,6 +245,14 @@ class HostComm:
         self._wire = _wire
         self._faults = _faults
         self.stats = CommStats()
+        # dpxmon (obs/metrics.py): rank-stamp the metrics registry and
+        # register this comm's per-op accounting as the pull-model
+        # `comm` provider — snapshots carry op counts/bytes and the
+        # exposed-vs-overlapped split with zero hot-path cost (polled
+        # once per snapshot; re-registration replaces a dead comm's)
+        from ..obs import metrics as _dpxmon
+        _dpxmon.set_rank(rank)
+        _dpxmon.register_provider("comm", self.stats.monitor_metrics)
         # always-on collective-schedule recorder: every issued op folds
         # into a rolling per-rank digest so a cross-rank divergence is
         # reportable as "rank R issued X where peers issued Y at seq N"

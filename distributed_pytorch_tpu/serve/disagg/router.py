@@ -37,6 +37,7 @@ import jax
 import numpy as np
 
 from ...models.generate import _check_attn_compatible, _model_window
+from ...obs import metrics as dpxmon
 from ...obs import trace as dpxtrace
 from ...runtime import env as dpxenv
 from ...utils.logging import MetricsLogger
@@ -291,6 +292,14 @@ class DisaggEngine:
             self._completed += 1
         rec = request_record(req, "ok")
         req.handle.metrics = rec
+        # dpxmon SLO instruments (obs/metrics.py): same window
+        # histograms as the monolithic engine, so the p99-ceiling
+        # health rules cover both front doors
+        dpxmon.inc("serve.completed")
+        if rec["ttft_ms"] is not None:
+            dpxmon.observe("serve.ttft_ms", rec["ttft_ms"])
+        if rec["tpot_ms"] is not None:
+            dpxmon.observe("serve.tpot_ms", rec["tpot_ms"])
         if self.metrics is not None:
             self.metrics.event("serve_request", **rec)
         emit_request_trace(req, "ok")
@@ -305,6 +314,8 @@ class DisaggEngine:
             self._failed += 1
         rec = request_record(req, outcome)
         req.handle.metrics = rec
+        dpxmon.inc("serve.failed")
+        dpxmon.inc(f"serve.outcome.{outcome}")
         if self.metrics is not None:
             self.metrics.event("serve_request", **rec)
         emit_request_trace(req, outcome)
@@ -454,18 +465,28 @@ class DisaggEngine:
         }
 
     def periodic_metrics(self, iteration: int) -> None:
-        """Emit the periodic engine record (decode-loop cadence)."""
+        """Emit the periodic engine snapshot (decode-loop cadence)
+        through the ONE dpxmon registry path (obs/metrics.py) — the
+        ad-hoc ``kind="serve_disagg_engine"`` step records are gone;
+        the split's queue/occupancy/handoff gauges ride the same
+        rank-attributed ``metrics_snapshot`` stream the health rules
+        and ``tools/dpxmon.py`` read."""
         if self.metrics is None or iteration % self.config.log_every:
             return
+        if not dpxmon.enabled():
+            return
         d = self.decode.stats()
-        self.metrics.log(
-            step=iteration, kind="serve_disagg_engine",
-            queue_depth=len(self.scheduler),
-            handoff_in_flight=self.handoff_count(),
-            active_slots=d["active_slots"],
-            pending_handoffs=d["pending_handoffs"],
-            tokens_emitted=d["tokens_emitted"],
-            pool_occupancy=d["pages"]["pool_occupancy"],
-            handoff_bytes_sent=int(
-                self.transport.stats.summary()
-                .get("handoff_send", {}).get("bytes", 0)))
+        dpxmon.set_gauge("serve.queue_depth", len(self.scheduler))
+        dpxmon.set_gauge("serve.handoff_in_flight",
+                         self.handoff_count())
+        dpxmon.set_gauge("serve.active_slots", d["active_slots"])
+        dpxmon.set_gauge("serve.pending_handoffs",
+                         d["pending_handoffs"])
+        dpxmon.set_gauge("serve.tokens_emitted", d["tokens_emitted"])
+        dpxmon.set_gauge("serve.pool_occupancy",
+                         d["pages"]["pool_occupancy"])
+        dpxmon.set_gauge("serve.handoff_bytes_sent", int(
+            self.transport.stats.summary()
+            .get("handoff_send", {}).get("bytes", 0)))
+        dpxmon.emit_snapshot(path=self.metrics.path, step=iteration,
+                             source="serve_disagg_engine")

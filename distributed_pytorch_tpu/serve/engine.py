@@ -38,6 +38,7 @@ import numpy as np
 
 from ..models.generate import (_check_attn_compatible, _model_window,
                                _sample)
+from ..obs import metrics as dpxmon
 from ..obs import trace as dpxtrace
 from ..runtime import env as dpxenv
 from ..runtime import faults
@@ -191,7 +192,13 @@ class InferenceEngine:
                 raise EngineStopped("engine is shut down")  # dpxlint: disable=DPX004 pre-admission, no request id assigned yet
             rid = self._next_id
             self._next_id += 1
-        self._validate(prompt, sp, rid)
+        try:
+            self._validate(prompt, sp, rid)
+        except AdmissionRejected:
+            # synchronous rejections are a first-class health signal
+            # (the back-pressure rate a quota/saturation rule watches)
+            dpxmon.inc("serve.rejected")
+            raise
         if rng is None:
             rng = jax.random.PRNGKey(rid)
         rngs = np.asarray(jax.random.split(rng, sp.max_new_tokens))
@@ -211,7 +218,11 @@ class InferenceEngine:
             if self._stop:
                 raise EngineStopped("engine is shut down",
                                     request_id=rid)
-            self._scheduler.submit(req)   # may raise AdmissionRejected
+            try:
+                self._scheduler.submit(req)  # may raise AdmissionRejected
+            except AdmissionRejected:
+                dpxmon.inc("serve.rejected")
+                raise
             self._cond.notify_all()
         return req.handle
 
@@ -327,20 +338,34 @@ class InferenceEngine:
                 break
             if (self.metrics is not None
                     and self._iteration % self.config.log_every == 0):
-                extra = {}
-                if self._paged:
-                    ps = self.pool.page_stats()
-                    extra = {"pool_occupancy": ps["pool_occupancy"],
-                             "free_pages": ps["free_pages"],
-                             "prefix_hit_rate": ps["prefix_hit_rate"],
-                             "page_evictions": ps["evictions"]}
-                self.metrics.log(
-                    step=self._iteration, kind="serve_engine",
-                    queue_depth=len(self._scheduler),
-                    active_slots=len(self._running),
-                    slot_occupancy=len(self._running) / self.config.n_slots,
-                    tokens_emitted=self._tokens_emitted, **extra)
+                self._emit_snapshot()
         self._drain_on_stop()
+
+    def _emit_snapshot(self) -> None:
+        """The ONE periodic-metrics emission path (obs/metrics.py):
+        engine gauges land in the dpxmon registry and the registry
+        emits a rank-attributed ``metrics_snapshot`` event into this
+        engine's metrics log — the ad-hoc ``kind="serve_engine"`` step
+        records (and their duplicate field plumbing) are gone; dpxmon
+        and the SLO health rules read the same stream."""
+        if not dpxmon.enabled():
+            return
+        dpxmon.set_gauge("serve.queue_depth", len(self._scheduler))
+        dpxmon.set_gauge("serve.active_slots", len(self._running))
+        dpxmon.set_gauge("serve.slot_occupancy",
+                         len(self._running) / self.config.n_slots)
+        dpxmon.set_gauge("serve.tokens_emitted", self._tokens_emitted)
+        if self._paged:
+            ps = self.pool.page_stats()
+            dpxmon.set_gauge("serve.pool_occupancy",
+                             ps["pool_occupancy"])
+            dpxmon.set_gauge("serve.free_pages", ps["free_pages"])
+            dpxmon.set_gauge("serve.prefix_hit_rate",
+                             ps["prefix_hit_rate"] or 0.0)
+            dpxmon.set_gauge("serve.page_evictions", ps["evictions"])
+        dpxmon.emit_snapshot(path=self.metrics.path,
+                             step=self._iteration,
+                             source="serve_engine")
 
     def _sweep_deadlines(self, now: float) -> None:
         for req in self._scheduler.expired(now):
@@ -497,6 +522,14 @@ class InferenceEngine:
         self._completed += 1
         rec = request_record(req, "ok")
         req.handle.metrics = rec
+        # dpxmon SLO instruments: TTFT/TPOT window histograms (the
+        # p99-ceiling health rules read their snapshot summaries) and
+        # the completion counter
+        dpxmon.inc("serve.completed")
+        if rec["ttft_ms"] is not None:
+            dpxmon.observe("serve.ttft_ms", rec["ttft_ms"])
+        if rec["tpot_ms"] is not None:
+            dpxmon.observe("serve.tpot_ms", rec["tpot_ms"])
         if self.metrics is not None:
             self.metrics.event("serve_request", **rec)
         emit_request_trace(req, "ok")
@@ -510,6 +543,8 @@ class InferenceEngine:
         self._failed += 1
         rec = request_record(req, outcome)
         req.handle.metrics = rec
+        dpxmon.inc("serve.failed")
+        dpxmon.inc(f"serve.outcome.{outcome}")
         if self.metrics is not None:
             self.metrics.event("serve_request", **rec)
         emit_request_trace(req, outcome)

@@ -138,6 +138,23 @@ class CommStats:
         """Per-op totals (a copy; safe to serialize)."""
         return {op: dict(d) for op, d in self.per_op.items()}
 
+    def monitor_metrics(self) -> Dict[str, float]:
+        """Flat ``{metric name: number}`` view for the dpxmon registry
+        (obs/metrics.py registers this as the ``comm`` provider —
+        polled once per snapshot, so the comm hot path never pays for
+        it): per-op calls/bytes plus the whole-stack totals with the
+        overlapped-vs-exposed split in milliseconds."""
+        out: Dict[str, float] = {}
+        for op, d in self.per_op.items():
+            out[f"comm.{op}.calls"] = d["calls"]
+            out[f"comm.{op}.bytes"] = d["bytes"]
+        tot = self.snapshot()
+        out["comm.calls"] = tot["calls"]
+        out["comm.bytes"] = tot["bytes"]
+        out["comm.exposed_ms"] = round(tot["exposed_s"] * 1e3, 3)
+        out["comm.overlapped_ms"] = round(tot["overlapped_s"] * 1e3, 3)
+        return out
+
 
 def device_memory_stats(device=None) -> Dict[str, Any]:
     """Per-device allocator stats (bytes in use, peak, limit) where the

@@ -344,6 +344,54 @@ class TestRules:
         """
         assert _lint_snippet(tmp_path, waived) == []
 
+    def test_dpx008_unknown_event_name_flagged(self, tmp_path):
+        bad = """
+            from ..utils.logging import append_event
+
+            def report():
+                append_event("totaly_unknwon_event", rank=0)
+        """
+        found = _lint_snippet(tmp_path, bad)
+        assert _rules(found) == ["DPX008"]
+        assert "totaly_unknwon_event" in found[0].message
+
+    def test_dpx008_known_names_variables_and_methods_ok(self, tmp_path):
+        good = """
+            from ..utils.logging import append_event
+
+            def report(name):
+                append_event("worker_failure", rank=0)
+                append_event("metrics_snapshot", rank=0)
+                append_event(name, rank=0)      # caller's literal is
+                                                # the checked site
+                logger.event("whatever_stream", rank=0)  # not append_event
+        """
+        assert _lint_snippet(tmp_path, good) == []
+
+    def test_dpx008_waivable_and_tests_exempt(self, tmp_path):
+        waived = """
+            from ..utils.logging import append_event
+
+            def report():
+                # dpxlint: disable=DPX008 deliberately-foreign stream
+                append_event("external_system_event", rank=0)
+        """
+        assert _lint_snippet(tmp_path, waived) == []
+        in_tests = """
+            def stage():
+                append_event("unknown_on_purpose")
+        """
+        assert _lint_snippet(tmp_path, in_tests,
+                             rel="tests/test_mod.py") == []
+
+    def test_dpx008_vocabulary_is_the_export_registry(self):
+        # the rule reads KNOWN_EVENTS itself — a name registered in
+        # obs/export.py can never be flagged, by construction
+        from distributed_pytorch_tpu.obs.export import KNOWN_EVENTS
+        assert lint.KNOWN_EVENTS is KNOWN_EVENTS
+        assert "metrics_snapshot" in lint.KNOWN_EVENTS
+        assert "health_transition" in lint.KNOWN_EVENTS
+
 
 class TestAllowlist:
     def test_inline_disable_same_line_and_line_above(self, tmp_path):

@@ -1,0 +1,486 @@
+"""dpxmon live monitoring (obs/metrics.py + obs/health.py +
+tools/dpxmon.py) — acceptance + units (ISSUE 15).
+
+The headline contracts: (1) the registry's instruments snapshot into
+rank-attributed ``metrics_snapshot`` events that pass BOTH strict
+validators (dpxmon's snapshot shape, dpxtrace's event vocabulary);
+(2) the streaming health evaluator walks ok → degraded → critical with
+hysteresis and emits transitions that name the firing rule and metric;
+(3) the health-rule edge cases: hysteresis across the ok↔degraded
+boundary, single-snapshot windows, all-ranks-missing snapshots, and
+the ``obs/detect.py`` small-sample IQR degeneracy (n<=2); (4) the
+``tools/dpxmon.py`` CLI replays clean logs to exit 0 and seeded
+SLO-violation logs to exit 1.
+"""
+
+import json
+import time
+
+import pytest
+
+from distributed_pytorch_tpu.obs import detect, export, health, metrics
+from distributed_pytorch_tpu.obs import trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Every test starts and ends with a pristine process-global
+    registry (and tracing state — the snapshot built-ins read it)."""
+    metrics.reset()
+    trace.reset()
+    yield
+    metrics.reset()
+    trace.reset()
+
+
+def _snap(rank=0, t=100.0, step=1, source="test", **m):
+    return {"event": "metrics_snapshot", "time": t, "rank": rank,
+            "step": step, "source": source, "metrics": m}
+
+
+def _hist(p99, count=8):
+    return {"count": count, "sum": p99 * count, "min": p99, "max": p99,
+            "p50": p99, "p99": p99}
+
+
+# ---------------------------------------------------------------------------
+# registry instruments
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram_snapshot(self):
+        metrics.configure(enabled=True, rank=3)
+        metrics.inc("a.count", 2)
+        metrics.inc("a.count")
+        metrics.set_gauge("a.gauge", 1.5)
+        for v in range(10):
+            metrics.observe("a.hist", float(v))
+        snap = metrics.snapshot()
+        assert snap["a.count"] == 3
+        assert snap["a.gauge"] == 1.5
+        h = snap["a.hist"]
+        assert h["count"] == 10 and h["min"] == 0.0 and h["max"] == 9.0
+        assert h["p50"] == 5.0 and h["p99"] == 9.0
+
+    def test_disabled_instruments_record_nothing(self):
+        metrics.configure(enabled=False)
+        metrics.inc("x")
+        metrics.set_gauge("y", 1.0)
+        metrics.observe("z", 1.0)
+        metrics.configure(enabled=True)
+        snap = metrics.snapshot()
+        assert "x" not in snap and "y" not in snap and "z" not in snap
+
+    def test_histogram_reservoir_bounded_cumulative_totals(self):
+        metrics.configure(enabled=True)
+        h = metrics.histogram("b.hist")
+        for v in range(1000):
+            h.observe(float(v))
+        assert len(h.recent) == metrics.RESERVOIR_CAP
+        s = h.snap()
+        # cumulative count/min/max never drop; percentiles are over
+        # the bounded RECENT window
+        assert s["count"] == 1000 and s["min"] == 0.0
+        assert s["p50"] >= 1000 - metrics.RESERVOIR_CAP
+
+    def test_type_collision_raises(self):
+        metrics.configure(enabled=True)
+        metrics.inc("name")
+        with pytest.raises(TypeError):
+            metrics.gauge("name")
+
+    def test_provider_polled_at_snapshot_and_never_fatal(self):
+        metrics.configure(enabled=True)
+        metrics.register_provider("good", lambda: {"p.val": 7})
+
+        def boom():
+            raise RuntimeError("provider crashed")
+
+        metrics.register_provider("bad", boom)
+        snap = metrics.snapshot()
+        assert snap["p.val"] == 7          # good provider polled
+        assert "proc.rss_bytes" in snap    # built-in RSS
+
+    def test_emit_snapshot_rank_attributed_and_validates(self, tmp_path):
+        log = tmp_path / "m.jsonl"
+        metrics.configure(enabled=True, rank=2)
+        metrics.inc("train.steps", 4)
+        assert metrics.emit_snapshot(path=str(log), step=4,
+                                     source="unit")
+        recs, bad = export.read_log(str(log))
+        assert bad == []
+        (rec,) = recs
+        assert rec["event"] == "metrics_snapshot" and rec["rank"] == 2
+        assert metrics.validate_snapshot(rec) == []
+        # the event name is in the dpxtrace vocabulary: the strict
+        # log validator accepts the stream (the DPX008 contract)
+        assert export.check_log(recs, bad) == []
+
+    def test_on_train_step_cadence_and_steps_per_sec(self, tmp_path,
+                                                     monkeypatch):
+        log = tmp_path / "cadence.jsonl"
+        monkeypatch.setenv("DPX_METRICS_LOG", str(log))
+        metrics.configure(enabled=True, every=2, rank=0)
+        for _ in range(6):
+            metrics.on_train_step("unit")
+            time.sleep(0.002)
+        recs, _ = export.read_log(str(log))
+        snaps = [r for r in recs if r["event"] == "metrics_snapshot"]
+        assert [r["step"] for r in snaps] == [2, 4, 6]
+        last = snaps[-1]["metrics"]
+        assert last["train.steps"] == 6
+        assert last["train.step_ms"]["count"] == 5   # gaps, not calls
+        assert last["train.steps_per_sec"] > 0
+
+    def test_validate_snapshot_flags_each_issue_class(self):
+        good = _snap(v=1.0, h=_hist(5.0))
+        assert metrics.validate_snapshot(good) == []
+        no_rank = _snap(v=1.0)
+        no_rank.pop("rank")
+        assert any("rank" in m
+                   for m in metrics.validate_snapshot(no_rank))
+        bad_val = _snap(v="a string")
+        assert any("neither a number" in m
+                   for m in metrics.validate_snapshot(bad_val))
+        bad_hist = _snap(h={"count": 1})
+        assert any("histogram summary" in m
+                   for m in metrics.validate_snapshot(bad_hist))
+        no_metrics = {"event": "metrics_snapshot", "time": 1.0,
+                      "rank": 0, "source": "t"}
+        assert any("metrics dict" in m
+                   for m in metrics.validate_snapshot(no_metrics))
+
+
+# ---------------------------------------------------------------------------
+# health rules + state machine
+# ---------------------------------------------------------------------------
+
+
+class TestRuleGrammar:
+    def test_parse_all_kinds(self):
+        rules = health.parse_rules(
+            "serve.ttft_ms.p99<=500;train.steps_per_sec>=2;"
+            "drift(train.steps_per_sec)@k=2.5,floor=0.2,name=slow;"
+            "growth(proc.rss_bytes)@window=6,grow=0.03")
+        kinds = {r.name: r for r in rules}
+        assert kinds["serve.ttft_ms.p99<=500"].kind == "max"
+        assert kinds["train.steps_per_sec>=2"].kind == "min"
+        assert kinds["slow"].kind == "drift"
+        assert kinds["slow"].k == 2.5 and kinds["slow"].rel_floor == 0.2
+        g = kinds["growth:proc.rss_bytes"]
+        assert g.window == 6 and g.min_growth == 0.03
+
+    def test_malformed_specs_raise(self):
+        for bad in ("nonsense", "a<=notanum", "drift()",
+                    "a<=1@window"):
+            with pytest.raises(ValueError):
+                health.parse_rules(bad)
+
+    def test_unevaluable_window_raises(self):
+        """drift needs >= 3 trailing values and growth >= 4 history
+        entries, both trimmed to the window — window < 4 could NEVER
+        evaluate, i.e. the silently-vacuous SLO the parser's contract
+        rejects."""
+        for bad in ("drift(x)@window=3", "growth(x)@window=2"):
+            with pytest.raises(ValueError):
+                health.parse_rules(bad)
+        assert health.parse_rules("growth(x)@window=4")[0].window == 4
+
+    def test_resolve_metric_hist_suffix_and_absent(self):
+        m = {"a": 1.0, "h": _hist(9.0)}
+        assert health.resolve_metric(m, "a") == 1.0
+        assert health.resolve_metric(m, "h.p99") == 9.0
+        assert health.resolve_metric(m, "h") is None     # needs suffix
+        assert health.resolve_metric(m, "missing") is None
+        assert health.resolve_metric(m, "h.p12345") is None
+
+
+class TestStateMachine:
+    def _mon(self, spec, **kw):
+        return health.HealthMonitor(health.parse_rules(spec), **kw)
+
+    def test_ceiling_escalates_with_hysteresis_and_names_rule(self):
+        mon = self._mon("occ<=0.9", critical_after=3)
+        trs = mon.feed(_snap(t=1, occ=0.95))
+        assert mon.state == "degraded"
+        assert trs[0]["rule"] == "occ<=0.9" and trs[0]["rank"] == 0
+        assert trs[0]["metric"] == "occ" and trs[0]["value"] == 0.95
+        mon.feed(_snap(t=2, occ=0.95))
+        assert mon.state == "degraded"     # 2 breaches < critical_after
+        trs = mon.feed(_snap(t=3, occ=0.95))
+        assert mon.state == "critical"
+        assert trs[0]["to"] == "critical"
+        assert trs[0]["rule"] == "occ<=0.9"
+        v = mon.verdict()
+        assert v["state"] == "critical"
+        assert v["firing"][0]["rule"] == "occ<=0.9"
+
+    def test_hysteresis_across_ok_degraded_boundary(self):
+        """Alternating breach/clear at the boundary: recover_after=2
+        means ONE clean snapshot does not recover, and the cleared
+        streak means re-breaching restarts the escalation count — the
+        state flaps at degraded without ever reaching critical."""
+        mon = self._mon("occ<=0.9", critical_after=3, recover_after=2)
+        states = []
+        for i, occ in enumerate((0.95, 0.5, 0.95, 0.5, 0.95, 0.5)):
+            mon.feed(_snap(t=i, occ=occ))
+            states.append(mon.state)
+        assert states == ["degraded"] * 6      # never critical
+        # two consecutive clean snapshots DO recover
+        mon.feed(_snap(t=10, occ=0.5))
+        mon.feed(_snap(t=11, occ=0.5))
+        assert mon.state == "ok"
+        # and the recovery transition names what recovered
+        rec = mon.transitions[-1]
+        assert rec["from"] == "degraded" and rec["to"] == "ok"
+        assert rec["rule"] == "occ<=0.9"
+
+    def test_drift_fires_on_collapse_not_on_single_snapshot(self):
+        mon = self._mon("drift(sps)@k=3,floor=0.1")
+        # single-snapshot window: nothing to compare against — no fire
+        mon.feed(_snap(t=0, sps=100.0))
+        assert mon.state == "ok"
+        for i in range(1, 6):
+            mon.feed(_snap(t=i, sps=100.0 + (i % 2)))
+        assert mon.state == "ok"
+        mon.feed(_snap(t=9, sps=40.0))     # sustained-collapse sample
+        assert mon.state == "degraded"
+        tr = mon.transitions[-1]
+        assert tr["rule"] == "drift:sps" and tr["value"] == 40.0
+
+    def test_drift_ignores_jitter_within_the_gate(self):
+        mon = self._mon("drift(sps)@k=3,floor=0.10")
+        for i, v in enumerate((100, 101, 99, 100, 98, 97, 99, 96)):
+            mon.feed(_snap(t=i, sps=float(v)))
+        assert mon.state == "ok"
+
+    def test_growth_monotone_rss_fires_dips_do_not(self):
+        mon = self._mon("growth(rss)@window=4,grow=0.02")
+        for i, v in enumerate((100, 110, 120, 130, 140)):
+            mon.feed(_snap(t=i, rss=float(v)))
+        assert mon.state == "degraded"     # monotone +40% over window
+        mon2 = self._mon("growth(rss)@window=4,grow=0.02")
+        for i, v in enumerate((100, 110, 105, 130, 140)):
+            mon2.feed(_snap(t=i, rss=float(v)))   # a dip breaks it
+        assert mon2.state == "ok"
+
+    def test_absent_metric_neither_breaches_nor_clears(self):
+        """Snapshots from another source (no such metric) must not
+        recover a firing rule — recovery needs evidence."""
+        mon = self._mon("occ<=0.9", recover_after=1)
+        mon.feed(_snap(t=1, occ=0.95))
+        assert mon.state == "degraded"
+        for i in range(2, 6):
+            mon.feed(_snap(t=i, source="other", unrelated=1.0))
+        assert mon.state == "degraded"
+
+    def test_all_ranks_missing_snapshots(self):
+        """A log with failure events but NO snapshots at all: the
+        monitor degrades on the failure and stays there (nothing can
+        clear it), and the verdict is well-formed."""
+        mon = health.HealthMonitor([])
+        mon.feed({"event": "worker_failure", "rank": 2, "time": 1.0})
+        assert mon.state == "degraded"
+        v = mon.verdict()
+        assert v["snapshots"] == 0
+        assert v["firing"][0]["rule"] == health.FAILURE_RULE
+        assert v["firing"][0]["rank"] == 2
+
+    def test_failure_event_then_snapshots_recover(self):
+        mon = health.HealthMonitor([], recover_after=2)
+        mon.feed({"event": "worker_failure", "rank": 1, "time": 1.0})
+        assert mon.state == "degraded"
+        # attempt-level exit (no rank) degrades the rank-None stream;
+        # ANY snapshot clears it — a reporting world came back
+        mon.feed({"event": "elastic_worker_exit", "time": 1.5,
+                  "exitcode": 43})
+        mon.feed(_snap(rank=1, t=2.0, steps=1))
+        mon.feed(_snap(rank=1, t=3.0, steps=2))
+        assert mon.state == "ok"
+        froms = [t["from"] for t in mon.transitions]
+        tos = [t["to"] for t in mon.transitions]
+        assert ("ok", "degraded") == (froms[0], tos[0])
+        assert ("degraded", "ok") == (froms[-1], tos[-1])
+
+    def test_giveup_is_critical(self):
+        mon = health.HealthMonitor([])
+        mon.feed({"event": "elastic_giveup", "time": 1.0})
+        assert mon.state == "critical"
+
+    def test_transitions_emitted_as_events_pass_validators(self,
+                                                           tmp_path):
+        log = tmp_path / "h.jsonl"
+        mon = health.HealthMonitor(
+            health.parse_rules("occ<=0.9"), emit_path=str(log))
+        mon.feed(_snap(t=1, occ=0.95))
+        recs, bad = export.read_log(str(log))
+        assert bad == []
+        (rec,) = recs
+        assert rec["event"] == "health_transition"
+        assert rec["to"] == "degraded" and rec["rule"] == "occ<=0.9"
+        assert rec["rank"] == 0
+        assert export.check_log(recs, bad) == []
+
+    def test_per_rank_streams_are_independent(self):
+        mon = self._mon("occ<=0.9", recover_after=1)
+        mon.feed(_snap(rank=0, t=1, occ=0.95))
+        mon.feed(_snap(rank=1, t=2, occ=0.5))
+        # rank 1's clean snapshot must not recover rank 0's breach
+        assert mon.state == "degraded"
+        assert mon.firing()[0]["rank"] == 0
+
+
+class TestLogFollower:
+    def test_incremental_poll_and_torn_line_buffering(self, tmp_path):
+        log = tmp_path / "f.jsonl"
+        mon = health.HealthMonitor(health.parse_rules("occ<=0.9"))
+        f = health.LogFollower(str(log), mon)
+        assert f.poll() == []              # missing file: no crash
+        line1 = json.dumps(_snap(t=1, occ=0.95)) + "\n"
+        line2 = json.dumps(_snap(t=2, occ=0.95))
+        with open(log, "w") as fh:
+            fh.write(line1 + line2[:10])   # torn second line
+        trs = f.poll()
+        assert [t["to"] for t in trs] == ["degraded"]
+        assert mon.snapshots_seen == 1     # the torn line is buffered
+        with open(log, "a") as fh:
+            fh.write(line2[10:] + "\n")
+        f.poll()
+        assert mon.snapshots_seen == 2
+
+
+# ---------------------------------------------------------------------------
+# obs/detect.py small-sample IQR degeneracy (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _mk_span(name, rank, t0, dur_s, span_id):
+    return {"event": "trace_span", "name": name, "trace_id": None,
+            "span_id": span_id, "parent_id": None, "t0_wall": t0,
+            "dur_ns": int(dur_s * 1e9), "rank": rank, "pid": 1000 + rank,
+            "tid": "MainThread"}
+
+
+class TestDetectSmallSamples:
+    def test_single_observation_per_rank_no_crash(self):
+        """n=1 duration per rank: summarize's IQR is 0 by construction;
+        the straggler fence still works off the across-rank spread."""
+        spans = [_mk_span("comm:allreduce", r, 100.0, d, f"{r}.0")
+                 for r, d in enumerate((0.010, 0.010, 0.011, 0.080))]
+        found = detect.stragglers(export.collect_spans(spans))
+        assert [f["rank"] for f in found] == [3]
+
+    def test_two_ranks_cannot_outvote_each_other(self):
+        """n_ranks=2 degeneracy, pinned: with one peer there is no
+        spread to build a leave-one-out fence from (a single-point
+        "IQR" is 0, which would flag ANY gap), so stragglers() skips
+        ops seen on fewer than three ranks.  Two ranks never produce
+        a straggler verdict; the caller needs n_ranks >= 3 for an
+        outlier to be meaningful."""
+        spans = []
+        for r, d in ((0, 0.010), (1, 1.0)):    # 100x apart
+            spans += [_mk_span("comm:allreduce", r, 100.0 + i, d,
+                               f"{r}.{i}") for i in range(4)]
+        assert detect.stragglers(export.collect_spans(spans)) == []
+
+    def test_three_ranks_is_the_minimum_meaningful_world(self):
+        spans = []
+        for r, d in ((0, 0.010), (1, 0.0101), (2, 0.9)):
+            spans += [_mk_span("comm:allreduce", r, 100.0 + i, d,
+                               f"{r}.{i}") for i in range(4)]
+        found = detect.stragglers(export.collect_spans(spans))
+        assert [f["rank"] for f in found] == [2]
+
+    def test_two_observations_per_rank_iqr_degeneracy(self):
+        """n=2 samples per rank: the per-rank median interpolates the
+        midpoint — still a finite, crash-free summary feeding the
+        across-rank fence."""
+        spans = []
+        for r in range(3):
+            d = 0.010 if r < 2 else 0.050
+            spans += [_mk_span("comm:allreduce", r, 100.0 + i, d + i * 1e-4,
+                               f"{r}.{i}") for i in range(2)]
+        found = detect.stragglers(export.collect_spans(spans))
+        assert [f["rank"] for f in found] == [2]
+
+
+# ---------------------------------------------------------------------------
+# the dpxmon CLI
+# ---------------------------------------------------------------------------
+
+
+class TestDpxmonCli:
+    def _write(self, path, recs):
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+
+    def test_replay_clean_log_exits_zero(self, tmp_path, capsys):
+        from tools import dpxmon as cli
+        log = tmp_path / "clean.jsonl"
+        self._write(log, [_snap(rank=r, t=10.0 + i, step=i,
+                                **{"train.steps": i})
+                          for i in range(4) for r in (0, 1)])
+        assert cli.main(["replay", str(log)]) == 0
+        out = capsys.readouterr().out
+        assert "health: OK" in out and "train.steps" in out
+
+    def test_replay_seeded_violation_exits_one(self, tmp_path, capsys):
+        """A pinned SLO violation (pool occupancy over the default
+        saturation ceiling for the whole window) must escalate to
+        CRITICAL and exit 1 — the soak gate can fail."""
+        from tools import dpxmon as cli
+        log = tmp_path / "bad.jsonl"
+        self._write(log, [_snap(t=10.0 + i, step=i,
+                                **{"serve.pool_occupancy": 0.999})
+                          for i in range(5)])
+        assert cli.main(["replay", str(log)]) == 1
+        out = capsys.readouterr().out
+        assert "critical" in out.lower()
+
+    def test_replay_reports_recovery_with_attribution(self, tmp_path,
+                                                      capsys):
+        from tools import dpxmon as cli
+        log = tmp_path / "rec.jsonl"
+        recs = [_snap(rank=1, t=10.0, step=0, **{"train.steps": 0}),
+                {"event": "worker_failure", "rank": 1, "time": 11.0,
+                 "op": "allreduce", "exitcode": 43},
+                _snap(rank=1, t=12.0, step=1, **{"train.steps": 1}),
+                _snap(rank=1, t=13.0, step=2, **{"train.steps": 2})]
+        self._write(log, recs)
+        assert cli.main(["replay", str(log)]) == 0
+        out = capsys.readouterr().out
+        assert "worker-failure" in out     # rule attribution
+        assert "degraded" in out and "ok" in out
+
+    def test_check_flags_invalid_snapshots(self, tmp_path, capsys):
+        from tools import dpxmon as cli
+        log = tmp_path / "invalid.jsonl"
+        bad = _snap(v=1.0)
+        bad.pop("rank")
+        self._write(log, [bad])
+        assert cli.main(["check", str(log)]) == 1
+        good = tmp_path / "good.jsonl"
+        self._write(good, [_snap(v=1.0)])
+        assert cli.main(["check", str(good)]) == 0
+        # replay also fails on validation issues, even when healthy
+        assert cli.main(["replay", str(log)]) == 1
+
+    def test_custom_rules_flag(self, tmp_path):
+        from tools import dpxmon as cli
+        log = tmp_path / "r.jsonl"
+        self._write(log, [_snap(t=10.0 + i, step=i, lat=50.0)
+                          for i in range(5)])
+        assert cli.main(["replay", str(log)]) == 0
+        assert cli.main(["replay", str(log), "--rules",
+                         "lat<=10"]) == 1
+
+    def test_follow_max_seconds(self, tmp_path, capsys):
+        from tools import dpxmon as cli
+        log = tmp_path / "live.jsonl"
+        self._write(log, [_snap(t=10.0, step=0, v=1.0)])
+        rc = cli.main(["follow", str(log), "--interval", "0.05",
+                       "--max-seconds", "0.2"])
+        assert rc == 0
+        assert "health: OK" in capsys.readouterr().out

@@ -412,20 +412,28 @@ class TestEngine:
 
     def test_slo_metrics_flow_to_logger(self, tmp_path):
         """Per-request TTFT/TPOT events and periodic queue-depth /
-        slot-occupancy records land in the line-JSON metrics stream."""
+        slot-occupancy snapshots land in the line-JSON metrics stream —
+        the periodic records now ride the ONE dpxmon registry path
+        (rank-attributed metrics_snapshot events, obs/metrics.py), and
+        every snapshot passes the strict dpxmon validator."""
+        from distributed_pytorch_tpu.obs import metrics as dpxmon
         model = _lm1()
         params = model.init(jax.random.PRNGKey(0))
         log = tmp_path / "serve_metrics.jsonl"
         logger = MetricsLogger(path=str(log))
         cfg = EngineConfig(n_slots=2, max_len=MAX_LEN, metrics=logger,
                            log_every=2)
-        with InferenceEngine(model, params, cfg) as eng:
-            hs = [eng.submit(np.arange(5, dtype=np.int32),
-                             SamplingParams(max_new_tokens=8))
-                  for _ in range(3)]
-            for h in hs:
-                h.result(timeout=60)
-        logger.close()
+        dpxmon.reset()
+        try:
+            with InferenceEngine(model, params, cfg) as eng:
+                hs = [eng.submit(np.arange(5, dtype=np.int32),
+                                 SamplingParams(max_new_tokens=8))
+                      for _ in range(3)]
+                for h in hs:
+                    h.result(timeout=60)
+        finally:
+            logger.close()
+            dpxmon.reset()
         rows = [json.loads(ln) for ln in log.read_text().splitlines()]
         reqs = [r for r in rows if r.get("event") == "serve_request"]
         assert len(reqs) == 3
@@ -433,10 +441,19 @@ class TestEngine:
             assert r["outcome"] == "ok" and r["n_tokens"] == 8
             assert r["ttft_ms"] > 0 and r["tpot_ms"] > 0
             assert r["queue_ms"] is not None
-        engine_rows = [r for r in rows if r.get("kind") == "serve_engine"]
-        assert engine_rows, rows
-        assert all(0.0 <= r["slot_occupancy"] <= 1.0 for r in engine_rows)
-        assert all("queue_depth" in r for r in engine_rows)
+        snaps = [r for r in rows if r.get("event") == "metrics_snapshot"
+                 and r.get("source") == "serve_engine"]
+        assert snaps, rows
+        for r in snaps:
+            assert dpxmon.validate_snapshot(r) == []
+            m = r["metrics"]
+            assert 0.0 <= m["serve.slot_occupancy"] <= 1.0
+            assert "serve.queue_depth" in m
+        # the SLO histograms feed the health rules: completed requests
+        # land TTFT/TPOT summaries in the final snapshots
+        last = snaps[-1]["metrics"]
+        assert last["serve.completed"] >= 1
+        assert last["serve.ttft_ms"]["count"] >= 1
 
     def test_shutdown_fails_inflight_typed(self):
         model = _lm1()

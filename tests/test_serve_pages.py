@@ -552,11 +552,15 @@ class TestPagedEngine:
         assert len(reqs) == 3
         assert sorted(r["prefix_hit_pages"] for r in reqs) == [0, 2, 2]
         assert sorted(r["prefill_tokens_saved"] for r in reqs) == [0, 16, 16]
-        engine_rows = [r for r in rows if r.get("kind") == "serve_engine"]
+        engine_rows = [r for r in rows
+                       if r.get("event") == "metrics_snapshot"
+                       and r.get("source") == "serve_engine"]
         assert engine_rows
         for r in engine_rows:
-            assert 0.0 <= r["pool_occupancy"] <= 1.0
-            assert "free_pages" in r and "page_evictions" in r
+            m = r["metrics"]
+            assert 0.0 <= m["serve.pool_occupancy"] <= 1.0
+            assert "serve.free_pages" in m
+            assert "serve.page_evictions" in m
         agg = aggregate([h.metrics for h in hs])
         assert agg["prefill_tokens_saved"] == 32
         assert 0.0 < agg["prefix_hit_rate"] < 1.0

@@ -1,0 +1,248 @@
+"""dpxmon CLI — follow or replay the live metrics stream, render
+per-rank tables and the streaming SLO health verdict (obs/metrics.py +
+obs/health.py — docs/observability.md).
+
+Usage::
+
+    python -m tools.dpxmon replay LOG [LOG ...] [--rules SPEC]
+                            # full pass: strict-validate every
+                            # metrics_snapshot, re-derive the health
+                            # trajectory, print transitions (rank+rule
+                            # attributed) and per-rank tables;
+                            # exit 1 on any CRITICAL verdict or
+                            # validation issue
+    python -m tools.dpxmon follow LOG [--interval S] [--max-seconds S]
+                            # tail a LIVE log, re-render health state as
+                            # snapshots arrive; exits 1 the moment the
+                            # monitor goes critical
+    python -m tools.dpxmon check LOG [LOG ...]
+                            # strict snapshot validation only
+
+``--rules`` takes the obs/health.py rule grammar
+(``serve.ttft_ms.p99<=500;drift(train.steps_per_sec)``); the default is
+``health.DEFAULT_RULES``. Exit codes: 0 = healthy/clean, 1 = critical
+verdict or validation issues, 2 = usage / unreadable input.
+
+Like ``tools/dpxtrace.py`` and ``tools/benchdiff.py``, this avoids the
+heavy package ``__init__`` (which pulls jax): obs/ and perfbench/ are
+stdlib-only and load against fabricated lightweight parents, so the CLI
+runs in a bare venv in milliseconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _load_obs():
+    """Import ``distributed_pytorch_tpu.obs``: the REAL package first
+    (in-process test use), else fabricated lightweight parents so the
+    stdlib-only obs/perfbench modules resolve against the source tree
+    (the benchdiff/dpxtrace loader contract)."""
+    import importlib
+    import types
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    try:
+        return importlib.import_module("distributed_pytorch_tpu.obs")
+    except Exception:  # noqa: BLE001 — bare venv: the __init__ chain needs jax
+        pass
+    pkg_dir = os.path.join(root, "distributed_pytorch_tpu")
+    for name, sub in (("distributed_pytorch_tpu", ""),
+                      ("distributed_pytorch_tpu.runtime", "runtime"),
+                      ("distributed_pytorch_tpu.utils", "utils")):
+        if name not in sys.modules:
+            pkg = types.ModuleType(name)
+            pkg.__path__ = [os.path.join(pkg_dir, sub) if sub
+                            else pkg_dir]
+            sys.modules[name] = pkg
+    return importlib.import_module("distributed_pytorch_tpu.obs")
+
+
+def _read_all(obs, paths):
+    records, malformed = [], []
+    for path in paths:
+        try:
+            recs, bad = obs.export.read_log(path)
+        except OSError as e:
+            print(f"dpxmon: cannot read {path}: {e}", file=sys.stderr)
+            raise SystemExit(2)
+        for r in recs:
+            r["_path"] = path
+        records.extend(recs)
+        malformed.extend((path, ln, why) for ln, why in bad)
+    return records, malformed
+
+
+def _fmt_table(rows, cols):
+    if not rows:
+        return "(none)"
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in cols}
+    head = "  ".join(c.ljust(widths[c]) for c in cols)
+    sep = "  ".join("-" * widths[c] for c in cols)
+    body = "\n".join("  ".join(str(r.get(c, "")).ljust(widths[c])
+                               for c in cols) for r in rows)
+    return "\n".join([head, sep, body])
+
+
+def _fmt_metric(v):
+    if isinstance(v, dict):
+        return f"p50={v.get('p50')} p99={v.get('p99')} n={v.get('count')}"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return v
+
+
+def _rank_tables(snapshots):
+    """Latest snapshot per (rank, source) -> printable rows."""
+    latest = {}
+    for rec in snapshots:
+        latest[(rec.get("rank"), rec.get("source"))] = rec
+    rows = []
+    for (rank, source), rec in sorted(
+            latest.items(),
+            key=lambda kv: (kv[0][0] is None, kv[0][0], kv[0][1] or "")):
+        for name in sorted(rec.get("metrics", {})):
+            rows.append({"rank": rank, "source": source, "metric": name,
+                         "value": _fmt_metric(rec["metrics"][name]),
+                         "step": rec.get("step")})
+    return rows
+
+
+def _validate(obs, records, malformed):
+    issues = [f"{path}:{ln}: malformed line: {why}"
+              for path, ln, why in malformed]
+    for rec in records:
+        if rec.get("event") != "metrics_snapshot":
+            continue
+        for msg in obs.metrics.validate_snapshot(rec):
+            issues.append(f"{rec.get('_path')}:{rec.get('_line')}: {msg}")
+    return issues
+
+
+def _monitor_for(obs, args):
+    rules = obs.health.parse_rules(args.rules) if args.rules else None
+    return obs.health.HealthMonitor(rules)
+
+
+def _print_verdict(mon) -> None:
+    v = mon.verdict()
+    if v["transitions"]:
+        print("health transitions:")
+        print(_fmt_table(
+            [{"from": t["from"], "to": t["to"], "rule": t["rule"],
+              "metric": t["metric"], "rank": t["rank"],
+              "value": t["value"]} for t in v["transitions"]],
+            ("from", "to", "rule", "metric", "rank", "value")))
+    else:
+        print("health transitions: (none)")
+    firing = v["firing"]
+    if firing:
+        print("firing rules:")
+        print(_fmt_table(firing,
+                         ("rule", "rank", "state", "breaches", "value")))
+    print(f"health: {v['state'].upper()} "
+          f"({v['snapshots']} snapshot(s) evaluated)")
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    ap = argparse.ArgumentParser(prog="dpxmon", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name in ("replay", "check", "follow"):
+        p = sub.add_parser(name)
+        p.add_argument("logs", nargs="+",
+                       help="line-JSON metrics log(s)")
+        if name in ("replay", "follow"):
+            p.add_argument("--rules", default=None,
+                           help="SLO rule spec (obs/health.py grammar; "
+                                "default: the built-in rule set)")
+        if name == "follow":
+            p.add_argument("--interval", type=float, default=2.0,
+                           help="poll interval seconds (default 2)")
+            p.add_argument("--max-seconds", type=float, default=None,
+                           help="stop following after this long "
+                                "(default: forever)")
+    args = ap.parse_args(argv)
+    obs = _load_obs()
+
+    if args.cmd == "follow":
+        if len(args.logs) != 1:
+            print("dpxmon follow takes exactly one log", file=sys.stderr)
+            return 2
+        mon = _monitor_for(obs, args)
+        follower = obs.health.LogFollower(args.logs[0], mon)
+        t0 = time.monotonic()
+        while True:
+            for tr in follower.poll():
+                print(f"# health {tr['from']} -> {tr['to']} "
+                      f"(rule {tr['rule']}, metric {tr['metric']}, "
+                      f"rank {tr['rank']}, value {tr['value']})",
+                      flush=True)
+            if mon.state == "critical":
+                _print_verdict(mon)
+                return 1
+            if (args.max_seconds is not None
+                    and time.monotonic() - t0 >= args.max_seconds):
+                _print_verdict(mon)
+                return 0
+            time.sleep(args.interval)
+
+    records, malformed = _read_all(obs, args.logs)
+    issues = _validate(obs, records, malformed)
+
+    if args.cmd == "check":
+        for msg in issues:
+            print(msg)
+        n = sum(1 for r in records
+                if r.get("event") == "metrics_snapshot")
+        if issues:
+            print(f"dpxmon check: {len(issues)} issue(s)",
+                  file=sys.stderr)
+            return 1
+        print(f"dpxmon check: clean ({n} snapshot(s) across "
+              f"{len(args.logs)} log(s))")
+        return 0
+
+    # replay: records in time order (the multi-writer stream is
+    # monotone per process; cross-process skew is below the snapshot
+    # cadence, so a global time sort is the honest replay order)
+    records.sort(key=lambda r: (r.get("time") is None,
+                                r.get("time", 0.0)))
+    mon = _monitor_for(obs, args)
+    ever_critical = False
+    for rec in records:
+        mon.feed(rec)
+        ever_critical = ever_critical or mon.state == "critical"
+    snapshots = [r for r in records
+                 if r.get("event") == "metrics_snapshot"]
+    print(_fmt_table(_rank_tables(snapshots),
+                     ("rank", "source", "step", "metric", "value")))
+    for msg in issues:
+        print(f"# validation: {msg}")
+    _print_verdict(mon)
+    if issues:
+        print(f"dpxmon replay: {len(issues)} validation issue(s)",
+              file=sys.stderr)
+        return 1
+    if ever_critical:
+        print("dpxmon replay: CRITICAL health verdict", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        # `dpxmon replay | head` is a legitimate spelling — exit
+        # quietly on a closed pipe instead of tracebacking
+        import os as _os
+        _os.close(2)
+        raise SystemExit(0)
