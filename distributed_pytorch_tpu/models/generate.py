@@ -329,7 +329,9 @@ def _gather_pages(pool, tables):
 def decode_step_slots_paged(model: TransformerLM, params: Params,
                             k_pages, v_pages, tables, lengths, tokens,
                             active, *, page_len: int,
-                            blockwise: bool = True
+                            blockwise: bool = True, kv_bits=None,
+                            k_scales=None, v_scales=None,
+                            k_tail=None, v_tail=None
                             ) -> Tuple[jnp.ndarray, list, list]:
     """One decode step over a PAGED slot pool (``serve/pages/``).
 
@@ -362,7 +364,25 @@ def decode_step_slots_paged(model: TransformerLM, params: Params,
 
     Returns ``(logits (B, vocab), new_k_pages, new_v_pages)``; host-side
     page allocation (growing a table at page boundaries) and length
-    bookkeeping belong to the caller."""
+    bookkeeping belong to the caller.
+
+    **Quantized resident pool** (``kv_bits`` = 8 | 4; docs/serving.md):
+    ``k_pages``/``v_pages`` hold block-quantized int pages and
+    ``k_scales``/``v_scales``/``k_tail``/``v_tail`` are per-layer lists
+    of their scales and per-slot f32 tail buffers. The step's K/V is
+    written to the slot's TAIL buffer (exact f32); when the write lands
+    on the page's last position the whole tail page is quantized ONCE —
+    from exact values, on the wire block grid — and scattered into the
+    int pool with its scales (everything inside this one program, so
+    the compile discipline is unchanged). Attention dequantizes inside
+    the page-gather loop and overlays the exact tail page. Returns the
+    extended tuple ``(logits, new_k_pages, new_v_pages, new_k_scales,
+    new_v_scales, new_k_tail, new_v_tail)``. Requires ``blockwise=True``
+    (the dense fallback would gather the whole int pool undequantized).
+    """
+    if kv_bits is not None and not blockwise:
+        raise ValueError("quantized paged KV (kv_bits) requires the "
+                         "blockwise decode path")
     idx = lengths
     n_pages = k_pages[0].shape[0]
     width = tables.shape[1] * page_len
@@ -379,20 +399,56 @@ def decode_step_slots_paged(model: TransformerLM, params: Params,
                              axis=1)[:, 0]
     wo = idx % page_len
     dest = jnp.where(active, wp, n_pages)
+    if kv_bits is not None:
+        from ..ops.quant import pack_page_nibbles, quantize_page_blocks
+        bsz = tokens.shape[0]
+        n_tail = k_tail[0].shape[0]
+        # tail-buffer write target (one exact f32 page per slot);
+        # inactive rows are dropped exactly like the pool scatter
+        dest_t = jnp.where(active, jnp.arange(bsz), n_tail)
+        # page completion: this write fills position page_len - 1 — the
+        # ONE moment a page's values are quantized (from exact f32)
+        completed = jnp.logical_and(active, wo == page_len - 1)
+        dest_q = jnp.where(completed, wp, n_pages)
 
     new_kp, new_vp = [], []
+    new_ks, new_vs, new_kt, new_vt = [], [], [], []
     for i, blk in enumerate(model.blocks):
         p = params["blocks"][i]
         hq, hk, hv = blk.attn.project_qkv(p["attn"],
                                           blk.ln1.apply(p["ln1"], x))
         hq, hk = blk.attn.maybe_rope(hq, hk, idx[:, None, None])
-        kp = k_pages[i].at[dest, :, wo].set(
-            hk[:, :, 0, :].astype(k_pages[i].dtype), mode="drop")
-        vp = v_pages[i].at[dest, :, wo].set(
-            hv[:, :, 0, :].astype(v_pages[i].dtype), mode="drop")
+        if kv_bits is None:
+            kp = k_pages[i].at[dest, :, wo].set(
+                hk[:, :, 0, :].astype(k_pages[i].dtype), mode="drop")
+            vp = v_pages[i].at[dest, :, wo].set(
+                hv[:, :, 0, :].astype(v_pages[i].dtype), mode="drop")
+        else:
+            kt = k_tail[i].at[dest_t, :, wo].set(
+                hk[:, :, 0, :].astype(jnp.float32), mode="drop")
+            vt = v_tail[i].at[dest_t, :, wo].set(
+                hv[:, :, 0, :].astype(jnp.float32), mode="drop")
+            qk, sk = quantize_page_blocks(kt, kv_bits)  # (B,Hkv,L,Dh)
+            qv, sv = quantize_page_blocks(vt, kv_bits)
+            if kv_bits == 4:
+                qk, qv = pack_page_nibbles(qk), pack_page_nibbles(qv)
+            kp = k_pages[i].at[dest_q].set(qk, mode="drop")
+            vp = v_pages[i].at[dest_q].set(qv, mode="drop")
+            ks_i = k_scales[i].at[dest_q].set(sk, mode="drop")
+            vs_i = v_scales[i].at[dest_q].set(sv, mode="drop")
+            new_ks.append(ks_i)
+            new_vs.append(vs_i)
+            new_kt.append(kt)
+            new_vt.append(vt)
         new_kp.append(kp)
         new_vp.append(vp)
-        if blockwise:
+        if kv_bits is not None:
+            o = paged_decode_attention(hq, kp, vp, tables, idx,
+                                       hk, hv, scale=scale,
+                                       page_len=page_len,
+                                       k_scales=ks_i, v_scales=vs_i,
+                                       k_tail=kt, v_tail=vt)
+        elif blockwise:
             # the page gather lives inside the block loop; hk/hv are
             # re-selected at the write position per block — identity
             # for active rows (already scattered), and gives inactive
@@ -413,12 +469,17 @@ def decode_step_slots_paged(model: TransformerLM, params: Params,
         x = x + blk.mlp(p, x)
 
     x = model.ln_f.apply(params["ln_f"], x)
-    return model.project_vocab(params, x)[:, 0], new_kp, new_vp
+    logits = model.project_vocab(params, x)[:, 0]
+    if kv_bits is None:
+        return logits, new_kp, new_vp
+    return logits, new_kp, new_vp, new_ks, new_vs, new_kt, new_vt
 
 
 def prefill_partial_paged(model: TransformerLM, params: Params,
                           k_pages, v_pages, table_row, tokens, offset,
-                          true_len, *, page_len: int
+                          true_len, *, page_len: int, kv_bits=None,
+                          k_scales=None, v_scales=None,
+                          k_tail=None, v_tail=None, slot=None
                           ) -> Tuple[jnp.ndarray, list, list]:
     """Prefill the TAIL of a prompt into pool pages, attending over a
     page-resident shared prefix (``serve/pages/``).
@@ -441,7 +502,20 @@ def prefill_partial_paged(model: TransformerLM, params: Params,
     the shared prefix pages are never written.
 
     Returns ``(logits (1, vocab) at the last real position,
-    new_k_pages, new_v_pages)``."""
+    new_k_pages, new_v_pages)``.
+
+    **Quantized resident pool** (``kv_bits`` = 8 | 4; docs/serving.md):
+    tail K/V that COMPLETE a page (a full ``page_len`` chunk of the
+    tail within ``true_len``) are quantized once — from exact f32, on
+    the wire block grid — and scattered into the int pool with their
+    scales; the partial last page goes EXACT into the per-slot f32
+    tail buffer ``k_tail[.][slot]``/``v_tail[.][slot]`` (stale region
+    past ``true_len`` zeroed), where decode continues writing it. The
+    shared prefix is dequantized for the tail's attention; the tail
+    itself attends in-register exact f32, so a cold prompt's logits and
+    written values see no quantization at admission. Returns the
+    extended tuple ``(logits, new_k_pages, new_v_pages, new_k_scales,
+    new_v_scales, new_k_tail, new_v_tail)``."""
     b, s = tokens.shape
     n_pages = k_pages[0].shape[0]
     width = table_row.shape[0] * page_len
@@ -465,29 +539,101 @@ def prefill_partial_paged(model: TransformerLM, params: Params,
                                    table_row.shape[0] - 1)]
     dest_off = positions % page_len
     dest = jnp.where(jnp.arange(s) < true_len, dest_page, n_pages)
+    if kv_bits is not None:
+        from ..ops.quant import (dequantize_page_blocks,
+                                 page_block_map, pack_page_nibbles,
+                                 quantize_page_blocks,
+                                 unpack_page_nibbles)
+        h_kv = getattr(model, "n_kv_heads", model.n_heads)
+        dh = model.dim // model.n_heads
+        bmap = page_block_map(h_kv, page_len, dh)
+        slot = jnp.asarray(slot, jnp.int32)
+        # the tail starts at a page boundary (offset is page-aligned),
+        # so tail chunk c IS the slot's page offset//page_len + c; the
+        # chunk is complete — quantizable — iff it lies within true_len
+        n_chunks = s // page_len
+        r = jnp.arange(page_len)
+        # partial-page span (tail coordinates): the positions past the
+        # last complete page, exact f32 into the slot's tail buffer
+        floor = (offset + true_len) // page_len * page_len - offset
+        t_src = jnp.clip(floor + r, 0, s - 1)
+        t_valid = ((floor + r) < true_len)[None, :, None]
 
     new_kp, new_vp = [], []
+    new_ks, new_vs, new_kt, new_vt = [], [], [], []
     for i, blk in enumerate(model.blocks):
         p = params["blocks"][i]
         hq, hk, hv = blk.attn.project_qkv(p["attn"],
                                           blk.ln1.apply(p["ln1"], x))
         hq, hk = blk.attn.maybe_rope(hq, hk, positions)
-        kp = k_pages[i].at[dest, :, dest_off].set(
-            jnp.moveaxis(hk[0], 1, 0).astype(k_pages[i].dtype),
-            mode="drop")
-        vp = v_pages[i].at[dest, :, dest_off].set(
-            jnp.moveaxis(hv[0], 1, 0).astype(v_pages[i].dtype),
-            mode="drop")
+        if kv_bits is None:
+            kp = k_pages[i].at[dest, :, dest_off].set(
+                jnp.moveaxis(hk[0], 1, 0).astype(k_pages[i].dtype),
+                mode="drop")
+            vp = v_pages[i].at[dest, :, dest_off].set(
+                jnp.moveaxis(hv[0], 1, 0).astype(v_pages[i].dtype),
+                mode="drop")
+        else:
+            kp, vp = k_pages[i], v_pages[i]
+            ks_i, vs_i = k_scales[i], v_scales[i]
+            for c in range(n_chunks):
+                lo = c * page_len
+                ck = hk[0, :, lo:lo + page_len, :].astype(jnp.float32)
+                cv = hv[0, :, lo:lo + page_len, :].astype(jnp.float32)
+                qk, sk = quantize_page_blocks(ck, kv_bits)
+                qv, sv = quantize_page_blocks(cv, kv_bits)
+                if kv_bits == 4:
+                    qk, qv = (pack_page_nibbles(qk),
+                              pack_page_nibbles(qv))
+                # incomplete chunks route out of bounds and drop; the
+                # page index gather clamps harmlessly for them
+                comp = (lo + page_len) <= true_len
+                dpi = jnp.where(
+                    comp,
+                    table_row[jnp.clip(offset // page_len + c, 0,
+                                       table_row.shape[0] - 1)],
+                    n_pages)
+                kp = kp.at[dpi].set(qk, mode="drop")
+                vp = vp.at[dpi].set(qv, mode="drop")
+                ks_i = ks_i.at[dpi].set(sk, mode="drop")
+                vs_i = vs_i.at[dpi].set(sv, mode="drop")
+            tk = jnp.where(t_valid,
+                           jnp.take(hk[0], t_src, axis=1), 0.0) \
+                .astype(jnp.float32)
+            tv = jnp.where(t_valid,
+                           jnp.take(hv[0], t_src, axis=1), 0.0) \
+                .astype(jnp.float32)
+            kt = k_tail[i].at[slot].set(tk)
+            vt = v_tail[i].at[slot].set(tv)
+            new_ks.append(ks_i)
+            new_vs.append(vs_i)
+            new_kt.append(kt)
+            new_vt.append(vt)
         new_kp.append(kp)
         new_vp.append(vp)
         # prefix keys from the (updated) pool; tail keys inline — the
         # tail pages were just written, but using the in-register tail
         # avoids a second gather and keeps the math identical to
         # prefill_partial's [real | pad] layout
-        pref_k = kp[table_row].transpose(1, 0, 2, 3) \
-            .reshape(1, -1, width, kp.shape[-1]).astype(hk.dtype)
-        pref_v = vp[table_row].transpose(1, 0, 2, 3) \
-            .reshape(1, -1, width, vp.shape[-1]).astype(hv.dtype)
+        if kv_bits is not None:
+            # dequantize the gathered prefix pages (the mask exposes
+            # only positions < offset — complete, quantized, shared);
+            # the tail attends in-register EXACT, so cold admissions
+            # (offset == 0) see zero quantization error
+            gk, gv = kp[table_row], vp[table_row]
+            if kv_bits == 4:
+                gk, gv = unpack_page_nibbles(gk), unpack_page_nibbles(gv)
+            gk = dequantize_page_blocks(gk, ks_i[table_row], bmap)
+            gv = dequantize_page_blocks(gv, vs_i[table_row], bmap)
+            pref_k = gk.transpose(1, 0, 2, 3) \
+                .reshape(1, -1, width, gk.shape[-1]).astype(hk.dtype)
+            pref_v = gv.transpose(1, 0, 2, 3) \
+                .reshape(1, -1, width, gv.shape[-1]).astype(hv.dtype)
+        else:
+            pref_k = kp[table_row].transpose(1, 0, 2, 3) \
+                .reshape(1, -1, width, kp.shape[-1]).astype(hk.dtype)
+            pref_v = vp[table_row].transpose(1, 0, 2, 3) \
+                .reshape(1, -1, width, vp.shape[-1]).astype(hv.dtype)
         k_all = jnp.concatenate([pref_k, hk], axis=2)   # (1,Hkv,W+S,Dh)
         v_all = jnp.concatenate([pref_v, hv], axis=2)
         bq, hh, _, dd = hq.shape
@@ -505,7 +651,10 @@ def prefill_partial_paged(model: TransformerLM, params: Params,
 
     x_last = jax.lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)
     x_last = model.ln_f.apply(params["ln_f"], x_last)
-    return model.project_vocab(params, x_last)[:, 0], new_kp, new_vp
+    logits = model.project_vocab(params, x_last)[:, 0]
+    if kv_bits is None:
+        return logits, new_kp, new_vp
+    return logits, new_kp, new_vp, new_ks, new_vs, new_kt, new_vt
 
 
 def _sample(logits, rng, temperature: float, top_k: Optional[int],

@@ -183,7 +183,9 @@ def blockwise_decode_attention(hq, k, v, idx, *, scale,
 
 
 def paged_decode_attention(hq, k_pages, v_pages, tables, idx, new_k,
-                           new_v, *, scale, page_len: int):
+                           new_v, *, scale, page_len: int,
+                           k_scales=None, v_scales=None,
+                           k_tail=None, v_tail=None):
     """Single-token attention over a PAGED pool, one page per step.
 
     hq: (B, H, 1, Dh); k_pages/v_pages: (n_pages[+1], Hkv, page_len,
@@ -197,6 +199,19 @@ def paged_decode_attention(hq, k_pages, v_pages, tables, idx, new_k,
     Visits only ``resident_blocks(idx, page_len, P)`` pages: the page
     gather itself is inside the loop, so a long pool serving short
     requests neither reads nor multiplies its dead pages.
+
+    **Quantized resident pool** (``serve/pages``, docs/serving.md):
+    when ``k_scales``/``v_scales`` are given, the pool buffers hold
+    block-quantized int pages (int8 at q8; nibble-packed uint8 with
+    ``Dh/2`` last dim at q4) and ``k_scales``/``v_scales`` are their
+    ``(n_pages[+1], nb)`` f32 per-page-per-block scales — dequant rides
+    the page gather (one scale lookup + multiply per page, f32 math).
+    ``k_tail``/``v_tail`` ``(B, Hkv, page_len, Dh)`` f32 are the
+    per-slot EXACT tail pages (positions not yet quantized): the page
+    holding position ``idx[b]`` is overlaid wholesale from the tail
+    buffer, so un-finalized positions attend exactly and quantization
+    error only ever comes from completed pages' single rounding. All
+    four default to None = the exact path, traced jaxpr unchanged.
     """
     b, h, _, dh = hq.shape
     hkv = k_pages.shape[1]
@@ -206,6 +221,13 @@ def paged_decode_attention(hq, k_pages, v_pages, tables, idx, new_k,
     nb = resident_blocks(idx, page_len, total)
     nk_g = new_k.reshape(b, hkv, 1, dh)
     nv_g = new_v.reshape(b, hkv, 1, dh)
+    quant = k_scales is not None
+    if quant:
+        from .quant import (dequantize_page_blocks, page_block_map,
+                            unpack_page_nibbles)
+        packed = k_pages.dtype == jnp.uint8
+        bmap = page_block_map(hkv, page_len, dh)
+        tail_page = idx // page_len
 
     def body(j, carry):
         pids = jax.lax.dynamic_index_in_dim(tables, j, axis=1,
@@ -213,6 +235,20 @@ def paged_decode_attention(hq, k_pages, v_pages, tables, idx, new_k,
         k_blk = jnp.take(k_pages, pids, axis=0)  # (B, Hkv, L, Dh)
         v_blk = jnp.take(v_pages, pids, axis=0)
         pos = j * page_len + jnp.arange(page_len)
+        if quant:
+            if packed:
+                k_blk = unpack_page_nibbles(k_blk)
+                v_blk = unpack_page_nibbles(v_blk)
+            k_blk = dequantize_page_blocks(
+                k_blk, jnp.take(k_scales, pids, axis=0), bmap)
+            v_blk = dequantize_page_blocks(
+                v_blk, jnp.take(v_scales, pids, axis=0), bmap)
+            # the slot's CURRENT page is exact: overlay the f32 tail
+            # buffer before the write-mask overlay (order matters — wm
+            # must still win for inactive rows' value semantics)
+            it = (j == tail_page)[:, None, None, None]
+            k_blk = jnp.where(it, k_tail, k_blk)
+            v_blk = jnp.where(it, v_tail, v_blk)
         wm = (pos[None, :] == idx[:, None])[:, None, :, None]
         k_blk = jnp.where(wm, nk_g.astype(k_blk.dtype), k_blk)
         v_blk = jnp.where(wm, nv_g.astype(v_blk.dtype), v_blk)
@@ -229,4 +265,5 @@ def paged_decode_attention(hq, k_pages, v_pages, tables, idx, new_k,
              jnp.zeros((b, hkv, g, 1), jnp.float32),
              jnp.zeros((b, hkv, g, 1, dh), jnp.float32))
     m, l, acc = jax.lax.fori_loop(0, nb, body, carry)
-    return _finish(m, l, acc, v_pages.dtype).reshape(b, h, 1, dh)
+    out_dtype = new_v.dtype if quant else v_pages.dtype
+    return _finish(m, l, acc, out_dtype).reshape(b, h, 1, dh)

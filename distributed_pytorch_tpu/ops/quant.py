@@ -187,6 +187,84 @@ def dequantize_grad_blocks(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
     return q.astype(jnp.float32) * scale
 
 
+# ---------------------------------------------------------------------------
+# paged-KV page codec (jnp face of the quantized resident pool,
+# serve/pages/ — docs/serving.md "Quantized resident pool")
+# ---------------------------------------------------------------------------
+
+
+def page_block_map(h_kv: int, page_len: int, dh: int) -> jnp.ndarray:
+    """``(Hkv, page_len, Dh)`` int32 constant mapping each page element
+    to its wire scale block (flat C-order ``QUANT_BLOCK`` blocking —
+    the SAME grid ``comm/wire.py`` frames a handoff page on, which is
+    what keeps pool bytes and wire bytes bit-identical at matched
+    widths). Constant-folded by XLA; the in-kernel dequant is one
+    gather + one multiply per page."""
+    from ..comm.wire import QUANT_BLOCK
+    e = h_kv * page_len * dh
+    return (jnp.arange(e, dtype=jnp.int32) // QUANT_BLOCK) \
+        .reshape(h_kv, page_len, dh)
+
+
+def quantize_page_blocks(pages: jnp.ndarray, bits: int):
+    """Quantize whole pages onto the wire block grid, inside a compiled
+    program.
+
+    ``pages``: f32 ``(..., Hkv, page_len, Dh)`` (any leading batch
+    dims). Returns ``(q int8 UNPACKED same shape, scales (..., nb)
+    f32)`` where ``nb = wire.num_blocks(Hkv*page_len*Dh)``. The flat
+    page is zero-padded up to ``nb * QUANT_BLOCK`` before blocking —
+    padding changes neither a block's amax nor its all-integer snap, so
+    the result is bit-identical to the numpy wire codec on the unpadded
+    page (``serve/pages/quant.py`` asserts this agreement in tests)."""
+    from ..comm.wire import QUANT_BLOCK, num_blocks
+    shape = pages.shape
+    e = shape[-3] * shape[-2] * shape[-1]
+    nb = num_blocks(e)
+    lead = shape[:-3]
+    flat = pages.astype(jnp.float32).reshape(lead + (e,))
+    pad = nb * QUANT_BLOCK - e
+    if pad:
+        flat = jnp.pad(flat, [(0, 0)] * len(lead) + [(0, pad)])
+    q, scale = quantize_grad_blocks(
+        flat.reshape(lead + (nb, QUANT_BLOCK)), bits=bits)
+    q = q.reshape(lead + (nb * QUANT_BLOCK,))[..., :e].reshape(shape)
+    return q, scale[..., 0]
+
+
+def dequantize_page_blocks(q: jnp.ndarray, scales: jnp.ndarray,
+                           block_map: jnp.ndarray) -> jnp.ndarray:
+    """``q`` (..., Hkv, L, Dh) int8, ``scales`` (..., nb),
+    ``block_map`` from :func:`page_block_map` → f32 pages. The scale
+    gather rides the page gather nearly free (one (..., nb) lookup
+    broadcast over the page)."""
+    return q.astype(jnp.float32) * scales[..., block_map]
+
+
+def pack_page_nibbles(q: jnp.ndarray) -> jnp.ndarray:
+    """jnp twin of ``comm/wire.py:pack_nibbles`` over page layouts:
+    ``(..., Dh)`` int8 (|q| <= 7) → ``(..., Dh // 2)`` uint8, pairs of
+    flat-adjacent elements with the LOW nibble first — byte-identical
+    to the wire/native packing, so packed pool pages ship into a q4
+    handoff frame without re-encoding."""
+    lo = q[..., 0::2]
+    hi = q[..., 1::2]
+    byte = jnp.bitwise_or(jnp.bitwise_and(lo, 0x0F),
+                          jnp.left_shift(jnp.bitwise_and(hi, 0x0F), 4))
+    return jax.lax.bitcast_convert_type(byte.astype(jnp.int8), jnp.uint8)
+
+
+def unpack_page_nibbles(packed: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`pack_page_nibbles`: ``(..., Dh // 2)`` uint8 →
+    ``(..., Dh)`` sign-extended int8 (arithmetic shifts recover the
+    two's-complement nibbles)."""
+    b = jax.lax.bitcast_convert_type(packed, jnp.int8)
+    lo = jnp.right_shift(jnp.left_shift(b, 4), 4)
+    hi = jnp.right_shift(b, 4)
+    return jnp.stack([lo, hi], axis=-1) \
+        .reshape(packed.shape[:-1] + (packed.shape[-1] * 2,))
+
+
 class ErrorFeedback:
     """Error-feedback residual for repeated lossy gradient reduction.
 

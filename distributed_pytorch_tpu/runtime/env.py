@@ -382,6 +382,13 @@ register("DPX_SERVE_PREFIX_SHARE", "bool", True,
          "Enable radix prefix sharing in the paged serving cache "
          "(refcounted reuse of resident full prompt pages; 0 = paged "
          "layout without sharing).")
+register("DPX_SERVE_KV_DTYPE", "str", "f32",
+         "Resident storage width of the paged serving KV pool: `f32` "
+         "(exact pages — the bit-exact-tokens default contract), `q8` "
+         "(block-int8 pages + per-page scales, ~3.9x resident tokens "
+         "per byte) or `q4` (nibble-packed, ~7.5x). Dequant happens "
+         "inside the one paged decode program; ignored by non-paged "
+         "engines (docs/serving.md \"Quantized resident pool\").")
 register("DPX_SERVE_DISAGG", "bool", False,
          "Serve through the disaggregated prefill/decode split "
          "(serve/disagg/) where the front door supports it "

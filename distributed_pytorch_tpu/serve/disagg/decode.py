@@ -51,7 +51,8 @@ class DecodeEngine:
     """The decode loop + slot pool of the disaggregated split."""
 
     def __init__(self, model, params, router, transport, *,
-                 n_slots: int, max_len: int, page_len: int, n_pages: int):
+                 n_slots: int, max_len: int, page_len: int, n_pages: int,
+                 kv_dtype: str = "f32"):
         self.model = model
         self.params = params
         self.router = router
@@ -61,7 +62,7 @@ class DecodeEngine:
         # (sharing already happened on the prefill side)
         self.pool = PagedSlotPool(model, n_slots, max_len,
                                   page_len=page_len, n_pages=n_pages,
-                                  prefix_share=False)
+                                  prefix_share=False, kv_dtype=kv_dtype)
         self.iterations = 0
         self.tokens_emitted = 0
         self._samplers: Dict[tuple, callable] = {}
@@ -166,7 +167,11 @@ class DecodeEngine:
                 return
             t_recv = time.monotonic()
             try:
-                frame = frames.decode_frame(raw)
+                # matched pool/wire width keeps pages quantized through
+                # the decode: the sender's resident bits are adopted
+                # verbatim (no dequant→requant double hop)
+                frame = frames.decode_frame(
+                    raw, keep_bits=self.pool.quant_bits)
             except HandoffCorrupt as e:
                 self.router.fail_handoff_corrupt(e, self.iterations)
                 continue
@@ -193,7 +198,12 @@ class DecodeEngine:
                 continue
             slot = self._free[-1]
             try:
-                self.pool.adopt(slot, frame.length, frame.ks, frame.vs)
+                if getattr(frame, "quantized", False):
+                    self.pool.adopt_quantized(slot, frame.length,
+                                              frame.ks, frame.vs)
+                else:
+                    self.pool.adopt(slot, frame.length, frame.ks,
+                                    frame.vs)
             except PagePoolExhausted as e:
                 if self._running:
                     return            # retry after a retirement

@@ -88,6 +88,21 @@ def _encode_page(page: np.ndarray, bits: Optional[int]) -> bytes:
     return scales.tobytes() + payload.tobytes()
 
 
+def _encode_page_prequant(q_page: np.ndarray, scales: np.ndarray,
+                          bits: int) -> bytes:
+    """Frame one ALREADY-quantized page (unpacked int8 + per-block
+    scales, the quantized pool's resident format) without touching the
+    values: the same q codes :func:`_encode_page` would emit for the
+    page's dequantized f32 image (pool and wire share one block codec),
+    with the resident scales shipped verbatim — where the requantize
+    trip's scales would pay a one-ulp double rounding. This is the
+    no-double-hop half of the matched-width handoff pass-through."""
+    q = np.ascontiguousarray(q_page, np.int8).ravel()
+    payload = wire.pack_nibbles(q) if bits == 4 else q.view(np.uint8)
+    return np.ascontiguousarray(scales, np.float32).tobytes() \
+        + payload.tobytes()
+
+
 def _decode_page(buf: memoryview, shape: Tuple[int, ...],
                  bits: Optional[int]) -> np.ndarray:
     n = int(np.prod(shape))
@@ -101,6 +116,22 @@ def _decode_page(buf: memoryview, shape: Tuple[int, ...],
     return wire.dequantize_blocks(q, scales).reshape(shape)
 
 
+def _decode_page_raw(buf: memoryview, shape: Tuple[int, ...],
+                     bits: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse one quantized page WITHOUT dequantizing: ``(q unpacked
+    int8 page-shaped, scales (nb,) f32)`` — fed straight into
+    ``PagedSlotPool.adopt_quantized`` when the pool's resident width
+    matches the wire's."""
+    n = int(np.prod(shape))
+    nb = wire.num_blocks(n)
+    scales = np.frombuffer(buf, np.float32, nb).copy()
+    raw = np.frombuffer(buf[4 * nb:], np.uint8,
+                        wire.payload_bytes(n, bits))
+    q = (wire.unpack_nibbles(raw, n) if bits == 4
+         else raw.view(np.int8).copy())
+    return q.reshape(shape), scales
+
+
 @dataclass
 class HandoffFrame:
     """A decoded handoff: the decode engine feeds ``ks``/``vs`` straight
@@ -110,9 +141,13 @@ class HandoffFrame:
     length: int                 # prompt length S (pages cover ceil(S/L))
     bits: Optional[int]         # None = exact f32 wire
     logits: np.ndarray          # (vocab,) f32, always exact
-    ks: List[np.ndarray]        # per layer (P, Hkv, page_len, Dh) f32
-    vs: List[np.ndarray]
+    ks: List[np.ndarray]        # per layer (P, Hkv, page_len, Dh) f32;
+    vs: List[np.ndarray]        # when quantized: per layer (q, scales)
     kv_bytes: int               # the booked/asserted wire accounting
+    #: True when ``decode_frame(..., keep_bits=)`` matched the wire
+    #: width and ks/vs carry ``(q unpacked int8, scales)`` tuples for
+    #: ``adopt_quantized`` instead of dequantized f32 pages.
+    quantized: bool = False
 
 
 def encode_frame(request_id: int, length: int, logits: np.ndarray,
@@ -145,10 +180,49 @@ def encode_frame(request_id: int, length: int, logits: np.ndarray,
             + b"".join(pages)), kv_bytes
 
 
-def decode_frame(buf) -> HandoffFrame:
+def encode_frame_quantized(request_id: int, length: int,
+                           logits: np.ndarray, kqs, vqs,
+                           bits: int) -> Tuple[bytes, int]:
+    """Serialize one handoff from a quantized pool's RESIDENT bits
+    (``PagedSlotPool.extract_quantized`` output: per layer ``(q
+    unpacked int8 (P, Hkv, page_len, Dh), scales (P, nb))``) — same
+    frame layout as :func:`encode_frame` and the same q codes an
+    exact-extract + requantize trip would produce (pool and wire share
+    one block codec), with the resident scales verbatim where the
+    requantize trip would drift them by one ulp of double rounding."""
+    wire.quant_levels(bits)
+    n_layers = len(kqs)
+    n_pages, h_kv, page_len, dh = kqs[0][0].shape
+    logits = np.ascontiguousarray(logits, np.float32).ravel()
+    hdr = np.array([MAGIC, VERSION, request_id, bits, n_layers, n_pages,
+                    h_kv, page_len, dh, length, logits.size, 0],
+                   np.int64)
+    pages: List[bytes] = []
+    for layer in range(n_layers):
+        for q, scales in (kqs[layer], vqs[layer]):
+            for p in range(n_pages):
+                pages.append(_encode_page_prequant(q[p], scales[p], bits))
+    kv_bytes = sum(len(p) for p in pages)
+    hdr[11] = kv_bytes
+    crcs = np.empty(1 + len(pages), np.uint32)
+    crcs[0] = crc32c(hdr.tobytes() + logits.tobytes())
+    for i, p in enumerate(pages):
+        crcs[i + 1] = crc32c(p)
+    return (hdr.tobytes() + crcs.tobytes() + logits.tobytes()
+            + b"".join(pages)), kv_bytes
+
+
+def decode_frame(buf, keep_bits: Optional[int] = None) -> HandoffFrame:
     """Parse + integrity-check a frame; raises a typed
     :class:`HandoffCorrupt` (request + first bad page + blamed engine)
-    on any damage."""
+    on any damage.
+
+    ``keep_bits``: the receiving pool's resident quant width (or None).
+    When it matches a quantized frame's wire width, pages are NOT
+    dequantized — ks/vs carry ``(q, scales)`` tuples and ``quantized``
+    is True, so the adopting pool installs the sender's exact resident
+    bits (the decode half of the matched-width pass-through). Every
+    CRC is still checked."""
     buf = memoryview(bytes(buf))
     if len(buf) < _N_HDR * 8:
         raise HandoffCorrupt(
@@ -207,10 +281,20 @@ def decode_frame(buf) -> HandoffFrame:
     logits = np.frombuffer(buf, np.float32, vocab,
                            offset=off_logits).copy()
     shape = (h_kv, page_len, dh)
-    ks = [np.empty((n_pages,) + shape, np.float32)
-          for _ in range(n_layers)]
-    vs = [np.empty((n_pages,) + shape, np.float32)
-          for _ in range(n_layers)]
+    keep = bits is not None and keep_bits == bits
+    if keep:
+        nb = wire.num_blocks(page_elems)
+        ks = [(np.empty((n_pages,) + shape, np.int8),
+               np.empty((n_pages, nb), np.float32))
+              for _ in range(n_layers)]
+        vs = [(np.empty((n_pages,) + shape, np.int8),
+               np.empty((n_pages, nb), np.float32))
+              for _ in range(n_layers)]
+    else:
+        ks = [np.empty((n_pages,) + shape, np.float32)
+              for _ in range(n_layers)]
+        vs = [np.empty((n_pages,) + shape, np.float32)
+              for _ in range(n_layers)]
     idx = 0
     for layer in range(n_layers):
         for tensor in (ks[layer], vs[layer]):
@@ -223,7 +307,12 @@ def decode_frame(buf) -> HandoffFrame:
                         f"tensor {idx} (layer {layer}) failed CRC32C",
                         request_id=request_id, engine="prefill",
                         page=idx)
-                tensor[p] = _decode_page(chunk, shape, bits)
+                if keep:
+                    tensor[0][p], tensor[1][p] = _decode_page_raw(
+                        chunk, shape, bits)
+                else:
+                    tensor[p] = _decode_page(chunk, shape, bits)
                 idx += 1
     return HandoffFrame(request_id=request_id, length=length, bits=bits,
-                        logits=logits, ks=ks, vs=vs, kv_bytes=kv_bytes)
+                        logits=logits, ks=ks, vs=vs, kv_bytes=kv_bytes,
+                        quantized=keep)

@@ -44,7 +44,7 @@ class PrefillEngine:
 
     def __init__(self, model, params, router, transport, *, buckets,
                  page_len: int, n_pages: int, prefix_share: bool,
-                 bits: Optional[int]):
+                 bits: Optional[int], kv_dtype: str = "f32"):
         self.model = model
         self.params = params
         self.router = router
@@ -56,7 +56,8 @@ class PrefillEngine:
         # cross-request prefix residency
         self.pool = PagedSlotPool(model, 1, max(buckets),
                                   page_len=page_len, n_pages=n_pages,
-                                  prefix_share=prefix_share)
+                                  prefix_share=prefix_share,
+                                  kv_dtype=kv_dtype)
         self.iterations = 0
         self._cond = threading.Condition()
         self._stop = False
@@ -142,11 +143,21 @@ class PrefillEngine:
             return
         req.prefix_hit_pages = n_hit
         req.prefill_tokens_saved = offset
-        length, ks, vs = self.pool.extract(0)
-        self.pool.release(0)
-        frame, kv_bytes = frames.encode_frame(
-            req.request_id, length, np.asarray(logits)[0], ks, vs,
-            self.bits)
+        if (self.pool.quant_bits is not None
+                and self.pool.quant_bits == self.bits):
+            # matched pool/wire width: the frame carries the pool's
+            # resident bits verbatim — no dequant→requant double hop
+            length, kqs, vqs = self.pool.extract_quantized(0)
+            self.pool.release(0)
+            frame, kv_bytes = frames.encode_frame_quantized(
+                req.request_id, length, np.asarray(logits)[0], kqs, vqs,
+                self.bits)
+        else:
+            length, ks, vs = self.pool.extract(0)
+            self.pool.release(0)
+            frame, kv_bytes = frames.encode_frame(
+                req.request_id, length, np.asarray(logits)[0], ks, vs,
+                self.bits)
         req.handoff_bytes = kv_bytes
         # enter the handoff stage BEFORE the send: if the transport
         # dies inside send, the victim is already attributable as

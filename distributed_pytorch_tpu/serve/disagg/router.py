@@ -79,6 +79,12 @@ class DisaggConfig:
     prefix_share: Optional[bool] = None
     handoff_width: Optional[str] = None    # "f32" | "q8" | "q4"
     handoff_timeout_ms: Optional[int] = None
+    # resident storage width of BOTH pools ("f32" | "q8" | "q4"; None =
+    # DPX_SERVE_KV_DTYPE). When it matches handoff_width the frame
+    # carries the prefill pool's resident bits verbatim and the decode
+    # pool adopts them verbatim — no dequant→requant double hop
+    # (docs/serving.md "Quantized resident pool").
+    kv_dtype: Optional[str] = None
 
 
 class DisaggEngine:
@@ -154,13 +160,17 @@ class DisaggEngine:
                 f"recv; drive a blocking cross-process transport from "
                 f"a dedicated receiver instead (see "
                 f"serve/disagg/transport.py)")
+        kv_dtype = (cfg.kv_dtype if cfg.kv_dtype is not None
+                    else dpxenv.get("DPX_SERVE_KV_DTYPE"))
+        self.kv_dtype = kv_dtype
         self.prefill = PrefillEngine(
             model, params, self, self.transport, buckets=self.buckets,
             page_len=page_len, n_pages=prefill_pages,
-            prefix_share=bool(share), bits=bits)
+            prefix_share=bool(share), bits=bits, kv_dtype=kv_dtype)
         self.decode = DecodeEngine(
             model, params, self, self.transport, n_slots=cfg.n_slots,
-            max_len=cfg.max_len, page_len=page_len, n_pages=n_pages)
+            max_len=cfg.max_len, page_len=page_len, n_pages=n_pages,
+            kv_dtype=kv_dtype)
         self._lock = threading.Lock()
         self._handoff: Dict[int, Request] = {}   # sent, not yet adopted
         self._requests: Dict[int, Request] = {}  # all in-flight
@@ -485,6 +495,11 @@ class DisaggEngine:
         dpxmon.set_gauge("serve.tokens_emitted", d["tokens_emitted"])
         dpxmon.set_gauge("serve.pool_occupancy",
                          d["pages"]["pool_occupancy"])
+        dpxmon.set_gauge("serve.kv_bits", d["pages"]["kv_bits"])
+        dpxmon.set_gauge("serve.kv_pool_bytes",
+                         d["pages"]["kv_pool_bytes"])
+        dpxmon.set_gauge("serve.bytes_per_resident_token",
+                         d["pages"]["bytes_per_resident_token"])
         dpxmon.set_gauge("serve.handoff_bytes_sent", int(
             self.transport.stats.summary()
             .get("handoff_send", {}).get("bytes", 0)))

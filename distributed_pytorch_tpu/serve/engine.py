@@ -88,6 +88,13 @@ class EngineConfig:
     page_len: Optional[int] = None
     n_pages: Optional[int] = None
     prefix_share: Optional[bool] = None
+    # resident KV storage width for the paged pool (docs/serving.md
+    # "Quantized resident pool"): "f32" exact (default) | "q8" | "q4".
+    # None defaults from DPX_SERVE_KV_DTYPE. Requires paged=True; an
+    # explicit non-f32 value on the contiguous pool raises, while an
+    # env-driven one is ignored (the env var sizes paged fleets without
+    # breaking non-paged engines in the same process).
+    kv_dtype: Optional[str] = None
     # reshard-free admit (docs/front_door.md): the params handed to the
     # engine must ALREADY carry these shardings — typically a train
     # step's ``out_shardings["params"]`` (parallel.handoff_shardings).
@@ -150,10 +157,18 @@ class InferenceEngine:
                 n_pages = cfg.n_slots * (-(-cfg.max_len // page_len))
             share = (cfg.prefix_share if cfg.prefix_share is not None
                      else dpxenv.get("DPX_SERVE_PREFIX_SHARE"))
+            kv_dtype = (cfg.kv_dtype if cfg.kv_dtype is not None
+                        else dpxenv.get("DPX_SERVE_KV_DTYPE"))
             self.pool = PagedSlotPool(model, cfg.n_slots, cfg.max_len,
                                       page_len=page_len, n_pages=n_pages,
-                                      prefix_share=bool(share))
+                                      prefix_share=bool(share),
+                                      kv_dtype=kv_dtype)
         else:
+            if cfg.kv_dtype is not None and cfg.kv_dtype != "f32":
+                raise ValueError(
+                    f"kv_dtype={cfg.kv_dtype!r} requires the paged pool "
+                    "(paged=True) — the contiguous SlotPool has no "
+                    "quantized storage mode")
             self.pool = SlotPool(model, cfg.n_slots, cfg.max_len,
                                  window=self.window)
         self.metrics = cfg.metrics
@@ -363,6 +378,12 @@ class InferenceEngine:
             dpxmon.set_gauge("serve.prefix_hit_rate",
                              ps["prefix_hit_rate"] or 0.0)
             dpxmon.set_gauge("serve.page_evictions", ps["evictions"])
+            # resident-KV capacity gauges (gauges are plain floats, so
+            # the storage width rides as numeric bits: 32 / 8 / 4)
+            dpxmon.set_gauge("serve.kv_bits", ps["kv_bits"])
+            dpxmon.set_gauge("serve.kv_pool_bytes", ps["kv_pool_bytes"])
+            dpxmon.set_gauge("serve.bytes_per_resident_token",
+                             ps["bytes_per_resident_token"])
         dpxmon.emit_snapshot(path=self.metrics.path,
                              step=self._iteration,
                              source="serve_engine")
@@ -423,6 +444,20 @@ class InferenceEngine:
                         iteration=self._iteration)
                     exc.__cause__ = e
                     self._fail(req, exc, outcome="no_free_pages")
+                    continue
+                except AdmissionRejected as e:
+                    # pool-level typed rejection (e.g. tail_too_long):
+                    # deterministic for this prompt — requeueing could
+                    # never succeed, so fail now, request-attributed
+                    self._running.pop(slot, None)
+                    self._free.append(slot)
+                    req.slot = None
+                    exc = AdmissionRejected(
+                        f"request {req.request_id}: {e}", reason=e.reason,
+                        request_id=req.request_id,
+                        iteration=self._iteration)
+                    exc.__cause__ = e
+                    self._fail(req, exc, outcome=e.reason)
                     continue
                 req.prefix_hit_pages = n_hit
                 req.prefill_tokens_saved = offset

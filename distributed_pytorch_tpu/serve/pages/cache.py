@@ -33,20 +33,35 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...comm import wire
 from ...models.generate import (decode_step_slots_paged,
                                 prefill_partial_paged)
 from ...runtime import faults
 from ..cache import CompileCounts
+from ..types import AdmissionRejected
 from .pool import PagePool
 from .prefix import PrefixIndex
+from .quant import (dequantize_page_np, num_page_blocks, pack_pages_np,
+                    page_elems, quantize_page_np, resolve_kv_bits,
+                    unpack_pages_np)
 
 
 class PagedSlotPool:
     """Owns the page-pool arrays, the page tables, and the jitted paged
-    programs; all allocation/refcount/eviction policy is host-side."""
+    programs; all allocation/refcount/eviction policy is host-side.
+
+    ``kv_dtype`` selects the RESIDENT storage format (docs/serving.md
+    "Quantized resident pool"): ``"f32"`` (default) keeps exact pages
+    in the model dtype — the bit-exact contract, traced programs
+    unchanged; ``"q8"``/``"q4"`` store block-quantized int pages plus
+    per-page-per-block f32 scales (the ``comm/wire.py`` block format
+    the handoff frame uses), with per-slot f32 tail buffers holding
+    each slot's partial tail page so every element is quantized exactly
+    ONCE, on page completion, inside the same one decode program."""
 
     def __init__(self, model, n_slots: int, max_len: int, *,
-                 page_len: int, n_pages: int, prefix_share: bool = True):
+                 page_len: int, n_pages: int, prefix_share: bool = True,
+                 kv_dtype: str = "f32"):
         if max_len < 1:
             raise ValueError(f"max_len must be >= 1, got {max_len}")
         self.model = model
@@ -55,14 +70,45 @@ class PagedSlotPool:
         self.page_len = page_len
         self.n_pages = n_pages
         self.prefix_share = prefix_share
+        self.kv_dtype = kv_dtype
+        self.quant_bits = resolve_kv_bits(kv_dtype)
         self.pages_per_slot = -(-max_len // page_len)   # ceil
         dh = model.dim // model.n_heads
         h_kv = getattr(model, "n_kv_heads", model.n_heads)
-        shape = (n_pages, h_kv, page_len, dh)
-        self.k_pages: List[jax.Array] = [jnp.zeros(shape, model.dtype)
-                                         for _ in range(model.n_layers)]
-        self.v_pages: List[jax.Array] = [jnp.zeros(shape, model.dtype)
-                                         for _ in range(model.n_layers)]
+        self._page_shape = (h_kv, page_len, dh)
+        n_layers = model.n_layers
+        if self.quant_bits is None:
+            shape = (n_pages, h_kv, page_len, dh)
+            self.k_pages: List[jax.Array] = [
+                jnp.zeros(shape, model.dtype) for _ in range(n_layers)]
+            self.v_pages: List[jax.Array] = [
+                jnp.zeros(shape, model.dtype) for _ in range(n_layers)]
+            self.k_scales = self.v_scales = None
+            self.k_tail = self.v_tail = None
+        else:
+            if self.quant_bits == 4 and dh % 2:
+                raise ValueError(
+                    f"kv_dtype='q4' packs two nibbles per byte along "
+                    f"the head dim, which must be even (got Dh={dh})")
+            store = ((n_pages, h_kv, page_len, dh // 2)
+                     if self.quant_bits == 4
+                     else (n_pages, h_kv, page_len, dh))
+            sdt = jnp.uint8 if self.quant_bits == 4 else jnp.int8
+            nb = num_page_blocks(h_kv, page_len, dh)
+            self.page_blocks = nb
+            self.k_pages = [jnp.zeros(store, sdt) for _ in range(n_layers)]
+            self.v_pages = [jnp.zeros(store, sdt) for _ in range(n_layers)]
+            # scale 1 is the codec's all-zero-block snap — a never-
+            # written page dequantizes to exact zeros
+            self.k_scales = [jnp.ones((n_pages, nb), jnp.float32)
+                             for _ in range(n_layers)]
+            self.v_scales = [jnp.ones((n_pages, nb), jnp.float32)
+                             for _ in range(n_layers)]
+            tshape = (n_slots, h_kv, page_len, dh)
+            self.k_tail = [jnp.zeros(tshape, jnp.float32)
+                           for _ in range(n_layers)]
+            self.v_tail = [jnp.zeros(tshape, jnp.float32)
+                           for _ in range(n_layers)]
         # host-side state: page tables / lengths mirror the traced args
         # (tiny int32 uploads per call), policy state never leaves host
         self.tables = np.zeros((n_slots, self.pages_per_slot), np.int32)
@@ -72,7 +118,11 @@ class PagedSlotPool:
         self.index = PrefixIndex(page_len)
         self.compiles = CompileCounts()
         self._admit_fns: Dict[int, callable] = {}
-        self._decode_fn = jax.jit(self._decode, donate_argnums=(1, 2))
+        if self.quant_bits is None:
+            self._decode_fn = jax.jit(self._decode, donate_argnums=(1, 2))
+        else:
+            self._decode_fn = jax.jit(self._decode_q,
+                                      donate_argnums=(1, 2, 3, 4, 5, 6))
         # cumulative sharing counters (engine metrics / bench)
         self.prefix_lookups = 0
         self.prefix_hit_pages_total = 0
@@ -88,12 +138,35 @@ class PagedSlotPool:
                                        v_pages, tables, lengths, tokens,
                                        active, page_len=self.page_len)
 
+    def _decode_q(self, params, k_pages, v_pages, k_scales, v_scales,
+                  k_tail, v_tail, tables, lengths, tokens, active):
+        self.compiles.decode += 1          # trace-time only
+        return decode_step_slots_paged(self.model, params, k_pages,
+                                       v_pages, tables, lengths, tokens,
+                                       active, page_len=self.page_len,
+                                       kv_bits=self.quant_bits,
+                                       k_scales=k_scales,
+                                       v_scales=v_scales,
+                                       k_tail=k_tail, v_tail=v_tail)
+
     def _admit(self, params, k_pages, v_pages, table_row, tokens,
                offset, true_len, *, bucket: int):
         self.compiles.bump_prefill(bucket)  # trace-time only
         return prefill_partial_paged(self.model, params, k_pages,
                                      v_pages, table_row, tokens, offset,
                                      true_len, page_len=self.page_len)
+
+    def _admit_q(self, params, k_pages, v_pages, k_scales, v_scales,
+                 k_tail, v_tail, table_row, tokens, offset, true_len,
+                 slot, *, bucket: int):
+        self.compiles.bump_prefill(bucket)  # trace-time only
+        return prefill_partial_paged(self.model, params, k_pages,
+                                     v_pages, table_row, tokens, offset,
+                                     true_len, page_len=self.page_len,
+                                     kv_bits=self.quant_bits,
+                                     k_scales=k_scales,
+                                     v_scales=v_scales, k_tail=k_tail,
+                                     v_tail=v_tail, slot=slot)
 
     # -- allocation --------------------------------------------------------
 
@@ -126,7 +199,9 @@ class PagedSlotPool:
         admissions. Returns ``(last-position logits (1, vocab), n_hit
         pages, offset tokens)``. Raises :class:`PagePoolExhausted`
         (pool-attributed, no slot state changed) when the tail cannot
-        be allocated."""
+        be allocated, and a typed :class:`~..types.AdmissionRejected`
+        (``reason="tail_too_long"``) — BEFORE any page is refcounted
+        or allocated — when the tail exceeds every prefill bucket."""
         s = int(prompt.shape[0])
         L = self.page_len
         hits: List[int] = []
@@ -140,6 +215,19 @@ class PagedSlotPool:
         offset = n_hit * L
         tail_len = s - offset
         n_fresh = -(-s // L) - n_hit
+        # bucket selection BEFORE any state change: a tail longer than
+        # every bucket must reject typed and attributable, not escape
+        # as a bare StopIteration with pages already refcounted
+        bucket = None
+        for b in buckets:
+            if b >= tail_len:
+                bucket = b
+                break
+        if bucket is None:
+            raise AdmissionRejected(
+                f"prompt tail ({tail_len} token(s) after {n_hit} shared "
+                f"page(s)) exceeds the largest prefill bucket "
+                f"({max(buckets)})", reason="tail_too_long")
         # incref matched pages BEFORE allocating: eviction only ever
         # considers refcount-zero pages, so a matched page cannot be
         # stolen to satisfy this very request's tail
@@ -155,19 +243,32 @@ class PagedSlotPool:
         self.tables[slot, :len(row)] = row
         self.tables[slot, len(row):] = 0
         self.owned[slot] = row
-        bucket = next(b for b in buckets if b >= tail_len)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :tail_len] = prompt[offset:]
         fn = self._admit_fns.get(bucket)
         if fn is None:
-            fn = jax.jit(partial(self._admit, bucket=bucket),
-                         donate_argnums=(1, 2))
+            if self.quant_bits is None:
+                fn = jax.jit(partial(self._admit, bucket=bucket),
+                             donate_argnums=(1, 2))
+            else:
+                fn = jax.jit(partial(self._admit_q, bucket=bucket),
+                             donate_argnums=(1, 2, 3, 4, 5, 6))
             self._admit_fns[bucket] = fn
-        logits, self.k_pages, self.v_pages = fn(
-            params, self.k_pages, self.v_pages,
-            jnp.asarray(self.tables[slot]), jnp.asarray(padded),
-            jnp.asarray(offset, jnp.int32),
-            jnp.asarray(tail_len, jnp.int32))
+        if self.quant_bits is None:
+            logits, self.k_pages, self.v_pages = fn(
+                params, self.k_pages, self.v_pages,
+                jnp.asarray(self.tables[slot]), jnp.asarray(padded),
+                jnp.asarray(offset, jnp.int32),
+                jnp.asarray(tail_len, jnp.int32))
+        else:
+            (logits, self.k_pages, self.v_pages, self.k_scales,
+             self.v_scales, self.k_tail, self.v_tail) = fn(
+                params, self.k_pages, self.v_pages, self.k_scales,
+                self.v_scales, self.k_tail, self.v_tail,
+                jnp.asarray(self.tables[slot]), jnp.asarray(padded),
+                jnp.asarray(offset, jnp.int32),
+                jnp.asarray(tail_len, jnp.int32),
+                jnp.asarray(slot, jnp.int32))
         self.lengths[slot] = s
         if self.prefix_share:
             self.index.insert(prompt, s // L, row, self.pool)
@@ -193,10 +294,18 @@ class PagedSlotPool:
         """Advance every slot one position through the ONE jitted paged
         decode program (inactive rows neither write the pool nor
         advance). Returns (n_slots, vocab) logits."""
-        logits, self.k_pages, self.v_pages = self._decode_fn(
-            params, self.k_pages, self.v_pages,
-            jnp.asarray(self.tables), jnp.asarray(self.lengths),
-            jnp.asarray(tokens), jnp.asarray(active))
+        if self.quant_bits is None:
+            logits, self.k_pages, self.v_pages = self._decode_fn(
+                params, self.k_pages, self.v_pages,
+                jnp.asarray(self.tables), jnp.asarray(self.lengths),
+                jnp.asarray(tokens), jnp.asarray(active))
+        else:
+            (logits, self.k_pages, self.v_pages, self.k_scales,
+             self.v_scales, self.k_tail, self.v_tail) = self._decode_fn(
+                params, self.k_pages, self.v_pages, self.k_scales,
+                self.v_scales, self.k_tail, self.v_tail,
+                jnp.asarray(self.tables), jnp.asarray(self.lengths),
+                jnp.asarray(tokens), jnp.asarray(active))
         self.lengths[np.asarray(active)] += 1
         return logits
 
@@ -209,7 +318,12 @@ class PagedSlotPool:
         Positions past ``length`` in the last page are ZEROED: a reused
         pool page may carry a previous occupant's stale K/V there, and
         while the decode mask would never attend it, shipping garbage
-        would poison the quantized frame's per-page scales."""
+        would poison the quantized frame's per-page scales.
+
+        In a quantized pool the full pages are dequantized host-side
+        and the partial last page is read from the slot's exact f32
+        tail buffer (the pool row for it was never written), so the
+        extracted tail carries ZERO quantization error."""
         row = self.owned[slot]
         length = int(self.lengths[slot])
         valid_last = length - (len(row) - 1) * self.page_len
@@ -218,6 +332,31 @@ class PagedSlotPool:
         # handoff with pool size instead of prompt size)
         idx = jnp.asarray(np.asarray(row, np.int32))
         ks, vs = [], []
+        if self.quant_bits is not None:
+            for i in range(self.model.n_layers):
+                kq = np.array(self.k_pages[i][idx])
+                vq = np.array(self.v_pages[i][idx])
+                if self.quant_bits == 4:
+                    kq = unpack_pages_np(kq)
+                    vq = unpack_pages_np(vq)
+                ksc = np.array(self.k_scales[i][idx], np.float32)
+                vsc = np.array(self.v_scales[i][idx], np.float32)
+                k = np.stack([dequantize_page_np(kq[p], ksc[p])
+                              for p in range(len(row))])
+                v = np.stack([dequantize_page_np(vq[p], vsc[p])
+                              for p in range(len(row))])
+                if valid_last < self.page_len:
+                    # the partial page's pool row is unwritten — its
+                    # exact value lives in the slot's f32 tail buffer
+                    kt = np.array(self.k_tail[i][slot], np.float32)
+                    vt = np.array(self.v_tail[i][slot], np.float32)
+                    kt[:, valid_last:, :] = 0.0
+                    vt[:, valid_last:, :] = 0.0
+                    k[-1] = kt
+                    v[-1] = vt
+                ks.append(k)
+                vs.append(v)
+            return length, ks, vs
         for i in range(self.model.n_layers):
             # np.array (not asarray): the zero-padding below mutates,
             # and a CPU-backend transfer can alias read-only memory
@@ -230,6 +369,46 @@ class PagedSlotPool:
             vs.append(v)
         return length, ks, vs
 
+    def extract_quantized(self, slot: int):
+        """Quantized-pool handoff WITHOUT the dequant→requant double
+        hop: returns ``(length, kqs, vqs)`` where each per-layer entry
+        is ``(q, scales)`` — ``q`` ``(P, Hkv, page_len, Dh)`` int8
+        UNPACKED, ``scales`` ``(P, nb)`` f32 — exactly the pool's
+        resident bits for full pages. The partial last page is
+        quantized ONCE here, from the exact zero-padded f32 tail
+        buffer, through the same wire block codec. A dequantizing round
+        trip would reconstruct the same q codes, but its requantized
+        scales pay a double rounding (one ulp of drift per hop) — this
+        path ships the resident scales verbatim instead."""
+        if self.quant_bits is None:
+            raise ValueError("extract_quantized requires a quantized "
+                             "pool (kv_dtype='q8'/'q4')")
+        row = self.owned[slot]
+        length = int(self.lengths[slot])
+        valid_last = length - (len(row) - 1) * self.page_len
+        idx = jnp.asarray(np.asarray(row, np.int32))
+        kqs, vqs = [], []
+        for i in range(self.model.n_layers):
+            kq = np.array(self.k_pages[i][idx])
+            vq = np.array(self.v_pages[i][idx])
+            if self.quant_bits == 4:
+                kq = unpack_pages_np(kq)
+                vq = unpack_pages_np(vq)
+            kq = np.ascontiguousarray(kq, np.int8)
+            vq = np.ascontiguousarray(vq, np.int8)
+            ksc = np.array(self.k_scales[i][idx], np.float32)
+            vsc = np.array(self.v_scales[i][idx], np.float32)
+            if valid_last < self.page_len:
+                kt = np.array(self.k_tail[i][slot], np.float32)
+                vt = np.array(self.v_tail[i][slot], np.float32)
+                kt[:, valid_last:, :] = 0.0
+                vt[:, valid_last:, :] = 0.0
+                kq[-1], ksc[-1] = quantize_page_np(kt, self.quant_bits)
+                vq[-1], vsc[-1] = quantize_page_np(vt, self.quant_bits)
+            kqs.append((kq, ksc))
+            vqs.append((vq, vsc))
+        return length, kqs, vqs
+
     def adopt(self, slot: int, length: int, ks: List[np.ndarray],
               vs: List[np.ndarray]) -> int:
         """Materialize a handed-off request's pages into THIS pool —
@@ -237,18 +416,109 @@ class PagedSlotPool:
         the same allocation path admissions use (free list, then LRU
         eviction of refcount-zero indexed pages), so
         :class:`~..types.PagePoolExhausted` back-pressure is intact and
-        nothing is changed on failure. Returns the page count adopted."""
+        nothing is changed on failure. Returns the page count adopted.
+
+        In a quantized pool: full pages are quantized here (their ONE
+        rounding — extract shipped exact values), the partial last page
+        goes into the slot's exact f32 tail buffer, and the tail buffer
+        is defensively zeroed on page-aligned lengths so a previous
+        occupant's stale tail can never alias into the new request."""
         n = int(ks[0].shape[0])
         pids = self._alloc(n)          # all-or-nothing; may raise
         self.tables[slot, :n] = pids
         self.tables[slot, n:] = 0
         self.owned[slot] = pids
         idx = jnp.asarray(np.asarray(pids, np.int32))
+        if self.quant_bits is not None:
+            L = self.page_len
+            nfull = length // L
+            valid_last = length - (n - 1) * L
+            for i in range(self.model.n_layers):
+                qk = np.zeros((n,) + self._page_shape, np.int8)
+                qv = np.zeros((n,) + self._page_shape, np.int8)
+                sk = np.ones((n, self.page_blocks), np.float32)
+                sv = np.ones((n, self.page_blocks), np.float32)
+                for p in range(nfull):
+                    qk[p], sk[p] = quantize_page_np(ks[i][p],
+                                                    self.quant_bits)
+                    qv[p], sv[p] = quantize_page_np(vs[i][p],
+                                                    self.quant_bits)
+                if self.quant_bits == 4:
+                    qk = pack_pages_np(qk)
+                    qv = pack_pages_np(qv)
+                self.k_pages[i] = self.k_pages[i].at[idx].set(
+                    jnp.asarray(qk))
+                self.v_pages[i] = self.v_pages[i].at[idx].set(
+                    jnp.asarray(qv))
+                self.k_scales[i] = self.k_scales[i].at[idx].set(
+                    jnp.asarray(sk))
+                self.v_scales[i] = self.v_scales[i].at[idx].set(
+                    jnp.asarray(sv))
+                if valid_last < L:
+                    kt = np.array(ks[i][-1], np.float32)
+                    vt = np.array(vs[i][-1], np.float32)
+                    kt[:, valid_last:, :] = 0.0
+                    vt[:, valid_last:, :] = 0.0
+                else:
+                    kt = np.zeros(self._page_shape, np.float32)
+                    vt = np.zeros(self._page_shape, np.float32)
+                self.k_tail[i] = self.k_tail[i].at[slot].set(
+                    jnp.asarray(kt))
+                self.v_tail[i] = self.v_tail[i].at[slot].set(
+                    jnp.asarray(vt))
+            self.lengths[slot] = length
+            return n
         for i in range(self.model.n_layers):
             self.k_pages[i] = self.k_pages[i].at[idx].set(
                 jnp.asarray(ks[i], self.k_pages[i].dtype))
             self.v_pages[i] = self.v_pages[i].at[idx].set(
                 jnp.asarray(vs[i], self.v_pages[i].dtype))
+        self.lengths[slot] = length
+        return n
+
+    def adopt_quantized(self, slot: int, length: int, kqs, vqs) -> int:
+        """Inverse of :meth:`extract_quantized`: install already-
+        quantized ``(q, scales)`` pages straight into the pool — NO
+        rounding happens here, the resident bits are exactly the
+        sender's bits. The partial last page is additionally
+        dequantized into the slot's tail buffer (lossless given
+        ``q``/``scales``) so decode's in-kernel tail overlay and the
+        completion re-quantization see the same values the sender's
+        pool held."""
+        if self.quant_bits is None:
+            raise ValueError("adopt_quantized requires a quantized "
+                             "pool (kv_dtype='q8'/'q4')")
+        n = int(kqs[0][0].shape[0])
+        pids = self._alloc(n)          # all-or-nothing; may raise
+        self.tables[slot, :n] = pids
+        self.tables[slot, n:] = 0
+        self.owned[slot] = pids
+        idx = jnp.asarray(np.asarray(pids, np.int32))
+        L = self.page_len
+        valid_last = length - (n - 1) * L
+        for i in range(self.model.n_layers):
+            kq, ksc = kqs[i]
+            vq, vsc = vqs[i]
+            kq = np.ascontiguousarray(kq, np.int8)
+            vq = np.ascontiguousarray(vq, np.int8)
+            sk = pack_pages_np(kq) if self.quant_bits == 4 else kq
+            sv = pack_pages_np(vq) if self.quant_bits == 4 else vq
+            self.k_pages[i] = self.k_pages[i].at[idx].set(jnp.asarray(sk))
+            self.v_pages[i] = self.v_pages[i].at[idx].set(jnp.asarray(sv))
+            self.k_scales[i] = self.k_scales[i].at[idx].set(
+                jnp.asarray(ksc, jnp.float32))
+            self.v_scales[i] = self.v_scales[i].at[idx].set(
+                jnp.asarray(vsc, jnp.float32))
+            if valid_last < L:
+                kt = dequantize_page_np(kq[-1], np.asarray(ksc[-1]))
+                vt = dequantize_page_np(vq[-1], np.asarray(vsc[-1]))
+                kt[:, valid_last:, :] = 0.0
+                vt[:, valid_last:, :] = 0.0
+            else:
+                kt = np.zeros(self._page_shape, np.float32)
+                vt = np.zeros(self._page_shape, np.float32)
+            self.k_tail[i] = self.k_tail[i].at[slot].set(jnp.asarray(kt))
+            self.v_tail[i] = self.v_tail[i].at[slot].set(jnp.asarray(vt))
         self.lengths[slot] = length
         return n
 
@@ -271,9 +541,45 @@ class PagedSlotPool:
             return None
         return self.prefill_tokens_saved_total / self.prompt_tokens_total
 
+    def kv_bits(self) -> int:
+        """Resident bits per KV element: quant width, or the exact
+        storage dtype's width in f32 mode."""
+        if self.quant_bits is not None:
+            return self.quant_bits
+        return self.k_pages[0].dtype.itemsize * 8
+
+    def kv_pool_bytes(self) -> int:
+        """Total resident KV footprint: pages + scales + tail buffers,
+        K and V, all layers. Static for a given config — this is the
+        denominator of the capacity-per-byte story."""
+        total = sum(a.nbytes for a in self.k_pages)
+        total += sum(a.nbytes for a in self.v_pages)
+        if self.quant_bits is not None:
+            total += sum(a.nbytes for a in self.k_scales)
+            total += sum(a.nbytes for a in self.v_scales)
+            total += sum(a.nbytes for a in self.k_tail)
+            total += sum(a.nbytes for a in self.v_tail)
+        return total
+
+    def bytes_per_resident_token(self) -> float:
+        """Pool bytes (pages + scales; tails are per-slot, not
+        per-resident-page) per token position the pool can hold. The
+        serve_bench capacity arm gates on the f32/q8 ratio of this —
+        a deterministic storage-layout fact, not a runtime sample."""
+        total = sum(a.nbytes for a in self.k_pages)
+        total += sum(a.nbytes for a in self.v_pages)
+        if self.quant_bits is not None:
+            total += sum(a.nbytes for a in self.k_scales)
+            total += sum(a.nbytes for a in self.v_scales)
+        return total / float(self.n_pages * self.page_len)
+
     def page_stats(self) -> Dict:
         return {"n_pages": self.n_pages,
                 "page_len": self.page_len,
+                "kv_dtype": self.kv_dtype,
+                "kv_bits": self.kv_bits(),
+                "kv_pool_bytes": self.kv_pool_bytes(),
+                "bytes_per_resident_token": self.bytes_per_resident_token(),
                 "free_pages": self.pool.free_pages,
                 "pages_in_use": self.pool.pages_in_use,
                 "pool_occupancy": self.pool.occupancy(),

@@ -1,0 +1,107 @@
+"""The paged pool's resident-KV width vocabulary + host-side page codec
+(``serve/pages/``).
+
+``PagedSlotPool(kv_dtype=...)`` selects the pool's STORAGE format:
+
+- ``"f32"`` (default): exact pages in the model dtype — the bit-exact
+  contract, zero behavior change;
+- ``"q8"`` / ``"q4"``: block-quantized resident pages — per layer the
+  pool holds int pages plus per-page-per-block f32 scales, in exactly
+  the :mod:`...comm.wire` block format (``QUANT_BLOCK`` C-order blocks
+  over the flat ``(Hkv, page_len, Dh)`` page) the disagg handoff frame
+  already uses per page on the wire. Same blocking, same integer-exact
+  snap, same nibble packing — so a quantized pool's pages pass into a
+  matching-width handoff frame BYTE-IDENTICAL, with no dequant→requant
+  double hop (``extract_quantized``/``adopt_quantized``).
+
+q4 pages are nibble-PACKED in pool memory (two two's-complement nibbles
+per byte, low nibble first — ``wire.pack_nibbles``'s order), unlike the
+SPMD gradient path where packing is a wire-framing concern: here the
+packed bytes ARE the capacity win (~7.9x resident tokens per byte).
+
+The quality discipline that makes the pool's error bound exact
+(per-element err <= scale/2, asserted in tests/test_serve_kvq.py):
+every element is quantized exactly ONCE, from its exact f32 value, when
+its page COMPLETES. The partial tail page of each slot lives in a
+per-slot f32 tail buffer (attended exactly, in-kernel); a page only
+enters the int pool when position ``page_len - 1`` is written. No value
+is ever re-rounded, so the codec's single-rounding bound holds verbatim.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ...comm import wire
+
+#: Pool storage widths (``kv_dtype`` / DPX_SERVE_KV_DTYPE) → quant bits
+#: (None = exact, pages stay in the model dtype). Same spellings as the
+#: handoff wire's HANDOFF_WIDTHS — pool and wire widths are the SAME
+#: axis, which is what makes the matched-width pass-through possible.
+KV_WIDTHS = {"f32": None, "q8": 8, "q4": 4}
+
+
+def resolve_kv_bits(kv_dtype: str) -> Optional[int]:
+    """Map a ``kv_dtype`` spelling onto quant bits. Unknown values
+    raise — a typo'd width silently serving exact f32 would make the
+    capacity gates vacuous (same rule as ``resolve_handoff_bits``)."""
+    try:
+        return KV_WIDTHS[kv_dtype]
+    except KeyError:
+        raise ValueError(
+            f"kv_dtype must be one of {sorted(KV_WIDTHS)}, "
+            f"got {kv_dtype!r}") from None
+
+
+def page_elems(h_kv: int, page_len: int, dh: int) -> int:
+    return h_kv * page_len * dh
+
+
+def num_page_blocks(h_kv: int, page_len: int, dh: int) -> int:
+    """Scale blocks per page tensor — ``wire.num_blocks`` over the flat
+    page, the ONE blocking the pool, the kernel and the frame share."""
+    return wire.num_blocks(page_elems(h_kv, page_len, dh))
+
+
+# -- host-side page codec (numpy; extract/adopt) ---------------------------
+#
+# Thin wrappers over the wire codec so every host-side page
+# quantization goes through the same rint/inverse-multiply grid the jnp
+# in-program codec (ops/quant.py:quantize_grad_blocks) lands on —
+# bit-agreement between the two faces is what the pass-through tests
+# assert.
+
+
+def quantize_page_np(page: np.ndarray, bits: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """One f32 page ``(Hkv, L, Dh)`` → ``(q int8 UNPACKED same shape,
+    scales (nb,) f32)`` on the wire block grid."""
+    q, scales = wire.quantize_blocks(
+        np.ascontiguousarray(page, np.float32).ravel(), bits=bits)
+    return q.reshape(page.shape), scales
+
+
+def dequantize_page_np(q: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """Inverse (lossless given ``q``/``scales``): unpacked int8 page +
+    per-block scales → f32 page of the same shape."""
+    return wire.dequantize_blocks(q.ravel(), scales).reshape(q.shape)
+
+
+def pack_pages_np(q: np.ndarray) -> np.ndarray:
+    """Nibble-pack unpacked q4 pages ``(..., Dh)`` int8 →
+    ``(..., Dh // 2)`` uint8, wire byte order (pairs of flat-adjacent
+    elements, low nibble first). Requires an even ``Dh`` so no pair
+    straddles a row — the pool constructor enforces that."""
+    shape = q.shape[:-1] + (q.shape[-1] // 2,)
+    return wire.pack_nibbles(np.ascontiguousarray(q, np.int8).ravel()) \
+        .reshape(shape)
+
+
+def unpack_pages_np(packed: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`pack_pages_np` (sign-extended int8)."""
+    shape = packed.shape[:-1] + (packed.shape[-1] * 2,)
+    n = int(np.prod(shape))
+    return wire.unpack_nibbles(
+        np.ascontiguousarray(packed, np.uint8).ravel(), n).reshape(shape)
