@@ -1355,6 +1355,9 @@ def _stage_main(stage: str) -> int:
         print(json.dumps(bench_decode_attention()))
     elif stage == "obs_overhead":
         print(json.dumps(bench_obs_overhead()))
+    elif stage == "scale_sweep":
+        from benchmarks.scale_sweep import run_scale_sweep
+        print(json.dumps(run_scale_sweep()))
     else:
         print(json.dumps({"error": f"unknown stage {stage!r}"}))
         return 2

@@ -1,0 +1,670 @@
+"""The dpxchaos campaign driver — the full fault matrix through the
+full composed stack (ROADMAP item 3; docs/failures.md "Chaos
+campaigns").
+
+Where the soak arm (benchmarks/soak.py) injects exactly ONE kill, this
+driver runs a DECLARED campaign (``runtime/chaos.py``; ``DPX_CHAOS``
+overrides the built-in matrix) clause by clause:
+
+* **train legs** — the composed world-``DPX_CHAOS_WORLD`` train stack
+  (hier two-level ring x adaptive wire x bucketed overlap x sharded
+  elastic checkpointing) under ``elastic_run``, one campaign clause
+  armed per leg (kill/drop/delay on ``hier_reduce`` / ``ckpt_commit`` /
+  a step boundary). The ``train_shrink`` leg is the elastic
+  shrink-resume proof: the injected kill takes the world down,
+  ``reconfigure`` relaunches at HALF the world, and the relaunched rank
+  0 verifies the resharded restore BIT-EXACT against the sha256 digest
+  the world-4 run recorded at save time.
+* **serve legs** — the disagg+paged(+q8 handoff) serve split in-process:
+  a severed handoff (typed ``PrefillEngineDied``, victim-only), a
+  stalled one (typed ``HandoffTimeout``), a stalled engine iteration
+  (typed ``RequestDeadlineExceeded``), and a ``flaky`` handoff absorbed
+  by the bounded retry.
+* **transport legs** — the retry micro-harness on a bare handoff
+  transport: ``flaky`` under the default budget recovers with
+  ``comm_retry`` events; under a tightened ``DPX_RETRY_MAX`` it
+  exhausts into the typed ``CommRetryExhausted`` carrying the attempt
+  count.
+
+The whole run is followed LIVE by the PR 15 HealthMonitor and gated on
+dpxmon's verdict; every clause lands a ``chaos_clause`` event and a
+report row (fired / typed error observed / attribution correct /
+recovered), rolled up by ``tools/dpxchaos.py report`` — whose rc-1 path
+is itself proven by a seeded unrecovered clause, exactly like the
+seeded SLO-violation log proves dpxmon's.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.soak import _run_cli, _seed_violation_log  # noqa: E402
+
+# train-leg shape: seconds-scale at world 4 (2 "hosts" x 2 ranks), a
+# sharded ckpt every CKPT_EVERY steps, the kill landing mid-run with
+# completed checkpoints on both sides of it
+TRAIN_STEPS = 20
+KILL_STEP = 10
+CKPT_EVERY = 4
+HIER_LOCAL = 2
+MON_EVERY = 2
+
+#: The built-in smoke matrix (CI `chaos-smoke`): one flaky
+#: retry-success, one kill -> shrink-resume, one typed serve error.
+SMOKE_CAMPAIGN = {
+    "name": "chaos-smoke",
+    "clauses": [
+        {"fault": "flaky@op=handoff_send,count=2", "leg": "transport",
+         "expect": "retry_recover",
+         "note": "transient handoff refusal absorbed by bounded retry"},
+        {"fault": f"kill@step={KILL_STEP},rank=3,attempt=0",
+         "leg": "train_shrink", "expect": "elastic_resume",
+         "note": "kill -> relaunch at world//2 -> bit-exact resharded "
+                 "resume"},
+        {"fault": "drop_conn@op=handoff_send,call=2", "leg": "serve",
+         "expect": "typed_error",
+         "note": "severed handoff -> typed PrefillEngineDied, victim "
+                 "only"},
+    ],
+}
+
+#: The full matrix (the default without --smoke): the smoke clauses
+#: plus kills inside the hier ring and the ckpt commit, the stalled
+#: handoff / stalled engine iteration timeouts, a flaky handoff through
+#: the REAL engine, and the retry-exhaustion proof.
+FULL_CAMPAIGN = {
+    "name": "chaos-full",
+    "clauses": SMOKE_CAMPAIGN["clauses"] + [
+        {"fault": "kill@op=hier_reduce,call=3,rank=1,attempt=0",
+         "leg": "train", "expect": "elastic_resume",
+         "note": "rank 1 dies entering the intra-host reduce phase"},
+        {"fault": "kill@op=ckpt_commit,call=2,rank=0,attempt=0",
+         "leg": "train", "expect": "elastic_resume",
+         "note": "rank 0 dies entering its 2nd ckpt commit"},
+        {"fault": "delay@op=handoff_send,call=2,ms=600", "leg": "serve",
+         "expect": "typed_error",
+         "note": "stalled handoff past DPX_HANDOFF_TIMEOUT_MS -> typed "
+                 "HandoffTimeout"},
+        {"fault": "delay@op=serve_step,call=3,ms=1200", "leg": "serve",
+         "expect": "typed_error",
+         "note": "stalled engine iteration -> typed "
+                 "RequestDeadlineExceeded(stage=running)"},
+        {"fault": "flaky@op=handoff_send,count=2", "leg": "serve",
+         "expect": "retry_recover",
+         "note": "flaky handoff through the real disagg engine"},
+        {"fault": "flaky@op=handoff_send,count=5", "leg": "transport",
+         "expect": "typed_error", "env": {"DPX_RETRY_MAX": "1"},
+         "note": "transient outlives the budget -> typed "
+                 "CommRetryExhausted carrying the attempt count"},
+    ],
+}
+
+
+def _progress(msg: str) -> None:
+    print(f"# chaos: {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# train legs (subprocess world under elastic_run)
+# ---------------------------------------------------------------------------
+
+
+def _tree_digest(tree) -> str:
+    """Deterministic sha256 over a pytree's leaves (dtype+shape+bytes in
+    tree-leaf order) — the bit-exactness witness the shrink-resume leg
+    compares across world sizes."""
+    import hashlib
+
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        a = np.asarray(leaf)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def _train_worker(rank: int, world: int, workdir: str,
+                  steps: int) -> None:
+    """One rank of the composed train stack (module-level:
+    spawn-picklable) — the soak worker's composition plus the digest
+    protocol: rank 0 records a state digest at every step, and a
+    resumed rank 0 verifies the restored tree bit-exact against the
+    digest recorded at save time (across world sizes — the resharded
+    restore must reproduce the SAME full tree)."""
+    import jax
+    import numpy as np
+
+    import distributed_pytorch_tpu as dist
+    from distributed_pytorch_tpu import models, optim
+    from distributed_pytorch_tpu.ckpt import CheckpointManager
+    from distributed_pytorch_tpu.ops.losses import cross_entropy
+    from distributed_pytorch_tpu.parallel import (fsdp_param_specs,
+                                                  make_train_step)
+    from distributed_pytorch_tpu.runtime import faults
+    from distributed_pytorch_tpu.utils.checkpoint import (
+        latest_step, restore_checkpoint)
+    from jax.sharding import PartitionSpec as P
+
+    dist.init_process_group(rank, world)
+    try:
+        model = models.DummyModel(in_dim=16, hidden_dim=128, n_classes=8)
+        opt = optim.adamw(1e-3)
+
+        def loss_fn(p, batch):
+            x, y = batch
+            return cross_entropy(model.apply(p, x), y), {}
+
+        step_fn = make_train_step(loss_fn, opt, grad_reduce="adaptive",
+                                  overlap=True, comm_buckets=2)
+        params = model.init(jax.random.PRNGKey(0))
+        opt_state = step_fn.init_opt_state(params)
+
+        specs = fsdp_param_specs(params, world, min_size=64)
+        shape_spec = {np.shape(l): s for l, s in zip(
+            jax.tree_util.tree_leaves(params),
+            jax.tree_util.tree_leaves(specs))}
+        opt_specs = jax.tree_util.tree_map(
+            lambda x: shape_spec.get(np.shape(x), P()), opt_state)
+        ckdir = os.path.join(workdir, "ckpt")
+        start = 0
+        if latest_step(ckdir) is not None:
+            ck = restore_checkpoint(ckdir, like_params=params,
+                                    like_opt_state=opt_state)
+            params, opt_state, start = ck.params, ck.opt_state, ck.step
+            if rank == 0:
+                digfile = os.path.join(workdir, f"digest_{start}.json")
+                if os.path.exists(digfile):
+                    with open(digfile, "r", encoding="utf-8") as f:
+                        want = json.load(f)
+                    got = {"world": world,
+                           "sha256": _tree_digest((params, opt_state))}
+                    if got["sha256"] != want["sha256"]:
+                        raise RuntimeError(
+                            f"resharded resume NOT bit-exact at step "
+                            f"{start}: restored {got['sha256'][:16]} at "
+                            f"world {world} != saved "
+                            f"{want['sha256'][:16]} at world "
+                            f"{want['world']}")
+                    marker = os.path.join(workdir,
+                                          f"resume_verified_{start}.json")
+                    with open(marker, "w", encoding="utf-8") as f:
+                        json.dump(got, f)
+
+        rng = np.random.default_rng(7)
+        batches = [(rng.random((8, 16), dtype=np.float32),
+                    rng.integers(0, 8, size=(8,)).astype(np.int32))
+                   for _ in range(min(steps, 64))]
+        with CheckpointManager(ckdir, interval=CKPT_EVERY, keep=2,
+                               sharded=True, param_specs=specs,
+                               opt_specs=opt_specs,
+                               axis_sizes={"dp": world}) as mgr:
+            for s in range(start, steps):
+                faults.on_step(s, rank=rank)
+                out = step_fn(params, opt_state,
+                              batches[s % len(batches)])
+                params, opt_state = out.params, out.opt_state
+                mgr.save(s + 1, params, opt_state)
+                if rank == 0:
+                    # digest BEFORE any failure can land later in the
+                    # step loop: what save() was handed is what a
+                    # restore must reproduce
+                    dig = {"world": world,
+                           "sha256": _tree_digest((params, opt_state))}
+                    digfile = os.path.join(workdir,
+                                           f"digest_{s + 1}.json")
+                    with open(digfile, "w", encoding="utf-8") as f:
+                        json.dump(dig, f)
+    finally:
+        dist.cleanup()
+
+
+def _train_target(workdir: str, steps: int, world: int) -> None:
+    """The elastically supervised unit: one full world launch."""
+    from distributed_pytorch_tpu.runtime.multiprocess import (
+        launch_multiprocess)
+    launch_multiprocess(_train_worker, world, workdir, steps)
+
+
+def _shrink_reconfigure(attempt, exitcode, args):
+    """Topology-shrink hook of the train_shrink leg: after the injected
+    kill, relaunch on HALF the world and let the sharded ckpt reshard
+    the restore onto it."""
+    workdir, steps, world = args
+    if world > 2:
+        return (workdir, steps, max(2, world // 2))
+    return None
+
+
+def _read_new(log: str, pos: int):
+    """Records appended to ``log`` since byte offset ``pos``."""
+    try:
+        with open(log, "r", encoding="utf-8") as f:
+            f.seek(pos)
+            text = f.read()
+            newpos = f.tell()
+    except OSError:
+        return [], pos
+    recs = []
+    for ln in text.splitlines():
+        try:
+            recs.append(json.loads(ln))
+        except (json.JSONDecodeError, ValueError):
+            continue
+    return recs, newpos
+
+
+def _saw_fault_injected(recs) -> bool:
+    """Did the log window record an injection? ``fault_injected`` rides
+    the trace stream: standalone it is a ``trace_span`` record named
+    ``fault_injected``; inside a collective it nests in the enclosing
+    span's ``events``; a kill's last word is the victim's
+    ``flight_recorder`` dump (reason ``fault_kill`` — the ``os._exit``
+    preempts the span flush)."""
+    for r in recs:
+        ev = r.get("event")
+        if ev == "trace_span":
+            if r.get("name") == "fault_injected":
+                return True
+            if any(e.get("name") == "fault_injected"
+                   for e in r.get("events", []) if isinstance(e, dict)):
+                return True
+        elif ev == "flight_recorder" and r.get("reason") == "fault_kill":
+            return True
+    return False
+
+
+def _count_comm_retries(recs) -> int:
+    return sum(1 for r in recs if r.get("event") == "comm_retry")
+
+
+def _run_train_leg(clause, log: str, pos: int, workdir: str,
+                   world: int):
+    """One composed train leg with ``clause`` armed; returns the report
+    row ingredients."""
+    from distributed_pytorch_tpu.runtime import chaos, elastic, faults
+
+    legdir = os.path.join(workdir, f"leg_{clause.id}")
+    os.makedirs(legdir, exist_ok=True)
+    child_env = {
+        "DPX_METRICS_LOG": log,
+        "DPX_TRACE": "1",
+        "DPX_MON": "1",
+        "DPX_MON_EVERY": str(MON_EVERY),
+        "DPX_HIER_RING": str(HIER_LOCAL),
+        "DPX_COMM_TIMEOUT_MS": "60000",
+    }
+    child_env.update(clause.arm_env())
+    shrink = clause.leg == "train_shrink"
+    try:
+        res = elastic.elastic_run(
+            _train_target, (legdir, TRAIN_STEPS, world),
+            max_restarts=2, backoff_s=0.2, env=child_env,
+            reconfigure=_shrink_reconfigure if shrink else None)
+    except Exception as e:  # giveup: the leg is reported, not fatal
+        return chaos.clause_report(
+            clause, fired=True, typed_error=type(e).__name__,
+            attributed=False, recovered=False,
+            detail=f"elastic giveup: {e}")
+
+    recs, _ = _read_new(log, pos)
+    kill_exits = [c for c in res.exitcodes
+                  if c == faults.KILL_EXIT_CODE]
+    fired = _saw_fault_injected(recs) or bool(kill_exits)
+    # typed attribution: the supervisor's worker_failure event must
+    # blame the rank the clause targeted
+    want_rank = clause.specs[0].rank
+    failures = [r for r in recs if r.get("event") == "worker_failure"]
+    typed = "WorkerFailure" if failures else ""
+    attributed = any(r.get("rank") == want_rank for r in failures) \
+        if want_rank is not None else bool(failures)
+    recovered = res.restarts >= 1 and res.exitcodes[-1] == 0
+    detail = (f"restarts={res.restarts} "
+              f"exitcodes={list(res.exitcodes)}")
+    if shrink and recovered:
+        markers = [f for f in os.listdir(legdir)
+                   if f.startswith("resume_verified_")]
+        reconf = [r for r in recs
+                  if r.get("event") == "elastic_reconfigured"]
+        recovered = bool(markers) and bool(reconf)
+        detail += (f" shrink={world}->{max(2, world // 2)} "
+                   f"resume_verified={sorted(markers)}")
+    return chaos.clause_report(clause, fired=fired, typed_error=typed,
+                               attributed=attributed,
+                               recovered=recovered, detail=detail)
+
+
+# ---------------------------------------------------------------------------
+# serve + transport legs (in-process)
+# ---------------------------------------------------------------------------
+
+
+def _serve_model():
+    import jax
+
+    from distributed_pytorch_tpu import models
+    model = models.TransformerLM(vocab=61, dim=32, n_layers=1,
+                                 n_heads=4, n_kv_heads=2, pos="rope",
+                                 max_seq=128)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _run_serve_leg(clause, log: str, pos: int):
+    """One clause through the disagg+paged(+q8) serve split (or the
+    monolithic engine for ``serve_step`` clauses) in-process."""
+    import jax
+    import numpy as np
+
+    from distributed_pytorch_tpu.runtime import chaos, faults
+    from distributed_pytorch_tpu.serve import (DisaggConfig, DisaggEngine,
+                                               EngineConfig,
+                                               HandoffTimeout,
+                                               InferenceEngine,
+                                               PrefillEngineDied,
+                                               RequestDeadlineExceeded,
+                                               SamplingParams)
+
+    spec = clause.specs[0]
+    model, params = _serve_model()
+    rng = np.random.default_rng(11)
+    typed, attributed, recovered = "", False, False
+    faults.reset()
+
+    if spec.op == "serve_step":
+        # the monolithic engine's iteration hook: a stalled iteration
+        # breaches a running request's deadline, typed + attributed
+        with InferenceEngine(model, params,
+                             EngineConfig(n_slots=2,
+                                          max_len=128)) as eng:
+            # warm every compile first — compile time must not eat the
+            # injected deadline (the tests/test_serve.py discipline)
+            eng.submit(np.arange(4, dtype=np.int32),
+                       SamplingParams(max_new_tokens=2)).result(
+                           timeout=120)
+            faults.install(clause.fault)
+            ha = eng.submit(rng.integers(0, 61, (5,)).astype(np.int32),
+                            SamplingParams(max_new_tokens=100,
+                                           deadline_ms=700.0))
+            hb = eng.submit(rng.integers(0, 61, (8,)).astype(np.int32),
+                            SamplingParams(max_new_tokens=8),
+                            rng=jax.random.PRNGKey(9))
+            try:
+                ha.result(timeout=120)
+            except RequestDeadlineExceeded as e:
+                typed = "RequestDeadlineExceeded"
+                attributed = (e.request_id == ha.request_id
+                              and e.stage == "running")
+            hb.result(timeout=120)   # co-resident stream unaffected
+            recovered = True
+    else:
+        # the disagg split: paged pools + q8 handoff wire composed
+        eng = DisaggEngine(model, params,
+                           DisaggConfig(n_slots=2, max_len=64,
+                                        page_len=8, handoff_width="q8",
+                                        handoff_timeout_ms=80
+                                        if spec.action == "delay"
+                                        else None))
+        a = rng.integers(0, 61, (9,)).astype(np.int32)
+        b = rng.integers(0, 61, (12,)).astype(np.int32)
+        sp = SamplingParams(max_new_tokens=12)
+        ka, kb = jax.random.PRNGKey(1), jax.random.PRNGKey(2)
+        with eng:
+            if spec.action == "flaky":
+                faults.install(clause.fault)
+                out = eng.submit(a, sp, rng=ka).result(timeout=120)
+                recovered = len(out) > 0
+            else:
+                # armed BEFORE any traffic: the call counter only runs
+                # while specs are installed, so a's handoff is call 1
+                # and b's is the targeted call 2
+                faults.install(clause.fault)
+                ha = eng.submit(a, sp, rng=ka)
+                while not ha.tokens:   # a decoding before b's handoff
+                    time.sleep(0.005)
+                hb = eng.submit(b, sp, rng=kb)
+                try:
+                    hb.result(timeout=120)
+                except PrefillEngineDied as e:
+                    typed = "PrefillEngineDied"
+                    attributed = (e.request_id == hb.request_id
+                                  and e.engine == "prefill")
+                except HandoffTimeout as e:
+                    typed = "HandoffTimeout"
+                    attributed = (e.request_id == hb.request_id
+                                  and e.deadline_ms == 80.0)
+                # the co-resident stream must finish: containment IS
+                # the recovery for a victim-only serve fault
+                recovered = len(ha.result(timeout=120)) > 0
+
+    fired = bool(faults.fired())
+    recs, _ = _read_new(log, pos)
+    retries = _count_comm_retries(recs)
+    faults.reset()
+    return chaos.clause_report(clause, fired=fired, typed_error=typed,
+                               attributed=attributed,
+                               recovered=recovered, retries=retries,
+                               detail=f"fired={faults.fired()!r}"
+                               if not fired else "")
+
+
+def _run_transport_leg(clause, log: str, pos: int):
+    """The retry micro-harness: one bare LocalTransport send with the
+    clause armed — recovery proves the bounded retry, exhaustion proves
+    the typed error carries the attempt count."""
+    from distributed_pytorch_tpu.runtime import chaos, faults
+    from distributed_pytorch_tpu.runtime import env as _env
+    from distributed_pytorch_tpu.runtime.native import CommRetryExhausted
+    from distributed_pytorch_tpu.serve.disagg import LocalTransport
+
+    typed, attributed, recovered = "", False, False
+    saved = _env.snapshot(list(clause.env))
+    for k, v in clause.env.items():
+        _env.set(k, str(v))
+    faults.reset()
+    faults.install(clause.fault)
+    try:
+        t = LocalTransport()
+        try:
+            t.send(b"frame", 16)
+            recovered = t.frames_sent == 1
+        except CommRetryExhausted as e:
+            typed = "CommRetryExhausted"
+            budget = int(_env.get(chaos.RETRY_MAX_ENV))
+            attributed = (e.op == "handoff_send"
+                          and e.attempts == budget + 1)
+        fired = bool(faults.fired())
+    finally:
+        faults.reset()
+        _env.restore(saved)
+    recs, _ = _read_new(log, pos)
+    return chaos.clause_report(clause, fired=fired, typed_error=typed,
+                               attributed=attributed,
+                               recovered=recovered,
+                               retries=_count_comm_retries(recs))
+
+
+# ---------------------------------------------------------------------------
+# the campaign
+# ---------------------------------------------------------------------------
+
+
+def run_campaign(smoke: bool = False) -> int:
+    """Run the armed campaign end to end; returns the exit code (0 =
+    every clause green AND every meta-gate held). Prints one JSON
+    summary line."""
+    from distributed_pytorch_tpu.obs import health
+    from distributed_pytorch_tpu.runtime import chaos
+    from distributed_pytorch_tpu.runtime import env as _env
+    from distributed_pytorch_tpu.utils.logging import append_event
+
+    world = int(_env.get("DPX_CHAOS_WORLD"))
+    workdir = tempfile.mkdtemp(prefix="dpx_chaos_")
+    log = os.path.join(workdir, "chaos_metrics.jsonl")
+    campaign = chaos.load_campaign(
+        default=SMOKE_CAMPAIGN if smoke else FULL_CAMPAIGN)
+    _progress(f"campaign {campaign.name!r}: {len(campaign.clauses)} "
+              f"clause(s), train world {world}, log {log}")
+
+    # supervisor + in-process legs write events/traces into the one
+    # campaign log (restored on exit); the live monitor follows it
+    saved = _env.snapshot(["DPX_METRICS_LOG", "DPX_TRACE", "DPX_MON"])
+    _env.set("DPX_METRICS_LOG", log)
+    _env.set("DPX_TRACE", "1")
+    _env.set("DPX_MON", "1")
+    live_rules = health.parse_rules(
+        "drift(train.steps_per_sec)@k=3,floor=0.5;"
+        "growth(proc.rss_bytes)@window=8,grow=0.25")
+    monitor = health.HealthMonitor(live_rules, emit_path=log,
+                                   critical_after=5)
+    follower = health.LogFollower(log, monitor)
+    stop = threading.Event()
+
+    def _follow():
+        while not stop.is_set():
+            follower.poll()
+            stop.wait(0.5)
+
+    t = threading.Thread(target=_follow, name="dpx-chaos-health",
+                         daemon=True)
+    t.start()
+
+    rows = []
+    t0 = time.perf_counter()
+    try:
+        pos = 0
+        for clause in campaign.clauses:
+            _progress(f"clause {clause.id}: [{clause.leg}] "
+                      f"{clause.fault} (expect {clause.expect})")
+            t_leg = time.perf_counter()
+            if clause.leg in ("train", "train_shrink"):
+                row = _run_train_leg(clause, log, pos, workdir, world)
+            elif clause.leg == "serve":
+                row = _run_serve_leg(clause, log, pos)
+            else:
+                row = _run_transport_leg(clause, log, pos)
+            row["wall_s"] = round(time.perf_counter() - t_leg, 1)
+            rows.append(row)
+            green = chaos.clause_green(row)
+            append_event("chaos_clause", id=clause.id,
+                         fault=clause.fault, leg=clause.leg,
+                         expect=clause.expect, fired=row["fired"],
+                         typed_error=row["typed_error"],
+                         attributed=row["attributed"],
+                         recovered=row["recovered"],
+                         retries=row["retries"], green=green)
+            typed = row["typed_error"] or None
+            _progress(f"clause {clause.id}: "
+                      f"{'GREEN' if green else 'NOT GREEN'} "
+                      f"({row['wall_s']}s; typed={typed} "
+                      f"retries={row['retries']})")
+            _, pos = _read_new(log, pos)
+    finally:
+        _env.restore(saved)
+        stop.set()
+        t.join(timeout=10)
+    follower.poll()
+    wall_s = time.perf_counter() - t0
+
+    failures = []
+
+    def gate(ok: bool, what: str) -> None:
+        # explicit checks, NOT assert (-O/PYTHONOPTIMIZE safe)
+        if not ok:
+            failures.append(what)
+            _progress(f"GATE FAILED: {what}")
+
+    verdict = chaos.campaign_verdict(rows)
+    gate(verdict["ok"],
+         f"clause(s) not green: {verdict['failing']}")
+
+    # the LIVE monitor must have seen the train-leg failure degrade
+    # health (the deterministic worker-failure rule)
+    trs = monitor.transitions
+    gate(any(x["to"] == "degraded" for x in trs),
+         "no ok->degraded transition observed live")
+
+    # dpxmon verdict over the whole campaign log: strict validation +
+    # re-derived health, exit 0
+    rc, _out = _run_cli("tools.dpxmon", ["replay", log])
+    gate(rc == 0, f"dpxmon replay over the campaign log exited {rc}")
+    rc2, out2 = _run_cli("tools.dpxtrace", ["check", log])
+    gate(rc2 == 0,
+         f"dpxtrace check over the campaign log exited {rc2}: "
+         f"{out2.strip()[:300]}")
+
+    # the gates can FAIL: seeded SLO violation -> dpxmon rc 1
+    seeded = os.path.join(workdir, "seeded_violation.jsonl")
+    _seed_violation_log(seeded)
+    rc3, _out3 = _run_cli("tools.dpxmon", ["replay", seeded])
+    gate(rc3 == 1, f"seeded SLO-violation log exited {rc3}, wanted 1")
+
+    # the per-clause report, rolled up by the stdlib CLI (rc 0) ...
+    report = {"name": campaign.name, "world": world,
+              "smoke": smoke, "clauses": rows, "verdict": verdict}
+    report_path = os.path.join(workdir, "campaign_report.json")
+    with open(report_path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1)
+    rc4, out4 = _run_cli("tools.dpxchaos", ["report", report_path])
+    gate(rc4 == (0 if verdict["ok"] else 1),
+         f"dpxchaos report exited {rc4} for ok={verdict['ok']}: "
+         f"{out4.strip()[:300]}")
+
+    # ... and a seeded UNRECOVERED clause must make it exit 1
+    seeded_rows = [dict(r) for r in rows] + [{
+        "id": "seeded", "fault": "kill@step=1,rank=0",
+        "leg": "train", "expect": "elastic_resume", "fired": True,
+        "typed_error": "WorkerFailure", "attributed": True,
+        "recovered": False, "retries": 0,
+        "detail": "seeded unrecovered clause (gate-can-fail proof)"}]
+    seeded_report = os.path.join(workdir, "seeded_report.json")
+    with open(seeded_report, "w", encoding="utf-8") as f:
+        json.dump({"name": "seeded", "clauses": seeded_rows}, f)
+    rc5, _out5 = _run_cli("tools.dpxchaos", ["report", seeded_report])
+    gate(rc5 == 1,
+         f"seeded unrecovered-clause report exited {rc5}, wanted 1")
+
+    summary = {
+        "chaos_campaign": campaign.name,
+        "ok": not failures,
+        "world": world,
+        "wall_s": round(wall_s, 1),
+        "clauses": [{k: r[k] for k in
+                     ("id", "leg", "fault", "expect", "fired",
+                      "typed_error", "attributed", "recovered",
+                      "retries", "wall_s")} for r in rows],
+        "verdict": verdict,
+        "dpxmon_replay_rc": rc,
+        "dpxtrace_check_rc": rc2,
+        "seeded_violation_rc": rc3,
+        "dpxchaos_report_rc": rc4,
+        "seeded_report_rc": rc5,
+        "report": report_path,
+        "log": log,
+        **({"failures": failures} if failures else {}),
+    }
+    print(json.dumps(summary))
+    if not failures and smoke:
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif failures:
+        _progress(f"artifacts kept for inspection: {workdir}")
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    smoke = "--smoke" in (sys.argv[1:] if argv is None else argv)
+    return run_campaign(smoke=smoke)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,156 @@
+"""Composed-stack scale sweep: the full train composition (hier
+two-level ring x adaptive wire x bucketed overlap) at world sizes
+w=2..max-sustainable on THIS host, one gated perfbench record per
+world (``python bench.py --stage scale_sweep``; ROADMAP item 3's
+"scale story" satellite).
+
+Per world the sweep reports steps/s, the exposed-vs-overlapped comm
+split (ms/step, straight from CommStats — the same numbers dpxmon
+surfaces live) and bytes moved per step. The point is the SHAPE across
+worlds, not any one absolute number: exposed_ms must not explode as
+the world grows (overlap keeps hiding the wire), and bytes/step must
+track the expected ring volume. ``DPX_SCALE_WORLDS=2,4,8`` overrides
+the world list; worlds the host cannot sustain (beyond
+``max(4, cpu_count)`` — world 4 is the repo's floor everywhere else:
+soak, chaos, the dp8 family time-share smaller hosts) are skipped and
+reported as skipped, never silently dropped.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SWEEP_STEPS = 12
+WARMUP_STEPS = 3
+
+
+def _sweep_worker(rank: int, world: int, q, steps: int) -> None:
+    """One rank of the composed stack (module-level: spawn-picklable).
+    Rank 0 puts the per-world row; timing is barrier-fenced so every
+    rank measures the same window."""
+    import jax
+    import numpy as np
+
+    import distributed_pytorch_tpu as dist
+    from distributed_pytorch_tpu import models, optim
+    from distributed_pytorch_tpu.ops.losses import cross_entropy
+    from distributed_pytorch_tpu.parallel import make_train_step
+    from distributed_pytorch_tpu.runtime import context
+
+    dist.init_process_group(rank, world)
+    try:
+        model = models.DummyModel(in_dim=16, hidden_dim=128, n_classes=8)
+        opt = optim.adamw(1e-3)
+
+        def loss_fn(p, batch):
+            x, y = batch
+            return cross_entropy(model.apply(p, x), y), {}
+
+        step_fn = make_train_step(loss_fn, opt, grad_reduce="adaptive",
+                                  overlap=True, comm_buckets=2)
+        params = model.init(jax.random.PRNGKey(0))
+        opt_state = step_fn.init_opt_state(params)
+        rng = np.random.default_rng(7)
+        batch = (rng.random((8, 16), dtype=np.float32),
+                 rng.integers(0, 8, size=(8,)).astype(np.int32))
+
+        comm = context.get_host_comm()
+        for _ in range(WARMUP_STEPS):
+            out = step_fn(params, opt_state, batch)
+            params, opt_state = out.params, out.opt_state
+
+        before = comm.stats.snapshot()
+        comm.barrier()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = step_fn(params, opt_state, batch)
+            params, opt_state = out.params, out.opt_state
+        comm.barrier()
+        wall = time.perf_counter() - t0
+        after = comm.stats.snapshot()
+
+        if rank == 0:
+            # per-step deltas over the fenced window (the barriers
+            # themselves land in the totals; their cost is part of the
+            # composed stack's step)
+            d = {k: after[k] - before[k] for k in after}
+            q.put({
+                "world": world,
+                "steps": steps,
+                "steps_per_sec": round(steps / wall, 2),
+                "exposed_ms": round(d["exposed_s"] * 1e3 / steps, 3),
+                "overlapped_ms": round(d["overlapped_s"] * 1e3 / steps,
+                                       3),
+                "bytes_per_step": int(d["bytes"] / steps),
+                "comm_calls_per_step": round(d["calls"] / steps, 1),
+            })
+    finally:
+        dist.cleanup()
+
+
+def _worlds() -> list:
+    from distributed_pytorch_tpu.runtime import env as _env
+    raw = _env.get("DPX_SCALE_WORLDS")
+    if raw:
+        return [int(w) for w in str(raw).split(",") if w.strip()]
+    return [2, 4]
+
+
+def run_scale_sweep() -> dict:
+    """The sweep entry (``bench.py --stage scale_sweep``): one row per
+    sustainable world, gated (steps/s and bytes/step must be positive
+    at every world) and appended to the perfbench trajectory."""
+    from distributed_pytorch_tpu.runtime import env as _env
+    from distributed_pytorch_tpu.runtime.multiprocess import (
+        launch_multiprocess)
+
+    max_world = max(4, os.cpu_count() or 2)
+    rows, skipped = [], []
+    t0 = time.perf_counter()
+    saved = _env.snapshot(["DPX_HIER_RING"])
+    try:
+        for world in _worlds():
+            if world > max_world:
+                skipped.append(world)
+                print(f"# scale_sweep: skipping world {world} "
+                      f"(> max sustainable {max_world})",
+                      file=sys.stderr, flush=True)
+                continue
+            # hier ring only divides even worlds >= 4; below that the
+            # flat ring IS the composed stack
+            if world >= 4 and world % 2 == 0:
+                _env.set("DPX_HIER_RING", "2")
+            else:
+                _env.unset("DPX_HIER_RING")
+            ctx = mp.get_context("spawn")
+            q = ctx.Queue()
+            launch_multiprocess(_sweep_worker, world, q, SWEEP_STEPS)
+            rows.append(q.get(timeout=60))
+    finally:
+        _env.restore(saved)
+    wall_s = time.perf_counter() - t0
+
+    ok = bool(rows) and all(
+        r["steps_per_sec"] > 0 and r["bytes_per_step"] > 0
+        for r in rows)
+    result = {"scale_sweep": rows, "skipped_worlds": skipped,
+              "ok": ok, "wall_s": round(wall_s, 1)}
+    try:
+        from bench import append_result
+        append_result("scale_sweep", result, ok=ok, wall_s=wall_s)
+    except Exception as e:  # noqa: BLE001 — the sweep result still prints
+        print(f"# scale_sweep: trajectory append failed: {e}",
+              file=sys.stderr)
+    return result
+
+
+if __name__ == "__main__":
+    out = run_scale_sweep()
+    print(json.dumps(out))
+    raise SystemExit(0 if out["ok"] else 1)

@@ -103,6 +103,7 @@ TYPED_ERRORS: Dict[str, Tuple[str, ...]] = {
     "CommPeerDied": ("op", "rank", "peer"),
     "CommTimeout": ("op", "rank", "peer", "deadline_ms"),
     "CommCorrupt": ("op", "rank", "peer"),
+    "CommRetryExhausted": ("op", "rank", "peer", "attempts"),
     "CkptError": ("step", "rank", "shard"),
     "CkptCorrupt": ("step", "rank", "shard"),
     "CkptIncomplete": ("step", "rank", "shard"),

@@ -55,6 +55,12 @@ KNOWN_EVENTS = frozenset({
     "bench_row",
     # observability (this subsystem)
     "trace_span", "flight_recorder", "fault_injected",
+    # chaos campaigns (runtime/chaos.py + benchmarks/chaos_campaign.py):
+    # one comm_retry per transient-fault retry (op/rank/attempt/backoff
+    # attributed — a retry is never silent), one chaos_clause per
+    # campaign clause verdict (fired / typed error / attribution /
+    # recovery)
+    "comm_retry", "chaos_clause",
     # dpxmon live monitoring (obs/metrics.py + obs/health.py): per-rank
     # registry snapshots and the SLO state machine's transitions
     "metrics_snapshot", "health_transition",

@@ -462,9 +462,11 @@ def flight_dump(reason: str, rank: Optional[int] = None,
     several ops in a row dumps once, like the schedule recorder's flush
     — and silent when the ring is empty (a supervisor that never traced
     a span has no timeline to ship). ``rank`` is a fallback attribution
-    when this process never learned its own (the dump must stay
-    rank-attributed — the ``dpxtrace check`` contract). Never raises;
-    returns whether a line was written."""
+    when this process never learned its own; with neither, ``-1``
+    ("this process is not a rank": a single-process serve engine, a
+    campaign driver) — the dump must stay rank-attributed either way,
+    that is the ``dpxtrace check`` contract. Never raises; returns
+    whether a line was written."""
     st = _state if _state is not None else _init()
     if not st.enabled or not st.log_path:
         return False
@@ -478,7 +480,8 @@ def flight_dump(reason: str, rank: Optional[int] = None,
         from ..utils.logging import append_event
         return append_event(
             "flight_recorder", path=st.log_path, reason=reason,
-            rank=st.rank if st.rank is not None else rank,
+            rank=st.rank if st.rank is not None
+            else (rank if rank is not None else -1),
             pid=os.getpid(), n_spans=len(spans),
             dropped=dropped, spans=spans, **fields)
     except Exception:

@@ -97,6 +97,7 @@ def elastic_run(target: Callable, args: Sequence = (), *,
     demanding the original world size back (docs/failures.md). Returning
     None keeps the previous arguments.
     """
+    from ..obs import metrics as _dpxmon
     from ..utils.logging import append_event
 
     ctx = mp.get_context(ctx_method)
@@ -130,6 +131,11 @@ def elastic_run(target: Callable, args: Sequence = (), *,
                     p.join()  # dpxlint: disable=DPX003 post-SIGKILL reap returns promptly
             raise
         codes.append(p.exitcode)
+        # dpxmon gauges (obs/metrics.py): relaunch churn is alertable
+        # BEFORE giveup — a monitor rule on elastic.attempts catches a
+        # crash-looping worker while restarts are still being burned
+        _dpxmon.set_gauge("elastic.attempts", attempt + 1)
+        _dpxmon.set_gauge("elastic.last_exit_code", p.exitcode)
         if p.exitcode == 0:
             if attempt > 0:
                 append_event("elastic_recovered", restarts=attempt,

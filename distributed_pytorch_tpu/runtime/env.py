@@ -340,6 +340,25 @@ register("DPX_FAULT", "str", None,
          "Deterministic fault-injection spec(s): "
          "`action@key=value,...` with actions kill|delay|drop_conn|"
          "diverge (grammar in runtime/faults.py, docs/failures.md).")
+register("DPX_CHAOS", "str", None,
+         "Declarative multi-fault chaos campaign: inline JSON, a path "
+         "to a JSON spec, or `;`-joined `[leg:expect:]fault` clauses "
+         "(grammar in runtime/chaos.py, docs/failures.md; driven by "
+         "benchmarks/chaos_campaign.py, validated by tools/dpxchaos.py).")
+register("DPX_RETRY_MAX", "int", 2,
+         "Bounded retry budget for TRANSIENT comm faults — rendezvous "
+         "connect and the handoff-transport hooks retry up to this many "
+         "times (total attempts = 1 + budget) before raising the typed "
+         "CommRetryExhausted. Collectives mid-flight never retry "
+         "(docs/failures.md).")
+register("DPX_RETRY_BACKOFF_MS", "float", 25.0,
+         "Base backoff of the transient-fault retry path: attempt k "
+         "sleeps base*2^(k-1) ms before re-entering; every retry emits "
+         "a comm_retry event so flakiness is never silent.")
+register("DPX_CHAOS_WORLD", "int", 4,
+         "World size of the chaos-campaign train legs "
+         "(benchmarks/chaos_campaign.py; the shrink-resume leg "
+         "relaunches at half this).")
 register("DPX_ELASTIC_ATTEMPT", "int", 0,
          "Restart attempt number exported to elastically supervised "
          "workers (0 = first launch).")
@@ -368,6 +387,10 @@ register("DPX_SOAK_SECONDS", "float", 0.0,
          "Wall-clock budget of a long soak run (0 = step-bounded "
          "only). The worker checks the budget at step granularity and "
          "exits cleanly once it is spent.")
+register("DPX_SCALE_WORLDS", "str", None,
+         "Comma-separated world sizes for the weak-scaling sweep "
+         "(bench.py --stage scale_sweep); default derives "
+         "2..max-sustainable from the host core count.")
 
 # -- serving ----------------------------------------------------------------
 register("DPX_SERVE_PAGE_LEN", "int", 16,

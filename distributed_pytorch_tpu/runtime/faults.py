@@ -14,8 +14,9 @@ programmatically via :func:`install`. The spec grammar::
 
     DPX_FAULT = spec [';' spec ...]
     spec      = action '@' key '=' value [',' key '=' value ...]
-    action    = 'kill' | 'delay' | 'drop_conn' | 'diverge'
+    action    = 'kill' | 'delay' | 'drop_conn' | 'diverge' | 'flaky'
     key       = 'step' | 'rank' | 'op' | 'call' | 'ms' | 'attempt'
+              | 'count'
 
 Examples::
 
@@ -84,6 +85,14 @@ Actions:
   branch), which deadlocks until the deadline. The schedule verifier
   (``analysis/schedule.py``) exists to turn exactly this into a report
   naming the rank/op/sequence; the world-4 chaos test injects it.
+- ``flaky``     — raise :class:`FlakyFault` at the match point, ``count``
+  times (default 1), then let the op through: a TRANSIENT fault (the
+  connection that refuses twice and then accepts). The bounded-retry
+  wrappers (``runtime/chaos.py``; rendezvous connect and the handoff
+  transport hooks) treat it as retryable, so a chaos campaign can prove
+  the retry path deterministically — fail N times, succeed on attempt
+  N+1, with a ``comm_retry`` event per retry. At an un-wrapped hook
+  site it propagates like any injected error (fail-fast).
 
 Everything is deterministic: no randomness, counters only advance at
 hook call sites, and a given (spec, call history) always injects at the
@@ -108,12 +117,22 @@ FAULT_ENV = "DPX_FAULT"
 #: supervisor/test can tell an injected death from an organic one.
 KILL_EXIT_CODE = 43
 
-_ACTIONS = ("kill", "delay", "drop_conn", "diverge")
-_INT_KEYS = ("step", "rank", "call", "ms", "attempt")
+_ACTIONS = ("kill", "delay", "drop_conn", "diverge", "flaky")
+_INT_KEYS = ("step", "rank", "call", "ms", "attempt", "count")
+
+
+class FlakyFault(RuntimeError):
+    """The injected TRANSIENT failure of the ``flaky`` action: raised at
+    the hook site for the spec's first ``count`` matches, after which the
+    op goes through clean. The retry wrappers in ``runtime/chaos.py``
+    recognize it as retryable; everything else treats it as the terminal
+    error it would be in production."""
+
 
 #: Comm-layer op names that fire op-scoped specs from :func:`on_comm_op`
-#: (the HostComm hook sites; informational — the grammar accepts any op
-#: string, this is the registry of names the runtime actually emits).
+#: (the HostComm hook sites). ``parse_fault_spec`` VALIDATES ``op=``
+#: values against this vocabulary (plus :func:`register_op` extensions) —
+#: a typo'd op name must fail at parse time, not silently never fire.
 #: ``allreduce_q4`` is the 4-bit adaptive-wire ring (the width is part
 #: of the op name, so a width-scoped fault targets exactly the q4
 #: steps); ``reduce_scatter``/``allgather`` are the sharded-weight-
@@ -123,11 +142,30 @@ _INT_KEYS = ("step", "rank", "call", "ms", "attempt")
 #: leader-ring scatter phase); ``ckpt*`` ops fire from the checkpoint
 #: save path and ``serve_step`` from the serving engine's iteration
 #: hook.
-COMM_OPS = ("allreduce", "allreduce_q8", "allreduce_q4",
+#: ``init`` is the rendezvous-connect hook (``HostComm.__init__`` fires
+#: it before each native ``dpx_comm_init`` attempt — the retry-wrapped
+#: site, so ``flaky@op=init,rank=1,count=2`` makes rank 1's rendezvous
+#: refuse twice and then connect).
+COMM_OPS = ("init",
+            "allreduce", "allreduce_q8", "allreduce_q4",
             "reduce_scatter", "allgather", "hier_reduce", "hier_gather",
             "reduce", "gather", "broadcast", "barrier",
             "ckpt", "ckpt_commit", "ckpt_commit_window", "serve_step",
             "page_admit", "page_evict", "handoff_send", "handoff_recv")
+
+_extra_ops: set = set()
+
+
+def register_op(op: str) -> None:
+    """Extend the op vocabulary :func:`parse_fault_spec` accepts — the
+    escape hatch for out-of-tree hook sites that call :func:`on_comm_op`
+    with their own op names. Idempotent; process-local."""
+    _extra_ops.add(op)
+
+
+def registered_ops() -> tuple:
+    """The full op vocabulary (built-in + registered extensions)."""
+    return COMM_OPS + tuple(sorted(_extra_ops))
 
 
 @dataclass
@@ -139,7 +177,9 @@ class FaultSpec:
     call: Optional[int] = None
     ms: Optional[int] = None
     attempt: Optional[int] = None
+    count: Optional[int] = None       # flaky: matches that raise (def. 1)
     fired: bool = field(default=False, compare=False)
+    left: Optional[int] = field(default=None, compare=False)  # flaky budget
 
     def matches_rank_attempt(self, rank: Optional[int]) -> bool:
         # a rank-scoped spec never fires from a hook that cannot say
@@ -176,9 +216,20 @@ def parse_fault_spec(spec: str) -> List[FaultSpec]:
             key, eq, val = tok.partition("=")
             if not eq or key not in _INT_KEYS + ("op",):
                 raise ValueError(f"bad fault key {tok!r} in {part!r}")
+            if key == "op" and val not in COMM_OPS \
+                    and val not in _extra_ops:
+                # a misspelled op would otherwise arm a spec that can
+                # never fire — the chaos test goes vacuously green
+                raise ValueError(
+                    f"unregistered fault op {val!r} in {part!r} — "
+                    f"registered ops: {', '.join(registered_ops())} "
+                    f"(extend via faults.register_op)")
             kw[key] = val if key == "op" else int(val)
         if action == "delay" and "ms" not in kw:
             raise ValueError(f"delay fault needs ms= in {part!r}")
+        if action != "flaky" and "count" in kw:
+            raise ValueError(
+                f"count= is only meaningful for flaky faults in {part!r}")
         out.append(FaultSpec(action=action, **kw))
     return out
 
@@ -228,6 +279,14 @@ def fired() -> List[str]:
     return list(_log)
 
 
+def armed() -> bool:
+    """Whether any fault spec is live in this process. Hot paths that
+    would otherwise pay a retry-wrapper closure per call (the
+    transport's recv(0) busy-poll) gate on this — with nothing armed
+    the hook is a no-op, so skipping it entirely is equivalent."""
+    return bool(_active())
+
+
 def _active() -> List[FaultSpec]:
     """The live spec list, re-parsed whenever ``DPX_FAULT`` changes."""
     global _specs, _specs_src
@@ -255,7 +314,15 @@ def _live_comms():
 
 
 def _fire(spec: FaultSpec, site: str, rank: Optional[int], comm) -> None:
-    if spec.action != "delay":
+    if spec.action == "flaky":
+        # a bounded budget of transient failures, then the op succeeds:
+        # fired flips once the budget is spent so later matches pass
+        if spec.left is None:
+            spec.left = spec.count if spec.count is not None else 1
+        spec.left -= 1
+        if spec.left <= 0:
+            spec.fired = True
+    elif spec.action != "delay":
         spec.fired = True  # kill/drop_conn are one-shot; delay repeats
     _log.append(f"{spec.action}@{site}")
     print(f"# fault-injection: {spec.action} firing at {site} "
@@ -285,6 +352,9 @@ def _fire(spec: FaultSpec, site: str, rank: Optional[int], comm) -> None:
         targets = [comm] if comm is not None else _live_comms()
         for c in targets:
             c.barrier()
+    elif spec.action == "flaky":
+        raise FlakyFault(
+            f"injected transient fault at {site} (rank {rank})")
 
 
 def on_comm_op(op: str, rank: Optional[int] = None, comm=None) -> None:

@@ -76,6 +76,19 @@ class CommCorrupt(CommError):
     transport or codec corruption that must never reach gradients."""
 
 
+class CommRetryExhausted(CommError):
+    """A TRANSIENT fault outlived the bounded retry budget
+    (``DPX_RETRY_MAX`` attempts with ``DPX_RETRY_BACKOFF_MS``
+    exponential backoff — ``runtime/chaos.py``). Carries how many
+    attempts were made, so a supervisor can distinguish "flaky but we
+    tried" from a first-strike failure; the final transient error is
+    chained as ``__cause__``."""
+
+    def __init__(self, msg: str, *, attempts: int = 0, **kw):
+        super().__init__(msg, **kw)
+        self.attempts = attempts
+
+
 def _build() -> None:
     # Build to a per-pid temp path and rename atomically: concurrently
     # spawned rank processes may all see the .so missing, and a partially
@@ -262,12 +275,27 @@ class HostComm:
         # the native layer takes dotted-quad only; resolve hostnames (e.g.
         # 'localhost', the reference's MASTER_ADDR default) here
         addr = _socket.gethostbyname(master_addr)
-        self._h = self._lib.dpx_comm_init(
-            addr.encode(), base_port, rank, world, timeout_ms)
-        if not self._h:
-            raise CommError(
-                f"native rendezvous failed (rank {rank}/{world} on "
-                f"{master_addr}:{base_port})", op="init", rank=rank)
+
+        def _rendezvous():
+            # the op=init fault hook fires per ATTEMPT (flaky@op=init
+            # proves the retry path); a null handle is the native
+            # layer's connect/accept failure after its own internal
+            # timeout — nothing is established yet, so re-entering is
+            # safe, and rendezvous is the one comm call that retries
+            # (docs/failures.md "Retry policy")
+            _faults.on_comm_op("init", rank=rank)
+            h = self._lib.dpx_comm_init(
+                addr.encode(), base_port, rank, world, timeout_ms)
+            if not h:
+                raise CommError(
+                    f"native rendezvous failed (rank {rank}/{world} on "
+                    f"{master_addr}:{base_port})", op="init", rank=rank)
+            return h
+
+        from . import chaos as _chaos
+        self._h = _chaos.call_with_retry(
+            _rendezvous, op="init", rank=rank,
+            transient=(_faults.FlakyFault, CommError))
         if op_timeout_ms is None:
             op_timeout_ms = _envreg.get(COMM_TIMEOUT_ENV)
         self._lib.dpx_set_timeout_ms(self._h, op_timeout_ms)

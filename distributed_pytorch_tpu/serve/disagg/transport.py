@@ -23,6 +23,13 @@ entering a send, ``op=handoff_recv`` as a frame is taken off — with
 themselves as the fault hook's comm, so ``drop_conn@op=handoff_send``
 severs the channel mid-handoff (the in-process analog of killing the
 prefill engine; the chaos test in tests/test_serve_disagg.py).
+
+The hook call is the one point of a handoff with NO bytes in flight, so
+it is retry-wrapped (:func:`...runtime.chaos.call_with_retry`): an
+injected ``flaky@op=handoff_send`` refuses ``count`` times and then the
+frame goes through, each retry logged as a ``comm_retry`` event. Once a
+broadcast has started, failures stay fail-fast (``TransportSevered`` /
+the typed handoff vocabulary) — docs/failures.md "Retry policy".
 """
 
 from __future__ import annotations
@@ -33,6 +40,7 @@ from typing import Optional
 
 import numpy as np
 
+from ...runtime import chaos as _chaos
 from ...runtime import faults
 from ...utils.profiler import CommStats
 
@@ -62,7 +70,10 @@ class LocalTransport:
         """Enqueue one encoded frame, booking its KV wire bytes (the
         ``wire.handoff_page_wire_bytes`` accounting the CI gate pins)
         under ``handoff_send``."""
-        faults.on_comm_op("handoff_send", comm=self)
+        if faults.armed():
+            _chaos.call_with_retry(
+                lambda: faults.on_comm_op("handoff_send", comm=self),
+                op="handoff_send")
         if self._severed.is_set():
             raise TransportSevered("handoff transport severed")
         with self.stats.timed("handoff_send", kv_bytes):
@@ -82,7 +93,11 @@ class LocalTransport:
                 raise TransportSevered(
                     "handoff transport severed") from None
             return None
-        faults.on_comm_op("handoff_recv", comm=self)
+        # the frame is already in hand — retrying the hook alone is safe
+        if faults.armed():
+            _chaos.call_with_retry(
+                lambda: faults.on_comm_op("handoff_recv", comm=self),
+                op="handoff_recv")
         self.frames_recv += 1
         return frame
 
@@ -127,10 +142,25 @@ class HostCommTransport:
         self.stats = CommStats()
         self.frames_sent = 0
         self.frames_recv = 0
+        self._expected: Optional[int] = None  # request the next recv serves
+
+    def expect(self, request_id: Optional[int]) -> None:
+        """Announce which request the next :meth:`recv` is waiting on.
+        With a request in hand, a deadline expiry (``CommTimeout``)
+        surfaces as the typed, request-attributed ``HandoffTimeout``
+        instead of a bare severed transport — the cross-process analog
+        of the router's in-process handoff sweep
+        (``DisaggEngine.sweep_handoff_timeouts``). ``None`` clears it."""
+        self._expected = request_id
 
     def send(self, frame: bytes, kv_bytes: int) -> None:
         from ...runtime.native import CommError
-        faults.on_comm_op("handoff_send", rank=self.comm.rank, comm=self)
+        if faults.armed():
+            _chaos.call_with_retry(
+                lambda: faults.on_comm_op("handoff_send",
+                                          rank=self.comm.rank,
+                                          comm=self),
+                op="handoff_send", rank=self.comm.rank)
         try:
             with self.stats.timed("handoff_send", kv_bytes):
                 self.comm.broadcast(
@@ -146,16 +176,34 @@ class HostCommTransport:
         """Blocking receive of one frame (``timeout_s`` is accepted for
         interface parity; the native ``DPX_COMM_TIMEOUT_MS`` deadline
         governs, so this still cannot hang forever)."""
-        from ...runtime.native import CommError
-        faults.on_comm_op("handoff_recv", rank=self.comm.rank, comm=self)
+        from ...runtime.native import CommError, CommTimeout
+        if faults.armed():
+            _chaos.call_with_retry(
+                lambda: faults.on_comm_op("handoff_recv",
+                                          rank=self.comm.rank,
+                                          comm=self),
+                op="handoff_recv", rank=self.comm.rank)
         hdr = np.zeros(1, np.int64)
         try:
             self.comm.broadcast(hdr, src=self.src)
             buf = np.zeros(int(hdr[0]), np.uint8)
             self.comm.broadcast(buf, src=self.src)
         except CommError as e:
+            if isinstance(e, CommTimeout) and self._expected is not None:
+                # a named request was waiting on this frame: the expiry
+                # IS a handoff timeout, attributed to that request
+                from ..types import HandoffTimeout
+                raise HandoffTimeout(
+                    f"request {self._expected}: no handoff frame within "
+                    f"the comm deadline ({e.deadline_ms} ms) on the "
+                    f"cross-process transport",
+                    request_id=self._expected,
+                    deadline_ms=float(e.deadline_ms),
+                    engine="transport",
+                    iteration=self.frames_recv) from e
             raise TransportSevered(
                 f"handoff recv failed: {e}") from e
+        self._expected = None
         self.frames_recv += 1
         return buf.tobytes()
 

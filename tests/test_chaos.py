@@ -1,0 +1,442 @@
+"""dpxchaos tests: the declarative campaign engine (runtime/chaos.py),
+the bounded transient-fault retry (``flaky`` faults absorbed at the
+rendezvous and the handoff transport, ``comm_retry``-evented, exhausted
+into the typed ``CommRetryExhausted``), the elastic supervision gauges,
+the cross-process HandoffTimeout, and the dpxchaos CLI."""
+
+import json
+import multiprocessing as mp
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_pytorch_tpu.obs import metrics as dpxmon
+from distributed_pytorch_tpu.runtime import chaos, elastic, faults
+from distributed_pytorch_tpu.runtime.multiprocess import launch_multiprocess
+from distributed_pytorch_tpu.runtime.native import (CommError,
+                                                    CommRetryExhausted)
+from distributed_pytorch_tpu.serve.disagg import LocalTransport
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.FAULT_ENV, raising=False)
+    monkeypatch.delenv(chaos.CHAOS_ENV, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _read_events(path, name):
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as f:
+        for ln in f:
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("event") == name:
+                out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# campaign grammar
+# ---------------------------------------------------------------------------
+
+
+class TestCampaignGrammar:
+    def test_inline_json(self):
+        c = chaos.parse_campaign(json.dumps({
+            "name": "demo",
+            "clauses": [
+                {"fault": "kill@step=3,rank=1", "leg": "train_shrink",
+                 "expect": "elastic_resume", "id": "k"},
+                {"fault": "flaky@op=handoff_send,count=2",
+                 "leg": "transport", "expect": "retry_recover",
+                 "env": {"DPX_RETRY_MAX": 5}},
+            ]}))
+        assert c.name == "demo" and len(c.clauses) == 2
+        assert c.clauses[0].id == "k"
+        assert c.clauses[0].leg == "train_shrink"
+        assert c.clauses[1].id == "c01"        # auto-assigned
+        assert c.clauses[1].specs[0].count == 2
+        env = c.clauses[1].arm_env()
+        assert env[faults.FAULT_ENV] == "flaky@op=handoff_send,count=2"
+        assert env["DPX_RETRY_MAX"] == "5"     # stringified for children
+
+    def test_json_file_names_the_campaign(self, tmp_path):
+        p = tmp_path / "storm.json"
+        p.write_text(json.dumps(
+            {"clauses": [{"fault": "drop_conn@op=handoff_send"}]}))
+        c = chaos.parse_campaign(str(p))
+        assert c.name == "storm"
+        assert c.clauses[0].leg == "train"           # defaults
+        assert c.clauses[0].expect == "typed_error"
+
+    def test_compact_env_form(self):
+        c = chaos.parse_campaign(
+            "transport:retry_recover:flaky@op=handoff_send,count=1;"
+            "delay@op=allreduce,ms=50")
+        assert [x.leg for x in c.clauses] == ["transport", "train"]
+        assert c.clauses[0].expect == "retry_recover"
+        assert c.clauses[0].id == "c00" and c.clauses[1].id == "c01"
+
+    def test_grid_expansion_is_cartesian(self):
+        c = chaos.parse_campaign({"clauses": [{
+            "grid": {"action": "kill", "op": ["allreduce", "barrier"],
+                     "rank": [0, 1]},
+            "id": "g", "leg": "train", "expect": "typed_error"}]})
+        assert len(c.clauses) == 4
+        assert sorted(x.id for x in c.clauses) == \
+            ["g.0", "g.1", "g.2", "g.3"]
+        combos = {(x.specs[0].op, x.specs[0].rank) for x in c.clauses}
+        assert combos == {("allreduce", 0), ("allreduce", 1),
+                          ("barrier", 0), ("barrier", 1)}
+
+    @pytest.mark.parametrize("bad,match", [
+        ({"clauses": [{"fault": "kill@op=allredcue"}]},
+         "unregistered fault op"),
+        ({"clauses": [{"fault": "kill@step=1", "leg": "cloud"}]},
+         "unknown leg"),
+        ({"clauses": [{"fault": "kill@step=1", "expect": "magic"}]},
+         "unknown expect"),
+        ({"clauses": [{"fault": "kill@step=1", "grid": {"action": "kill"}}]},
+         "exactly one of"),
+        ({"clauses": [{"grid": {"op": ["allreduce"]}}]}, "'action' key"),
+        ({"clauses": [{"fault": "kill@step=1", "bogus": 1}]},
+         "unknown key"),
+        ({"clauses": []}, "no clauses"),
+        ("", "empty campaign"),
+        ("{not json", "not valid JSON"),
+        ("a:b:c:kill@step=1", "compact clause"),
+    ])
+    def test_bad_campaigns_raise_typed(self, bad, match):
+        with pytest.raises(ValueError, match=match):
+            chaos.parse_campaign(bad)
+
+    def test_load_campaign_env_overrides_default(self, monkeypatch):
+        assert chaos.load_campaign() is None
+        default = {"name": "d", "clauses": [{"fault": "kill@step=1"}]}
+        assert chaos.load_campaign(default=default).name == "d"
+        monkeypatch.setenv(chaos.CHAOS_ENV, "delay@op=allreduce,ms=5")
+        c = chaos.load_campaign(default=default)
+        assert c.clauses[0].fault == "delay@op=allreduce,ms=5"
+
+
+# ---------------------------------------------------------------------------
+# clause verdicts
+# ---------------------------------------------------------------------------
+
+
+class TestVerdicts:
+    def _clause(self, expect):
+        return chaos.parse_campaign(
+            {"clauses": [{"fault": "kill@step=1", "expect": expect}
+                         if expect != "retry_recover" else
+                         {"fault": "flaky@op=handoff_send",
+                          "leg": "transport", "expect": expect}]}
+        ).clauses[0]
+
+    def test_typed_error_needs_fired_typed_attributed(self):
+        c = self._clause("typed_error")
+        row = chaos.clause_report(c, fired=True, typed_error="CommError",
+                                  attributed=True)
+        assert chaos.clause_green(row)
+        assert not chaos.clause_green(
+            chaos.clause_report(c, fired=False, typed_error="CommError",
+                                attributed=True))
+        assert not chaos.clause_green(
+            chaos.clause_report(c, fired=True, typed_error="CommError",
+                                attributed=False))
+
+    def test_retry_recover_needs_actual_retries(self):
+        c = self._clause("retry_recover")
+        assert chaos.clause_green(chaos.clause_report(
+            c, fired=True, recovered=True, retries=2))
+        # recovery with ZERO retries means the fault never exercised
+        # the retry path — not green
+        assert not chaos.clause_green(chaos.clause_report(
+            c, fired=True, recovered=True, retries=0))
+        assert not chaos.clause_green(chaos.clause_report(
+            c, fired=True, recovered=True, retries=2,
+            typed_error="CommRetryExhausted"))
+
+    def test_elastic_resume_needs_recovery_and_attribution(self):
+        c = self._clause("elastic_resume")
+        assert chaos.clause_green(chaos.clause_report(
+            c, fired=True, typed_error="WorkerFailure", attributed=True,
+            recovered=True))
+        assert not chaos.clause_green(chaos.clause_report(
+            c, fired=True, typed_error="WorkerFailure", attributed=True,
+            recovered=False))
+
+    def test_campaign_verdict_names_failing_clauses(self):
+        c = self._clause("typed_error")
+        good = chaos.clause_report(c, fired=True, typed_error="X",
+                                   attributed=True)
+        bad = dict(chaos.clause_report(c, fired=False), id="badone")
+        v = chaos.campaign_verdict([good, bad])
+        assert v["clauses"] == 2 and v["green"] == 1
+        assert v["failing"] == ["badone"] and not v["ok"]
+        assert chaos.campaign_verdict([good])["ok"]
+
+
+# ---------------------------------------------------------------------------
+# call_with_retry
+# ---------------------------------------------------------------------------
+
+
+class TestCallWithRetry:
+    def test_backoff_doubles_and_events_every_retry(self, tmp_path,
+                                                    monkeypatch):
+        log = str(tmp_path / "m.jsonl")
+        monkeypatch.setenv("DPX_METRICS_LOG", log)
+        sleeps = []
+        calls = {"n": 0}
+
+        def flaky_twice():
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise faults.FlakyFault("boom")
+            return "ok"
+
+        out = chaos.call_with_retry(flaky_twice, op="demo", rank=3,
+                                    max_retries=5, backoff_ms=10.0,
+                                    sleep=sleeps.append)
+        assert out == "ok" and calls["n"] == 3
+        assert sleeps == [0.01, 0.02]          # 10ms, then doubled
+        evs = _read_events(log, "comm_retry")
+        assert [e["attempt"] for e in evs] == [1, 2]
+        assert all(e["op"] == "demo" and e["rank"] == 3 for e in evs)
+        assert [e["backoff_ms"] for e in evs] == [10.0, 20.0]
+
+    def test_exhaustion_raises_typed_with_attempt_count(self):
+        def always():
+            raise faults.FlakyFault("persistent")
+
+        with pytest.raises(CommRetryExhausted) as ei:
+            chaos.call_with_retry(always, op="demo", max_retries=2,
+                                  backoff_ms=0.0, sleep=lambda s: None)
+        e = ei.value
+        assert e.attempts == 3                 # 1 try + 2 retries
+        assert e.op == "demo"
+        assert isinstance(e, CommError)        # typed under the family
+        assert "3 attempt" in str(e) and "budget 2" in str(e)
+
+    def test_non_transient_errors_pass_straight_through(self):
+        def bad():
+            raise ValueError("not transient")
+
+        with pytest.raises(ValueError, match="not transient"):
+            chaos.call_with_retry(bad, op="demo", max_retries=5,
+                                  sleep=lambda s: None)
+
+    def test_budget_comes_from_the_env_registry(self, monkeypatch):
+        monkeypatch.setenv(chaos.RETRY_MAX_ENV, "0")
+
+        def always():
+            raise faults.FlakyFault("x")
+
+        with pytest.raises(CommRetryExhausted) as ei:
+            chaos.call_with_retry(always, op="demo",
+                                  sleep=lambda s: None)
+        assert ei.value.attempts == 1          # zero retries allowed
+
+
+# ---------------------------------------------------------------------------
+# flaky faults through the handoff transport
+# ---------------------------------------------------------------------------
+
+
+class TestFlakyTransport:
+    def test_flaky_send_recovers_within_budget(self, tmp_path,
+                                               monkeypatch):
+        log = str(tmp_path / "m.jsonl")
+        monkeypatch.setenv("DPX_METRICS_LOG", log)
+        monkeypatch.setenv(chaos.RETRY_BACKOFF_ENV, "1")
+        faults.install("flaky@op=handoff_send,count=2")
+        t = LocalTransport()
+        t.send(b"frame", 16)                   # absorbed: 2 fails, then ok
+        assert t.frames_sent == 1
+        assert t.recv() == b"frame"
+        assert len([s for s in faults.fired()
+                    if s.startswith("flaky@")]) == 2
+        evs = _read_events(log, "comm_retry")
+        assert [e["attempt"] for e in evs] == [1, 2]
+        assert all(e["op"] == "handoff_send" for e in evs)
+
+    def test_flaky_send_exhausts_into_typed_error(self, monkeypatch):
+        monkeypatch.setenv(chaos.RETRY_MAX_ENV, "1")
+        monkeypatch.setenv(chaos.RETRY_BACKOFF_ENV, "1")
+        faults.install("flaky@op=handoff_send,count=5")
+        t = LocalTransport()
+        with pytest.raises(CommRetryExhausted) as ei:
+            t.send(b"frame", 16)
+        assert ei.value.attempts == 2
+        assert ei.value.op == "handoff_send"
+        assert t.frames_sent == 0
+
+
+# ---------------------------------------------------------------------------
+# elastic supervision gauges
+# ---------------------------------------------------------------------------
+
+
+def _fail_once_target(marker):
+    if not os.path.exists(marker):
+        with open(marker, "w", encoding="utf-8") as f:
+            f.write("died")
+        sys.exit(3)
+
+
+class TestElasticGauges:
+    def test_attempts_and_last_exit_code(self, tmp_path):
+        marker = str(tmp_path / "died.marker")
+        res = elastic.elastic_run(_fail_once_target, (marker,),
+                                  max_restarts=2, backoff_s=0.05)
+        assert res.restarts == 1 and res.exitcodes == (3, 0)
+        assert dpxmon.gauge("elastic.attempts").value == 2
+        assert dpxmon.gauge("elastic.last_exit_code").value == 0
+
+
+# ---------------------------------------------------------------------------
+# cross-process: rendezvous retry + HandoffTimeout over HostComm
+# ---------------------------------------------------------------------------
+
+
+def _rendezvous_retry_worker(rank, world):
+    import numpy as np
+
+    import distributed_pytorch_tpu as dist
+
+    dist.init_process_group(rank, world)
+    try:
+        dist.all_reduce(np.ones(8, np.float32))
+    finally:
+        dist.cleanup()
+
+
+def test_rendezvous_flaky_connect_recovers(tmp_path, monkeypatch):
+    """A transient rendezvous failure on rank 1 is absorbed by the
+    bounded retry — the world still comes up, and the retry left a
+    rank-attributed ``comm_retry`` event (never silent)."""
+    log = str(tmp_path / "m.jsonl")
+    monkeypatch.setenv("DPX_METRICS_LOG", log)
+    monkeypatch.setenv(faults.FAULT_ENV, "flaky@op=init,rank=1,count=1")
+    monkeypatch.setenv("DPX_COMM_TIMEOUT_MS", "30000")
+    launch_multiprocess(_rendezvous_retry_worker, 2)
+    evs = _read_events(log, "comm_retry")
+    assert any(e["op"] == "init" and e["rank"] == 1 and e["attempt"] == 1
+               for e in evs)
+
+
+def _xproc_handoff_worker(rank, world, q):
+    from distributed_pytorch_tpu.runtime import context
+    from distributed_pytorch_tpu.serve.disagg import HostCommTransport
+    from distributed_pytorch_tpu.serve.types import HandoffTimeout
+    from distributed_pytorch_tpu.serve.disagg.transport import (
+        TransportSevered)
+    import distributed_pytorch_tpu as dist
+
+    dist.init_process_group(rank, world)
+    try:
+        t = HostCommTransport(context.get_host_comm(), src=0)
+        if rank == 0:
+            t.send(b"frame-1", 16)             # call 1: clean
+            try:
+                # call 2: the armed delay stalls us past the peer's
+                # deadline; by the time the bytes move the peer is gone
+                t.send(b"frame-2", 16)
+            except TransportSevered:
+                pass
+        else:
+            assert t.recv() == b"frame-1"
+            t.expect(42)
+            t0 = time.monotonic()
+            try:
+                t.recv()
+                q.put((rank, None, None, None, None))
+            except HandoffTimeout as e:
+                q.put((rank, type(e).__name__, e.request_id,
+                       e.deadline_ms, time.monotonic() - t0))
+                q.close()
+                q.join_thread()
+    finally:
+        dist.cleanup()
+
+
+def test_cross_process_handoff_timeout_is_typed(monkeypatch):
+    """Satellite 4: a stalled cross-process handoff surfaces as the
+    typed, request-attributed ``HandoffTimeout`` on the REAL
+    HostCommTransport — within the native deadline, never a hang."""
+    monkeypatch.setenv(faults.FAULT_ENV,
+                       "delay@op=handoff_send,call=2,ms=3000,rank=0")
+    monkeypatch.setenv("DPX_COMM_TIMEOUT_MS", "700")
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    t0 = time.monotonic()
+    launch_multiprocess(_xproc_handoff_worker, 2, q)
+    assert time.monotonic() - t0 < 25.0
+    rank, kind, request_id, deadline_ms, elapsed = q.get(timeout=10)
+    assert rank == 1 and kind == "HandoffTimeout"
+    assert request_id == 42
+    assert deadline_ms == 700.0
+    assert elapsed < 2 * 0.7 + 1.0
+
+
+# ---------------------------------------------------------------------------
+# the dpxchaos CLI
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(args):
+    p = subprocess.run([sys.executable, "-m", "tools.dpxchaos", *args],
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=60)
+    return p.returncode, p.stdout + p.stderr
+
+
+class TestDpxchaosCli:
+    def test_validate_good_spec(self):
+        rc, out = _run_cli([
+            "validate",
+            "transport:retry_recover:flaky@op=handoff_send,count=2"])
+        assert rc == 0
+        assert "retry_recover" in out and "c00" in out
+
+    def test_validate_bad_op_exits_1_with_vocabulary(self):
+        rc, out = _run_cli(["validate", "kill@op=allredcue"])
+        assert rc == 1
+        assert "unregistered fault op" in out and "allreduce" in out
+
+    def test_report_green_and_failing(self, tmp_path):
+        rows = [{"id": "a", "leg": "transport", "expect": "retry_recover",
+                 "fault": "flaky@op=handoff_send,count=2", "fired": True,
+                 "typed_error": "", "attributed": False,
+                 "recovered": True, "retries": 2}]
+        rep = tmp_path / "r.json"
+        rep.write_text(json.dumps({"name": "t", "clauses": rows}))
+        rc, out = _run_cli(["report", str(rep)])
+        assert rc == 0 and "1/1 clause(s) green" in out
+        rows.append({"id": "dead", "leg": "train",
+                     "expect": "elastic_resume", "fault": "kill@step=1",
+                     "fired": True, "typed_error": "WorkerFailure",
+                     "attributed": True, "recovered": False,
+                     "retries": 0})
+        rep.write_text(json.dumps({"name": "t", "clauses": rows}))
+        rc, out = _run_cli(["report", str(rep)])
+        assert rc == 1 and "dead" in out and "NOT GREEN" in out
+
+    def test_report_unreadable_exits_2(self, tmp_path):
+        rc, _ = _run_cli(["report", str(tmp_path / "nope.json")])
+        assert rc == 2

@@ -65,6 +65,34 @@ class TestSpecGrammar:
         with pytest.raises(ValueError):
             faults.parse_fault_spec(bad)
 
+    def test_unregistered_op_raises_typed_with_vocabulary(self):
+        # the PR 17 bugfix: a typo'd op used to arm a clause that could
+        # never fire — a chaos run silently testing nothing
+        with pytest.raises(ValueError, match="unregistered fault op") as ei:
+            faults.parse_fault_spec("kill@op=allredcue")
+        msg = str(ei.value)
+        assert "'allredcue'" in msg
+        # the error must NAME the registered vocabulary, not just reject
+        assert "allreduce" in msg and "handoff_send" in msg
+        assert "faults.register_op" in msg
+
+    def test_register_op_extends_the_vocabulary(self):
+        with pytest.raises(ValueError, match="unregistered fault op"):
+            faults.parse_fault_spec("delay@op=my_custom_op,ms=5")
+        faults.register_op("my_custom_op")
+        try:
+            (s,) = faults.parse_fault_spec("delay@op=my_custom_op,ms=5")
+            assert s.op == "my_custom_op"
+            assert "my_custom_op" in faults.registered_ops()
+        finally:
+            faults._extra_ops.discard("my_custom_op")
+
+    def test_count_only_valid_on_flaky(self):
+        (s,) = faults.parse_fault_spec("flaky@op=handoff_send,count=3")
+        assert s.action == "flaky" and s.count == 3
+        with pytest.raises(ValueError, match="count"):
+            faults.parse_fault_spec("kill@op=allreduce,count=3")
+
 
 # ---------------------------------------------------------------------------
 # hook semantics (in-process; `kill` is only exercised in subprocesses)

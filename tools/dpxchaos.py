@@ -1,0 +1,158 @@
+"""dpxchaos CLI — validate chaos-campaign declarations and roll up
+campaign reports (runtime/chaos.py + benchmarks/chaos_campaign.py —
+docs/failures.md "Chaos campaigns").
+
+Usage::
+
+    python -m tools.dpxchaos validate SPEC
+                            # SPEC = a DPX_CHAOS value: inline JSON, a
+                            # .json path, or the compact clause grammar.
+                            # Prints the expanded clause table (grid
+                            # clauses multiplied out, every fault spec
+                            # parsed against the registered op
+                            # vocabulary); exit 1 with the typed parse
+                            # error on any bad clause
+    python -m tools.dpxchaos report REPORT.json
+                            # REPORT.json = a chaos_campaign.py
+                            # campaign_report: per-clause verdict table
+                            # (fired / typed error / attributed /
+                            # recovered / green) + the rollup line;
+                            # exit 0 only when EVERY clause is green
+
+Exit codes: 0 = valid / all green, 1 = parse error or non-green
+clause(s), 2 = usage / unreadable input.
+
+Like ``tools/dpxmon.py`` and ``tools/benchdiff.py``, this avoids the
+heavy package ``__init__`` (which pulls jax): ``runtime/chaos.py`` and
+its imports (``runtime/env.py``, ``runtime/faults.py``) are
+stdlib-only and load against fabricated lightweight parents, so the
+CLI runs in a bare venv in milliseconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load_chaos():
+    """Import ``distributed_pytorch_tpu.runtime.chaos``: the REAL
+    package first (in-process test use), else fabricated lightweight
+    parents so the stdlib-only runtime modules resolve against the
+    source tree (the benchdiff/dpxmon loader contract)."""
+    import importlib
+    import types
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    try:
+        return importlib.import_module(
+            "distributed_pytorch_tpu.runtime.chaos")
+    except Exception:  # noqa: BLE001 — bare venv: the __init__ chain needs jax
+        pass
+    pkg_dir = os.path.join(root, "distributed_pytorch_tpu")
+    for name, sub in (("distributed_pytorch_tpu", ""),
+                      ("distributed_pytorch_tpu.runtime", "runtime")):
+        if name not in sys.modules:
+            pkg = types.ModuleType(name)
+            pkg.__path__ = [os.path.join(pkg_dir, sub) if sub
+                            else pkg_dir]
+            sys.modules[name] = pkg
+    return importlib.import_module(
+        "distributed_pytorch_tpu.runtime.chaos")
+
+
+def _fmt_table(rows, cols):
+    if not rows:
+        return ""
+    widths = [max(len(str(c)), *(len(str(r.get(c, ""))) for r in rows))
+              for c in cols]
+    out = ["  ".join(str(c).ljust(w) for c, w in zip(cols, widths))]
+    out.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        out.append("  ".join(str(r.get(c, "")).ljust(w)
+                             for c, w in zip(cols, widths)))
+    return "\n".join(out)
+
+
+def cmd_validate(chaos, args) -> int:
+    try:
+        campaign = chaos.parse_campaign(args.spec)
+    except (ValueError, OSError) as e:
+        print(f"dpxchaos: invalid campaign: {e}", file=sys.stderr)
+        return 1
+    rows = [{"id": c.id, "leg": c.leg, "expect": c.expect,
+             "fault": c.fault,
+             "env": " ".join(f"{k}={v}" for k, v in c.env.items())}
+            for c in campaign.clauses]
+    print(f"campaign {campaign.name!r}: {len(rows)} clause(s)")
+    print(_fmt_table(rows, ("id", "leg", "expect", "fault", "env")))
+    return 0
+
+
+def cmd_report(chaos, args) -> int:
+    try:
+        with open(args.report, "r", encoding="utf-8") as f:
+            report = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"dpxchaos: cannot read report {args.report}: {e}",
+              file=sys.stderr)
+        return 2
+    rows = report.get("clauses")
+    if not isinstance(rows, list) or not rows:
+        print("dpxchaos: report carries no 'clauses' list",
+              file=sys.stderr)
+        return 2
+    shown = []
+    for r in rows:
+        shown.append({
+            "id": r.get("id", "?"), "leg": r.get("leg", "?"),
+            "expect": r.get("expect", "?"),
+            "fault": r.get("fault", "?"),
+            "fired": r.get("fired", False),
+            "typed_error": r.get("typed_error", "") or "-",
+            "attributed": r.get("attributed", False),
+            "recovered": r.get("recovered", False),
+            "retries": r.get("retries", 0),
+            "green": chaos.clause_green(r),
+        })
+    print(_fmt_table(shown, ("id", "leg", "expect", "fault", "fired",
+                             "typed_error", "attributed", "recovered",
+                             "retries", "green")))
+    verdict = chaos.campaign_verdict(rows)
+    name = report.get("name", "campaign")
+    print(f"{name}: {verdict['green']}/{verdict['clauses']} clause(s) "
+          f"green" + ("" if verdict["ok"]
+                      else f" — NOT GREEN: {verdict['failing']}"))
+    return 0 if verdict["ok"] else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dpxchaos",
+        description="validate chaos campaigns / roll up their reports")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_val = sub.add_parser(
+        "validate", help="parse+expand a DPX_CHAOS campaign spec")
+    p_val.add_argument("spec", help="inline JSON, a .json path, or the "
+                                    "compact clause grammar")
+    p_rep = sub.add_parser(
+        "report", help="per-clause verdict table from a campaign "
+                       "report JSON")
+    p_rep.add_argument("report", help="campaign_report.json path")
+    args = parser.parse_args(argv)
+    chaos = _load_chaos()
+    try:
+        if args.cmd == "validate":
+            return cmd_validate(chaos, args)
+        return cmd_report(chaos, args)
+    except BrokenPipeError:
+        # piped into head: exit quietly, not with a traceback
+        os.close(sys.stderr.fileno())
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
