@@ -25,6 +25,13 @@ overrides the built-in matrix) clause by clause:
   ``comm_retry`` events; under a tightened ``DPX_RETRY_MAX`` it
   exhausts into the typed ``CommRetryExhausted`` carrying the attempt
   count.
+* **fleet legs** — the multi-replica serve fleet (``serve/fleet/``)
+  in-process: ``drop_conn@op=fleet_submit`` kills the targeted
+  request's home replica mid-stream. Green means contained — ONLY the
+  victim replica's in-flight stream fails (typed ``ReplicaFailed``,
+  replica + request attributed), the co-resident request re-routes to
+  the survivor bit-exact, placement re-homes the dead shard, and the
+  same-id revive clears the replica's health-failure stream.
 
 The whole run is followed LIVE by the PR 15 HealthMonitor and gated on
 dpxmon's verdict; every clause lands a ``chaos_clause`` event and a
@@ -73,6 +80,11 @@ SMOKE_CAMPAIGN = {
          "expect": "typed_error",
          "note": "severed handoff -> typed PrefillEngineDied, victim "
                  "only"},
+        {"fault": "drop_conn@op=fleet_submit,call=2", "leg": "fleet",
+         "expect": "typed_error",
+         "note": "replica killed mid-stream -> typed ReplicaFailed, "
+                 "victim only; survivor serves bit-exact, shard "
+                 "re-homes, same-id revive clears health"},
     ],
 }
 
@@ -458,6 +470,127 @@ def _run_serve_leg(clause, log: str, pos: int):
                                if not fired else "")
 
 
+def _run_fleet_leg(clause, log: str, pos: int):
+    """One clause through an R=2 in-process serve fleet: the armed
+    ``drop_conn@op=fleet_submit`` kills the targeted request's home
+    replica mid-stream (``_ReplicaAbort`` -> ``kill_replica``). Green
+    means the kill is CONTAINED: only the victim replica's in-flight
+    stream fails (typed ``ReplicaFailed``, replica + request
+    attributed, engine crash chained), the co-resident shared-prefix
+    request re-routes to the survivor and completes BIT-EXACT vs a
+    standalone ``generate()`` call, placement re-homes the dead
+    replica's prefix shard, and a same-id revive serves again and
+    recovers the fleet HealthMonitor verdict.
+
+    The fleet writes its events + snapshots to its OWN log (not the
+    shared campaign log): the leg runs in the DRIVER process, and its
+    process snapshots would collide with the train children's rank-0
+    stream (two different processes' ``proc.rss_bytes`` interleaved
+    under one rank reads as a fake growth breach). The health proof
+    runs HERE instead: the leg's log must show the ok -> degraded
+    (``worker-failure``, rank = victim) -> ok trajectory, and
+    ``tools/dpxmon.py replay`` over it must exit 0."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_pytorch_tpu.models.generate import make_generate_fn
+    from distributed_pytorch_tpu.obs import health
+    from distributed_pytorch_tpu.runtime import chaos, faults
+    from distributed_pytorch_tpu.serve import EngineConfig, SamplingParams
+    from distributed_pytorch_tpu.serve.fleet import (FleetConfig,
+                                                     FleetRouter,
+                                                     ReplicaFailed)
+    from distributed_pytorch_tpu.utils.logging import MetricsLogger
+
+    model, params = _serve_model()
+    rng = np.random.default_rng(13)
+    typed, attributed, recovered, rehomed = "", False, False, False
+    victim = -1
+    faults.reset()
+
+    legdir = tempfile.mkdtemp(prefix="dpx_chaos_fleet_")
+    leglog = os.path.join(legdir, "fleet_metrics.jsonl")
+    fleet = FleetRouter(model, params,
+                        FleetConfig(n_replicas=2,
+                                    engine=EngineConfig(n_slots=2,
+                                                        max_len=64,
+                                                        page_len=8),
+                                    metrics=MetricsLogger(leglog),
+                                    log_every=4))
+    # shared first-page prefix: identical rendezvous key, so both
+    # requests home on the SAME replica — a is the in-flight victim,
+    # b is the submit whose hook call kills that home
+    head = rng.integers(0, 61, (8,)).astype(np.int32)
+    a = np.concatenate([head, rng.integers(0, 61, (6,)).astype(np.int32)])
+    b = np.concatenate([head, rng.integers(0, 61, (4,)).astype(np.int32)])
+    sp_a = SamplingParams(max_new_tokens=48)   # long: in flight at kill
+    sp_b = SamplingParams(max_new_tokens=12)
+    ka, kb = jax.random.PRNGKey(5), jax.random.PRNGKey(6)
+    with fleet:
+        # warm every compile BEFORE arming (the serve-leg discipline:
+        # the call counter only runs while specs are installed)
+        fleet.submit(rng.integers(0, 61, (6,)).astype(np.int32),
+                     SamplingParams(max_new_tokens=2)).result(timeout=120)
+        victim = fleet.home_of(a)
+        faults.install(clause.fault)           # call 1 = a, call 2 = b
+        ha = fleet.submit(a, sp_a, rng=ka)
+        while not ha.tokens:                   # a streaming on its home
+            time.sleep(0.005)
+        hb = fleet.submit(b, sp_b, rng=kb)     # the hook kills a's home
+        try:
+            ha.result(timeout=120)
+        except ReplicaFailed as e:
+            typed = "ReplicaFailed"
+            attributed = (e.replica == victim
+                          and e.request_id == ha.request_id)
+        # containment IS the recovery: b re-routed to the survivor and
+        # its stream is bit-exact vs a standalone generate()
+        out_b = hb.result(timeout=120)
+        fn = make_generate_fn(model, sp_b.max_new_tokens,
+                              temperature=sp_b.temperature,
+                              top_k=sp_b.top_k, top_p=sp_b.top_p,
+                              max_len=64)
+        want = np.asarray(jax.jit(fn)(params, jnp.asarray(b[None]),
+                                      kb))[0]
+        rehomed = fleet.home_of(a) != victim
+        recovered = (bool(np.array_equal(out_b, want)) and rehomed
+                     and hb.replica != victim)
+        # relaunch under the SAME id: the following snapshots name the
+        # replica live again, clearing its health-failure stream
+        fleet.revive_replica(victim)
+        hc = fleet.submit(a, SamplingParams(max_new_tokens=4))
+        recovered = recovered and len(hc.result(timeout=120)) > 0
+        fleet.emit_snapshot()
+        fleet.emit_snapshot()
+    fired = bool(faults.fired())
+    faults.reset()
+    # the fleet health proof, over the leg's own log: the kill must
+    # degrade the victim's stream (rule + replica attributed) and the
+    # revive + snapshots must recover it; replay re-derives the same
+    # verdict with strict snapshot validation (rc 0)
+    mon = health.HealthMonitor(
+        health.parse_rules("fleet.max_queue_depth<=9999"))
+    legrecs, _ = _read_new(leglog, 0)
+    for r in legrecs:
+        mon.feed(r)
+    degraded = any(t["to"] == "degraded"
+                   and t["rule"] == health.FAILURE_RULE
+                   and t["rank"] == victim for t in mon.transitions)
+    rc, _out = _run_cli("tools.dpxmon", ["replay", leglog])
+    recovered = (recovered and degraded and mon.state == "ok"
+                 and rc == 0)
+    if recovered:
+        shutil.rmtree(legdir, ignore_errors=True)
+    recs, _ = _read_new(log, pos)
+    return chaos.clause_report(
+        clause, fired=fired, typed_error=typed, attributed=attributed,
+        recovered=recovered, retries=_count_comm_retries(recs),
+        detail=f"victim=replica {victim} rehomed={rehomed} "
+               f"health_degraded={degraded} health_end={mon.state} "
+               f"dpxmon_rc={rc} log={leglog}")
+
+
 def _run_transport_leg(clause, log: str, pos: int):
     """The retry micro-harness: one bare LocalTransport send with the
     clause armed — recovery proves the bounded retry, exhaustion proves
@@ -551,6 +684,8 @@ def run_campaign(smoke: bool = False) -> int:
                 row = _run_train_leg(clause, log, pos, workdir, world)
             elif clause.leg == "serve":
                 row = _run_serve_leg(clause, log, pos)
+            elif clause.leg == "fleet":
+                row = _run_fleet_leg(clause, log, pos)
             else:
                 row = _run_transport_leg(clause, log, pos)
             row["wall_s"] = round(time.perf_counter() - t_leg, 1)

@@ -54,6 +54,23 @@ math), with TTFT p50/p99 gated medians, occupancy/hit-rate/evictions,
 and the token-divergence fraction vs the exact pool; non-smoke runs
 append stage ``serve_kvq``.
 
+The **fleet arm** (serve/fleet/, docs/serving.md "Multi-replica
+fleet") runs the shared-prefix population through the prefix-affine
+FleetRouter at R=1, 2, 4 replicas on the SAME seeded Poisson arrivals:
+tokens/s and TTFT p50/p99 as gated medians per R, and
+``vs_single_replica_r{2,4}_x`` throughput ratios printed-or-withheld
+per the spread gate (on one CPU host the replicas share cores, so a
+withheld-or-flat ratio is the honest outcome — the record is the
+methodology rail for a real multi-host run). Non-smoke runs append
+stage ``serve_fleet``. The separate ``--fleet-smoke`` mode is the CI
+gate (tier1.yml ``fleet-smoke``): an R=2 fleet serves the
+shared-prefix mix BIT-IDENTICAL to standalone ``generate()`` (and to
+the R=1 fleet — routing never changes tokens) with affinity hit rate
+> 0; one replica killed mid-run fails ONLY its in-flight requests as
+typed replica-attributed ``ReplicaFailed`` while a co-resident stream
+finishes bit-exact; and ``tools/dpxmon.py replay`` exits 0 over the
+fleet's emitted metrics log.
+
 ``--smoke`` shrinks everything to a seconds-scale CPU run AND asserts
 engine streams equal standalone ``generate()`` (all three engines —
 continuous, paged+shared, disaggregated), that the shared arm's hit
@@ -63,9 +80,10 @@ ONE decode program (zero on the prefill side of the split), and the
 q8 handoff byte gates above — the CI job that keeps the engine loops
 from rotting (tier1.yml).
 
-Usage: python benchmarks/serve_bench.py [--smoke] [--slots N]
+Usage: python benchmarks/serve_bench.py [--smoke | --fleet-smoke]
            [--requests N] [--rate R] [--max-new N] [--seed S]
-           [--trials N] [--warmup N] [--prefixes K] [--prefix-len N]
+           [--slots N] [--trials N] [--warmup N] [--prefixes K]
+           [--prefix-len N]
 """
 
 from __future__ import annotations
@@ -198,6 +216,41 @@ def run_disagg(model, params, reqs, n_slots, max_len, rate=None, seed=0,
     return rep, outs
 
 
+def run_fleet(model, params, reqs, n_replicas, n_slots, max_len,
+              rate=None, seed=0, page_len=None, metrics=None):
+    """Submit ``reqs`` through an R-replica prefix-affine fleet
+    (closed loop, or Poisson open loop at ``rate``) and aggregate the
+    per-request SLO records, with the fleet routing counters
+    attached."""
+    from distributed_pytorch_tpu.serve import EngineConfig, aggregate
+    from distributed_pytorch_tpu.serve.fleet import (FleetConfig,
+                                                     FleetRouter)
+    fleet = FleetRouter(
+        model, params,
+        FleetConfig(n_replicas=n_replicas,
+                    engine=EngineConfig(n_slots=n_slots, max_len=max_len,
+                                        paged=page_len is not None,
+                                        page_len=page_len),
+                    metrics=metrics))
+    rng = np.random.default_rng(seed)
+    handles = []
+    t0 = time.monotonic()
+    with fleet:
+        for prompt, sp, key in reqs:
+            if rate is not None:
+                time.sleep(rng.exponential(1.0 / rate))
+            handles.append(fleet.submit(prompt, sp, rng=key))
+        outs = [h.result(timeout=600) for h in handles]
+    wall = time.monotonic() - t0
+    rep = aggregate([h.metrics for h in handles], wall_s=wall)
+    fst = fleet.stats()
+    rep["fleet"] = {"replicas": n_replicas, "routes": fst["routes"],
+                    "spills": fst["spills"],
+                    "route_affinity_hit_rate":
+                        fst["route_affinity_hit_rate"]}
+    return rep, outs
+
+
 def run_static(model, params, reqs, n_slots, max_len):
     """Static batching: FCFS groups of ``n_slots`` through one compiled
     generate() each; every request's TTFT is its group's full wall time
@@ -283,7 +336,176 @@ def measured_arm(run_once, *, warmup, trials):
     return rep, sts["tokens_per_sec"]
 
 
+def fleet_smoke(argv):
+    """The CI fleet gate (tier1.yml ``fleet-smoke``): an R=2
+    prefix-affine fleet serves the shared-prefix mix BIT-IDENTICAL to
+    both standalone ``generate()`` and an R=1 fleet (routing never
+    changes tokens) with affinity hit rate > 0; one replica killed
+    mid-run fails ONLY its in-flight request as typed
+    replica-attributed ``ReplicaFailed`` while a co-resident stream on
+    the survivor finishes bit-exact and a same-id revive serves again;
+    and ``tools/dpxmon.py replay`` exits 0 over the fleet's emitted
+    metrics log (strict snapshot validation + the replica-failure
+    health stream recovering)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.soak import _run_cli
+    from distributed_pytorch_tpu.models.generate import make_generate_fn
+    from distributed_pytorch_tpu.serve import EngineConfig, SamplingParams
+    from distributed_pytorch_tpu.serve.fleet import (FleetConfig,
+                                                     FleetRouter,
+                                                     ReplicaFailed)
+    from distributed_pytorch_tpu.utils.logging import MetricsLogger
+
+    model, params = build_model(True)
+    max_len, page_len = 64, 8
+    n_req, k_prefixes, prefix_len, max_new = 10, 2, 8, 8
+    reqs = make_shared_requests(n_req, model.vocab, max_new, 0,
+                                k_prefixes, prefix_len, tail_max=7)
+    problems = []
+    workdir = tempfile.mkdtemp(prefix="dpx_fleet_smoke_")
+    log = os.path.join(workdir, "fleet_metrics.jsonl")
+
+    # R=2 vs R=1 vs standalone: the determinism gate
+    rep2, outs2 = run_fleet(model, params, reqs, 2, 2, max_len,
+                            rate=50.0, seed=3, page_len=page_len,
+                            metrics=MetricsLogger(log))
+    _, outs1 = run_fleet(model, params, reqs, 1, 2, max_len,
+                         rate=50.0, seed=3, page_len=page_len)
+    for i, (a, b) in enumerate(zip(outs1, outs2)):
+        if not np.array_equal(a, b):
+            problems.append(f"request {i}: R=2 stream != R=1 stream")
+    for i in (0, n_req // 2, n_req - 1):
+        prompt, sp, key = reqs[i]
+        ref = np.asarray(jax.jit(make_generate_fn(
+            model, sp.max_new_tokens, max_len=max_len))(
+            params, jnp.asarray(prompt[None]), key))[0]
+        if not np.array_equal(outs2[i], ref):
+            problems.append(f"request {i} diverged from standalone "
+                            f"generate()")
+    hit = rep2["fleet"]["route_affinity_hit_rate"] or 0.0
+    if not hit > 0:
+        problems.append(f"affinity hit rate {hit} not > 0")
+
+    # kill one replica mid-run: victim-only typed failure, co-resident
+    # bit-exact, same-id revive serves again
+    fleet = FleetRouter(
+        model, params,
+        FleetConfig(n_replicas=2,
+                    engine=EngineConfig(n_slots=2, max_len=max_len,
+                                        paged=True, page_len=page_len),
+                    metrics=MetricsLogger(log), log_every=4))
+    rng = np.random.default_rng(5)
+    with fleet:
+        fleet.submit(reqs[0][0][:6],
+                     SamplingParams(max_new_tokens=2)).result(timeout=120)
+        pa = reqs[0][0]
+        victim = fleet.home_of(pa)
+        # everything the kill window doesn't need happens BEFORE the
+        # victim stream starts: the off-victim prompt scan and the key
+        # constructions would otherwise eat the in-flight runway
+        q = None
+        for _ in range(64):        # a prompt homed OFF the victim
+            cand = rng.integers(0, model.vocab, (10,)).astype(np.int32)
+            if fleet.home_of(cand) != victim:
+                q = cand
+                break
+        ka, kb = jax.random.PRNGKey(7), jax.random.PRNGKey(8)
+        kc = jax.random.PRNGKey(9)
+        spb, spc = (SamplingParams(max_new_tokens=6),
+                    SamplingParams(max_new_tokens=40))
+        if q is None:
+            problems.append("no off-victim prompt found in 64 draws")
+        else:
+            # the co-resident stream starts FIRST (on the survivor),
+            # then the victim stream; the kill lands the moment the
+            # victim stream has a token in flight — nothing else sits
+            # in that window (this model decodes a token every few ms,
+            # so any work between first-token and kill loses the race)
+            hc = fleet.submit(q, spc, rng=kc)
+            ha = fleet.submit(pa, SamplingParams(max_new_tokens=45),
+                              rng=ka)
+            while not ha.tokens:   # in flight on its home replica
+                time.sleep(0.005)
+            fleet.kill_replica(victim, reason="fleet_smoke_kill")
+            try:
+                ha.result(timeout=120)
+                problems.append("in-flight request on the killed "
+                                "replica did not fail")
+            except ReplicaFailed as e:
+                if e.replica != victim or e.request_id != ha.request_id:
+                    problems.append(
+                        f"ReplicaFailed misattributed: replica="
+                        f"{e.replica} request={e.request_id} (wanted "
+                        f"{victim}/{ha.request_id})")
+            except Exception as e:  # noqa: BLE001 — the gate reports it
+                problems.append(f"in-flight failure not typed "
+                                f"ReplicaFailed: {type(e).__name__}")
+            out_c = hc.result(timeout=120)
+            ref_c = np.asarray(jax.jit(make_generate_fn(
+                model, spc.max_new_tokens, max_len=max_len))(
+                params, jnp.asarray(q[None]), kc))[0]
+            if not np.array_equal(out_c, ref_c):
+                problems.append("co-resident stream diverged after "
+                                "the kill")
+            # the dead replica's shard re-homes: a post-kill submit of
+            # the SAME prompt must land on the survivor, bit-exact
+            if fleet.home_of(pa) == victim:
+                problems.append("prefix shard did not re-home off the "
+                                "killed replica")
+            hb = fleet.submit(pa, spb, rng=kb)
+            if hb.replica == victim:
+                problems.append("post-kill submit routed to the dead "
+                                "replica")
+            out_b = hb.result(timeout=120)
+            ref_b = np.asarray(jax.jit(make_generate_fn(
+                model, spb.max_new_tokens, max_len=max_len))(
+                params, jnp.asarray(pa[None]), kb))[0]
+            if not np.array_equal(out_b, ref_b):
+                problems.append("re-homed stream diverged from "
+                                "standalone generate()")
+            fleet.revive_replica(victim)
+            out_d = fleet.submit(
+                pa, SamplingParams(max_new_tokens=4)).result(timeout=120)
+            if not len(out_d) > 0:
+                problems.append("revived replica served nothing")
+        fleet.emit_snapshot()
+        fleet.emit_snapshot()
+
+    # replay the fleet's own log: strict validation + the
+    # replica-failure stream must degrade AND recover (rc 0); the rule
+    # spec is the fleet SLO (queue ceiling) — process-growth rules
+    # don't apply to a log whose snapshots straddle jit compiles
+    rc, out = _run_cli("tools.dpxmon",
+                       ["replay", log, "--rules",
+                        "fleet.max_queue_depth<=64"])
+    if rc != 0:
+        problems.append(f"dpxmon replay over the fleet log exited "
+                        f"{rc}: {out.strip()[-200:]}")
+
+    if problems:
+        print(json.dumps({"bench": "serve_fleet",
+                          "error": "; ".join(problems)}))
+        return 1
+    shutil.rmtree(workdir, ignore_errors=True)
+    print(json.dumps({"bench": "serve_fleet", "fleet_smoke_gates": {
+        "engine_matches_generate": True,
+        "matches_single_replica": True,
+        "route_affinity_hit_rate": round(hit, 4),
+        "spills": rep2["fleet"]["spills"],
+        "kill_typed_attributed": True,
+        "coresident_bit_exact": True,
+        "dpxmon_replay_rc": rc}}))
+    return 0
+
+
 def main(argv):
+    if "--fleet-smoke" in argv:
+        return fleet_smoke(argv)
     smoke = "--smoke" in argv
 
     def flag(name, default):
@@ -722,6 +944,61 @@ def main(argv):
             "token_divergence": round(div, 4),
             "decode_compiles": 1}
 
+    # ---- multi-replica fleet arm (serve/fleet/) ----
+    # the shared-prefix population through the prefix-affine fleet at
+    # R=1, 2, 4 replicas on the SAME seeded Poisson arrivals: tokens/s
+    # and TTFT p50/p99 as gated medians per R, the scaling ratios
+    # printed-or-withheld per the spread gate. On one CPU host the
+    # replicas contend for the same cores, so a flat/withheld ratio is
+    # the honest outcome; the record is the methodology rail for a
+    # real multi-host run. Smoke runs skip this arm — the dedicated
+    # --fleet-smoke CI step owns the fleet correctness gates.
+    rec_f = None
+    if not smoke:
+        fleet_rs = (1, 2, 4)
+        rec_f = pbrecord.make_record("serve_fleet_tokens_per_sec",
+                                     "tokens_per_sec",
+                                     device="cpu-loopback")
+        rec_f.update({"bench": "serve_fleet", "smoke": smoke,
+                      "config": dict(rec["config"], page_len=page_len,
+                                     fleet_replicas=list(fleet_rs)),
+                      "arms": {}})
+        fleet_sts = {}
+        fkeys = ("tokens_per_sec", "ttft_ms_p50", "ttft_ms_p99")
+        for r in fleet_rs:
+            rep_r, sts_r = measured_stats(
+                lambda r=r: run_fleet(model, params, shared_reqs, r,
+                                      n_slots, max_len, rate=rate,
+                                      seed=seed + 4,
+                                      page_len=page_len)[0],
+                fkeys, warmup=warmup, trials=trials, absent_as_zero=())
+            rec_f["arms"][f"fleet_r{r}_open"] = rep_r
+            fleet_sts[r] = sts_r
+            for k in fkeys:
+                rec_f["metrics"][f"serve_fleet_r{r}_{k}"] = \
+                    pbrecord.make_metric(
+                        None,
+                        "tokens_per_sec" if k == "tokens_per_sec"
+                        else "ms", stats=sts_r[k],
+                        direction="higher" if k == "tokens_per_sec"
+                        else "lower")
+        top = fleet_sts[fleet_rs[-1]]["tokens_per_sec"]
+        rec_f["value"] = round(top.median, 2)
+        rec_f["provenance"] = "measured"
+        rec_f["trusted"] = top.trusted
+        if top.trusted:
+            rec_f.pop("untrusted_reason", None)
+        else:
+            rec_f["untrusted_reason"] = top.untrusted_reason
+        for r in fleet_rs[1:]:
+            vs, why = pbstats.gated_ratio(
+                fleet_sts[r]["tokens_per_sec"],
+                fleet_sts[1]["tokens_per_sec"])
+            if vs is not None:
+                rec_f[f"vs_single_replica_r{r}_x"] = round(vs, 2)
+            else:
+                rec_f[f"vs_single_replica_r{r}_x_withheld"] = why
+
     issues = pbrecord.validate_record(rec, strict=False)
     if issues:
         rec["schema_issues"] = issues
@@ -740,6 +1017,14 @@ def main(argv):
         print(f"# WARNING: kvq record failed schema self-validation: "
               f"{'; '.join(issues[:3])}", file=sys.stderr)
     print(json.dumps(rec_q))
+    if rec_f is not None:
+        issues = pbrecord.validate_record(rec_f, strict=False)
+        if issues:
+            rec_f["schema_issues"] = issues
+            print(f"# WARNING: fleet record failed schema "
+                  f"self-validation: {'; '.join(issues[:3])}",
+                  file=sys.stderr)
+        print(json.dumps(rec_f))
     if not smoke and dpxenv.get("DPX_BENCH_SELFLOG"):
         # real (non-CI) runs land in the trajectory store so the
         # shared-prefix TTFT numbers join the BENCH record trail
@@ -748,6 +1033,8 @@ def main(argv):
         pbrecord.append_row(store, "serve_shared", rec)
         pbrecord.append_row(store, "serve_disagg", rec_d)
         pbrecord.append_row(store, "serve_kvq", rec_q)
+        if rec_f is not None:
+            pbrecord.append_row(store, "serve_fleet", rec_f)
     return 0
 
 

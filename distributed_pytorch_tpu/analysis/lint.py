@@ -120,6 +120,7 @@ TYPED_ERRORS: Dict[str, Tuple[str, ...]] = {
     "HandoffTimeout": ("request_id", "iteration", "engine",
                        "deadline_ms"),
     "HandoffCorrupt": ("request_id", "iteration", "engine", "page"),
+    "ReplicaFailed": ("request_id", "iteration", "replica"),
     "WorkerFailure": ("rank", "exitcode", "op", "kind"),
 }
 

@@ -64,13 +64,21 @@ KNOWN_EVENTS = frozenset({
     # dpxmon live monitoring (obs/metrics.py + obs/health.py): per-rank
     # registry snapshots and the SLO state machine's transitions
     "metrics_snapshot", "health_transition",
+    # multi-replica fleet (serve/fleet/): one fleet_route per routed
+    # request (home/replica/spilled attributed), one fleet_spill per
+    # back-pressure spill (from/to replica), one fleet_scale per
+    # scaling decision (add/drain/revive, rule attributed), and the
+    # replica lifecycle edges — replica_failed is failure-shaped
+    # (rank = replica id) and degrades that replica's health stream
+    "fleet_route", "fleet_spill", "fleet_scale", "replica_drained",
+    "replica_failed",
 })
 
 #: Failure-shaped events that MUST carry rank attribution — a failure
 #: record that cannot say which rank it came from is ungreppable in a
 #: multi-writer stream.
 FAILURE_EVENTS = frozenset({"worker_failure", "comm_schedule",
-                            "flight_recorder"})
+                            "flight_recorder", "replica_failed"})
 
 
 def read_log(path: str) -> Tuple[List[Dict[str, Any]],

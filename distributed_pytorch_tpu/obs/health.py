@@ -39,10 +39,12 @@ is the worst stream state; every overall transition is returned AND
 ``critical`` verdict always says WHICH rule on WHICH rank fired.
 
 Failure events feed the same machine: ``worker_failure`` /
-``elastic_worker_exit`` degrade the named rank's stream immediately
-(the built-in ``worker-failure`` pseudo-rule; any later snapshot from
-that rank counts as a clean evaluation, so an elastic recovery shows
-as degraded → ok), and ``elastic_giveup`` is critical outright.
+``elastic_worker_exit`` / ``replica_failed`` degrade the named rank's
+stream immediately (the built-in ``worker-failure`` pseudo-rule; any
+later snapshot from that rank — or, for fleet replicas, any fleet
+snapshot naming the replica live in its ``replicas`` field — counts as
+a clean evaluation, so an elastic or replica recovery shows as
+degraded → ok), and ``elastic_giveup`` is critical outright.
 
 Stdlib-only with lazy imports (the ``analysis/lint.py`` contract) —
 ``tools/dpxmon.py`` loads this in a bare venv.
@@ -310,7 +312,20 @@ class HealthMonitor:
                 for key in ((FAILURE_RULE, rank), (FAILURE_RULE, None)):
                     if key in self._streams:
                         self._clear(self._streams[key])
-        elif ev in ("worker_failure", "elastic_worker_exit"):
+                # a fleet snapshot names its live replica set
+                # (serve/fleet/router.py): each named replica is a
+                # clean observation for ITS failure stream — the
+                # replica_failed events key on rank = replica id, so a
+                # revived replica shows as degraded → ok with replica
+                # attribution
+                reps = rec.get("replicas")
+                if isinstance(reps, (list, tuple)):
+                    for r in reps:
+                        key = (FAILURE_RULE, r)
+                        if key in self._streams:
+                            self._clear(self._streams[key])
+        elif ev in ("worker_failure", "elastic_worker_exit",
+                    "replica_failed"):
             s = self._stream(FAILURE_RULE, rec.get("rank"))
             s.breaches = max(s.breaches, self.degrade_after)
             s.total_breaches += 1

@@ -78,7 +78,7 @@ RETRY_MAX_ENV = "DPX_RETRY_MAX"
 #: base * 2^(k-1) ms before re-entering.
 RETRY_BACKOFF_ENV = "DPX_RETRY_BACKOFF_MS"
 
-LEGS = ("train", "train_shrink", "serve", "transport")
+LEGS = ("train", "train_shrink", "serve", "transport", "fleet")
 EXPECTS = ("typed_error", "retry_recover", "elastic_resume")
 
 

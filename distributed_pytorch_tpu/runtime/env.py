@@ -427,6 +427,36 @@ register("DPX_HANDOFF_TIMEOUT_MS", "int", 30_000,
          "decode pool; past it the request fails as a typed "
          "`HandoffTimeout` instead of waiting forever on a wedged "
          "prefill engine or transport (0 disables).")
+register("DPX_FLEET_REPLICAS", "int", 2,
+         "Default replica count of the multi-replica serving fleet "
+         "(serve/fleet/FleetRouter; FleetConfig(n_replicas=) "
+         "overrides — docs/serving.md \"Multi-replica fleet\").")
+register("DPX_FLEET_SPILL_QUEUE", "int", 4,
+         "Home-replica queue depth at which the fleet router "
+         "proactively spills a request to the least-loaded replica "
+         "instead of queueing behind known back-pressure (reactive "
+         "spill on `queue_full`/`no_free_pages` rejection happens "
+         "regardless; each spill is a from/to-attributed fleet_spill "
+         "event).")
+register("DPX_FLEET_MIN_REPLICAS", "int", 1,
+         "Elasticity floor of the fleet autoscaler — sustained-ok "
+         "drains never shrink the fleet below this many live replicas "
+         "(serve/fleet/autoscale.py).")
+register("DPX_FLEET_MAX_REPLICAS", "int", 4,
+         "Elasticity ceiling of the fleet autoscaler — SLO-degraded "
+         "scale-outs never grow the fleet past this many live "
+         "replicas.")
+register("DPX_FLEET_SCALE_RULES", "str", "",
+         "SLO rule spec the fleet autoscaler evaluates (the "
+         "obs/health.py rule grammar, e.g. "
+         "`serve.ttft_ms.p99<=500;fleet.max_queue_depth<=8`); empty = "
+         "serve/fleet/autoscale.py DEFAULT_FLEET_RULES (TTFT p99 "
+         "ceiling + worst per-replica queue depth).")
+register("DPX_FLEET_DRAIN_AFTER_OK", "int", 8,
+         "Consecutive ok autoscaler evaluations required before a "
+         "sustained-ok drain retires a replica — the scale-in half of "
+         "the hysteresis (scale-out reacts on the first degraded "
+         "verdict).")
 
 # -- torch front door / benches --------------------------------------------
 register("DPX_WEIGHT_UPDATE", "str", "replicated",

@@ -151,7 +151,8 @@ COMM_OPS = ("init",
             "reduce_scatter", "allgather", "hier_reduce", "hier_gather",
             "reduce", "gather", "broadcast", "barrier",
             "ckpt", "ckpt_commit", "ckpt_commit_window", "serve_step",
-            "page_admit", "page_evict", "handoff_send", "handoff_recv")
+            "page_admit", "page_evict", "handoff_send", "handoff_recv",
+            "fleet_submit")
 
 _extra_ops: set = set()
 

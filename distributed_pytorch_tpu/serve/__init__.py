@@ -14,6 +14,8 @@ loop and threaded front door (``engine``), and per-request SLO metrics
 from .cache import CompileCounts, SlotPool  # noqa: F401
 from .disagg import DisaggConfig, DisaggEngine  # noqa: F401
 from .engine import EngineConfig, InferenceEngine  # noqa: F401
+from .fleet import (FleetAutoscaler, FleetConfig, FleetHandle,  # noqa: F401
+                    FleetRouter, ReplicaFailed)
 from .metrics import aggregate, percentile, request_record  # noqa: F401
 from .pages import PagedSlotPool, PagePool, PrefixIndex  # noqa: F401
 from .scheduler import AdmissionScheduler  # noqa: F401
@@ -26,9 +28,10 @@ from .types import (AdmissionRejected, EngineStopped,  # noqa: F401
 __all__ = [
     "AdmissionRejected", "AdmissionScheduler", "CompileCounts",
     "DisaggConfig", "DisaggEngine", "EngineConfig", "EngineStopped",
+    "FleetAutoscaler", "FleetConfig", "FleetHandle", "FleetRouter",
     "HandoffCorrupt", "HandoffError", "HandoffTimeout",
     "InferenceEngine", "PagePool", "PagePoolExhausted", "PagedSlotPool",
-    "PrefillEngineDied", "PrefixIndex", "Request",
+    "PrefillEngineDied", "PrefixIndex", "ReplicaFailed", "Request",
     "RequestDeadlineExceeded", "RequestHandle", "SamplingParams",
     "ServeError", "SlotPool", "aggregate", "percentile", "request_record",
 ]

@@ -297,6 +297,26 @@ class InferenceEngine:
             self._thread.join()
             self._thread = None
 
+    def crash(self, exc: Exception, wait: bool = True) -> None:
+        """Hard-stop the engine AS IF its loop crashed with ``exc``:
+        every in-flight request fails a typed ``EngineStopped`` with
+        ``exc`` chained as the cause — exactly the real crash-drain
+        path. This is the chaos seam the fleet router's replica kill
+        (``serve/fleet/router.py``) rides; an orderly stop is
+        :meth:`shutdown`."""
+        with self._cond:
+            self._crash = exc
+            self._stop = True
+            self._cond.notify_all()
+        if wait:
+            t = self._thread
+            if t is not None:
+                t.join(timeout=60.0)
+                self._thread = None
+            else:
+                # never started: no loop exists to run the drain
+                self._drain_on_stop()
+
     def __enter__(self) -> "InferenceEngine":
         return self.start()
 

@@ -1,0 +1,134 @@
+"""Fleet-level types: the fleet configuration, the replica lifecycle,
+the typed replica-failure error, and the caller's fleet request handle.
+
+The fleet keeps the PR 3 engine contract — ``submit() -> future +
+streaming tokens`` — while adding one new failure mode: a REPLICA can
+die with requests in flight. That failure is typed and attributed
+(:class:`ReplicaFailed` carries ``replica``) exactly the way
+``HandoffError`` carries ``engine``: a supervisor must know WHICH
+replica to relaunch, and that no other replica's streams were touched
+(docs/serving.md "Multi-replica fleet").
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Optional
+
+from ..engine import EngineConfig
+from ..types import EngineStopped, ServeError
+
+#: Replica lifecycle states. ``live`` admits traffic; ``draining``
+#: finishes in-flight requests but admits nothing new (placement
+#: excludes it, re-homing its prefix shard); ``failed`` died with
+#: requests in flight (revivable under the same id); ``retired``
+#: drained cleanly and released its pages.
+REPLICA_LIVE = "live"
+REPLICA_DRAINING = "draining"
+REPLICA_FAILED = "failed"
+REPLICA_RETIRED = "retired"
+
+
+class ReplicaFailed(ServeError):
+    """A fleet replica died (crash, injected kill) with this request in
+    flight on it. Carries ``replica`` — the failed replica's id — so
+    the failure is attributable: ONLY that replica's in-flight requests
+    raise this, co-resident streams on other replicas complete
+    bit-exact, and the supervisor knows which slot to relaunch. The
+    engine-level ``EngineStopped`` (with the crash cause) is chained as
+    ``__cause__``."""
+
+    def __init__(self, msg: str, *, replica: int = -1, **kw):
+        super().__init__(msg, **kw)
+        self.replica = replica
+
+
+@dataclass
+class FleetConfig:
+    """Fleet shape and routing policy. Every ``None`` knob defaults
+    from the typed env registry (``DPX_FLEET_*`` — docs/env_vars.md).
+
+    ``engine`` is the per-replica :class:`~..engine.EngineConfig`,
+    reused UNCHANGED — a fleet of monolithic-paged engines and a fleet
+    of quantized-pool engines differ only in this field. ``spill_queue``
+    is the home-replica queue depth at which a request proactively
+    spills to the least-loaded replica instead of queueing behind the
+    back-pressure (reactive spill on ``queue_full`` / ``no_free_pages``
+    rejection happens regardless)."""
+
+    n_replicas: Optional[int] = None     # DPX_FLEET_REPLICAS
+    engine: Optional[EngineConfig] = None
+    spill_queue: Optional[int] = None    # DPX_FLEET_SPILL_QUEUE
+    metrics: Optional[object] = None     # MetricsLogger for fleet events
+    log_every: int = 8                   # routes between snapshots
+
+
+@dataclass
+class Replica:
+    """One replica slot: a stable integer id (the ``rank`` every fleet
+    event and health stream is keyed on — stable ACROSS relaunches, the
+    ``runtime/elastic.py`` discipline), the engine currently serving
+    it, its lifecycle state, and the relaunch attempt counter."""
+
+    rid: int
+    engine: object                       # InferenceEngine
+    state: str = REPLICA_LIVE
+    attempt: int = 0                     # relaunches (elastic idiom)
+
+
+class FleetHandle:
+    """The caller's fleet-level view of a submitted request — the same
+    contract as the engine's ``RequestHandle`` (a future for the final
+    token array, the streamed ``tokens`` list, completion metrics) plus
+    ``replica``: which replica served it.
+
+    Failure translation happens HERE, exactly once: the inner engine
+    future resolves exactly once, and its done-callback resolves this
+    future exactly once — so the double-resolve gate holds across a
+    replica failover. An ``EngineStopped`` from a replica the router
+    marked FAILED becomes a :class:`ReplicaFailed` (replica + request
+    attributed, cause chained); an ``EngineStopped`` from an orderly
+    fleet shutdown passes through untranslated (the caller asked for
+    it — there is no replica to blame)."""
+
+    def __init__(self, request_id: int, replica: Replica, inner):
+        self.request_id = request_id      # fleet-level id
+        self.replica = replica.rid
+        self._replica = replica
+        self.inner = inner                # engine RequestHandle
+        # the ONE streamed token list, aliased through the engine handle
+        self.tokens = inner.tokens
+        self.future: Future = Future()
+        inner.future.add_done_callback(self._resolve)
+
+    @property
+    def state(self) -> str:
+        return self.inner.state
+
+    @property
+    def metrics(self) -> dict:
+        return self.inner.metrics
+
+    def _resolve(self, fut: Future) -> None:
+        exc = fut.exception()
+        if exc is None:
+            self.future.set_result(fut.result())
+            return
+        if (isinstance(exc, EngineStopped)
+                and self._replica.state == REPLICA_FAILED):
+            typed = ReplicaFailed(
+                f"replica {self.replica} failed with request "
+                f"{self.request_id} in flight "
+                f"({len(self.tokens)} token(s) streamed)",
+                replica=self.replica, request_id=self.request_id,
+                iteration=exc.iteration)
+            typed.__cause__ = exc
+            exc = typed
+        self.future.set_exception(exc)
+
+    def result(self, timeout: Optional[float] = None):
+        """Block for the final (n_tokens,) int32 array; raises the
+        request's typed ``ServeError`` — :class:`ReplicaFailed` when
+        the serving replica died mid-flight."""
+        return self.future.result(timeout)

@@ -1,0 +1,392 @@
+"""Multi-replica fleet (serve/fleet/) — the acceptance suite.
+
+The headline contracts: a fleet of R replicas serves a shared-prefix
+mix with every stream bit-identical to a standalone ``generate()``
+call (routing never changes tokens); capacity back-pressure spills
+typed and attributed, and a fully-exhausted fleet rejects
+synchronously with ``reason="fleet_exhausted"``; draining finishes
+in-flight streams bit-exact and re-homes the prefix shard; killing a
+replica fails ONLY its in-flight requests as replica-attributed
+``ReplicaFailed`` (double-resolve safe) while the fleet HealthMonitor
+verdict runs degraded → recovered with rule+replica attribution.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu import models
+from distributed_pytorch_tpu.models.generate import make_generate_fn
+from distributed_pytorch_tpu.obs import export as dpxexport
+from distributed_pytorch_tpu.obs import health as dpxhealth
+from distributed_pytorch_tpu.obs import metrics as dpxmon
+from distributed_pytorch_tpu.runtime import faults
+from distributed_pytorch_tpu.serve import (AdmissionRejected, EngineConfig,
+                                           SamplingParams)
+from distributed_pytorch_tpu.serve.fleet import (REPLICA_RETIRED,
+                                                 AutoscaleConfig,
+                                                 FleetAutoscaler,
+                                                 FleetConfig, FleetRouter,
+                                                 ReplicaFailed, placement)
+from distributed_pytorch_tpu.utils.logging import MetricsLogger
+
+MAX_LEN = 64
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    faults.reset()
+    dpxmon.reset()
+    yield
+    faults.reset()
+    dpxmon.reset()
+
+
+def _lm(**kw):
+    kw.setdefault("vocab", 61)
+    kw.setdefault("dim", 32)
+    kw.setdefault("n_layers", 1)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("n_kv_heads", 2)
+    kw.setdefault("pos", "rope")
+    kw.setdefault("max_seq", 128)
+    return models.TransformerLM(**kw)
+
+
+def _standalone(model, params, prompt, sp, key, max_len=MAX_LEN):
+    fn = make_generate_fn(model, sp.max_new_tokens,
+                          temperature=sp.temperature, top_k=sp.top_k,
+                          top_p=sp.top_p, max_len=max_len)
+    return np.asarray(jax.jit(fn)(params, jnp.asarray(
+        np.asarray(prompt, np.int32)[None]), key))[0]
+
+
+def _events(path, name):
+    out = []
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("event") == name:
+                out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# placement (pure, no engines)
+# ---------------------------------------------------------------------------
+
+
+class TestPlacement:
+    def test_prefix_key_is_first_full_page(self):
+        toks = np.arange(40)
+        assert placement.prefix_key(toks, 16) \
+            == np.asarray(toks[:16], np.int32).tobytes()
+        short = np.arange(5)
+        assert placement.prefix_key(short, 16) \
+            == np.asarray(short, np.int32).tobytes()
+
+    def test_rendezvous_minimal_disruption(self):
+        """HRW's operational property: removing one replica re-homes
+        ONLY the keys that homed there — every other key's placement
+        (and its warm prefix pages) is untouched."""
+        keys = [placement.prefix_key(np.arange(16) + i, 16)
+                for i in range(64)]
+        before = {k: placement.rendezvous(k, [0, 1, 2]) for k in keys}
+        assert len(set(before.values())) > 1   # spread over replicas
+        after = {k: placement.rendezvous(k, [0, 2]) for k in keys}
+        for k in keys:
+            if before[k] != 1:
+                assert after[k] == before[k]
+            else:
+                assert after[k] in (0, 2)
+
+    def test_spill_order_prefers_home_until_backpressure(self):
+        key = b"k"
+        loads = {0: (0, 0.0), 1: (2, 0.0)}
+        assert placement.spill_order(key, 0, loads, 4)[0] == 0
+        # home at/past the spill threshold with a lighter peer: proactive
+        assert placement.spill_order(key, 1, {0: (0, 0.0), 1: (4, 0.0)},
+                                     4)[0] == 0
+        # every peer just as loaded: stay home
+        assert placement.spill_order(key, 1, {0: (4, 0.0), 1: (4, 0.0)},
+                                     4)[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# routing: bit-exactness, affinity, spill, exhaustion
+# ---------------------------------------------------------------------------
+
+
+class TestFleetRouting:
+    def test_shared_prefix_mix_bit_exact_with_affinity(self, tmp_path):
+        """R=2 paged fleet over a shared-prefix mix: every stream is
+        bit-identical to standalone generate() with the fleet rng key,
+        regardless of which replica served it; affinity hit rate > 0;
+        every route is a logged, attributed fleet_route event."""
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        log = str(tmp_path / "fleet.jsonl")
+        cfg = FleetConfig(
+            n_replicas=2, metrics=MetricsLogger(log),
+            engine=EngineConfig(n_slots=2, max_len=MAX_LEN, paged=True))
+        fleet = FleetRouter(model, params, cfg)
+        sp = SamplingParams(max_new_tokens=8)
+        prefix = np.arange(16) % 61
+        prompts = [np.concatenate([prefix, [i + 1, i + 2]])
+                   for i in range(4)]
+        prompts += [(np.arange(18) + 7 * i) % 61 for i in range(3)]
+        with fleet:
+            handles = [fleet.submit(p, sp) for p in prompts]
+            outs = [h.result(timeout=120) for h in handles]
+            st = fleet.stats()
+        assert st["completed"] == len(prompts)
+        assert st["route_affinity_hit_rate"] > 0
+        for p, h, out in zip(prompts, handles, outs):
+            ref = _standalone(model, params, p, sp,
+                              jax.random.PRNGKey(h.request_id))
+            assert np.array_equal(out, ref)
+        routes = _events(log, "fleet_route")
+        assert len(routes) == len(prompts)
+        assert all({"request_id", "replica", "home", "spilled"}
+                   <= set(r) for r in routes)
+        served = {r["replica"] for r in routes}
+        assert served <= {0, 1}
+
+    def test_spill_then_fleet_exhausted_typed(self, tmp_path):
+        """Deterministic back-pressure (engines never started, so
+        queues only fill): the home replica's queue_full rejection
+        spills — typed, from/to-attributed — and once EVERY replica is
+        full the next submit fails synchronously with
+        reason="fleet_exhausted" and the last rejection chained."""
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        log = str(tmp_path / "fleet.jsonl")
+        cfg = FleetConfig(
+            n_replicas=2, metrics=MetricsLogger(log), spill_queue=99,
+            engine=EngineConfig(n_slots=1, max_len=MAX_LEN, max_queue=2))
+        fleet = FleetRouter(model, params, cfg)   # NOT started
+        sp = SamplingParams(max_new_tokens=4)
+        prompt = np.arange(12) % 61
+        handles = [fleet.submit(prompt, sp) for _ in range(4)]
+        with pytest.raises(AdmissionRejected) as ei:
+            fleet.submit(prompt, sp)
+        assert ei.value.reason == "fleet_exhausted"
+        assert ei.value.request_id == 4
+        assert isinstance(ei.value.__cause__, AdmissionRejected)
+        assert ei.value.__cause__.reason == "queue_full"
+        spills = _events(log, "fleet_spill")
+        assert len(spills) == 2   # requests 2,3 overflowed to the peer
+        home = fleet.home_of(prompt)
+        assert all(s["from_replica"] == home
+                   and s["to_replica"] != home for s in spills)
+        assert fleet.stats()["spills"] == 2
+        # the queued work is real: start the fleet and finish it all
+        with fleet:
+            outs = [h.result(timeout=120) for h in handles]
+        assert all(len(o) == 4 for o in outs)
+
+    def test_deterministic_rejection_does_not_walk(self):
+        """A prompt every replica must reject identically (too long)
+        surfaces as its own typed reason, not fleet_exhausted."""
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        fleet = FleetRouter(model, params, FleetConfig(
+            n_replicas=2, engine=EngineConfig(n_slots=1,
+                                              max_len=MAX_LEN)))
+        with pytest.raises(AdmissionRejected) as ei:
+            fleet.submit(np.arange(MAX_LEN) % 61,
+                         SamplingParams(max_new_tokens=8))
+        assert ei.value.reason == "too_long"
+
+
+# ---------------------------------------------------------------------------
+# drain: finish in-flight, re-home the shard
+# ---------------------------------------------------------------------------
+
+
+class TestDrain:
+    def test_drain_while_streaming_bit_exact_and_rehomes(self, tmp_path):
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        log = str(tmp_path / "fleet.jsonl")
+        fleet = FleetRouter(model, params, FleetConfig(
+            n_replicas=2, metrics=MetricsLogger(log),
+            engine=EngineConfig(n_slots=2, max_len=MAX_LEN)))
+        sp = SamplingParams(max_new_tokens=24)
+        prompt = np.arange(14) % 61
+        with fleet:
+            victim = fleet.home_of(prompt)
+            h = fleet.submit(prompt, sp)
+            while not h.tokens:           # mid-stream, provably
+                time.sleep(0.005)
+            assert fleet.drain_replica(victim, rule="sustained_ok")
+            # never killed mid-stream: the stream finished, bit-exact
+            out = h.result(timeout=120)
+            ref = _standalone(model, params, prompt, sp,
+                              jax.random.PRNGKey(h.request_id))
+            assert np.array_equal(out, ref)
+            assert fleet.stats()["replicas"][victim]["state"] \
+                == REPLICA_RETIRED
+            # prefix re-homing: the same prompt now homes elsewhere,
+            # and serving still works
+            new_home = fleet.home_of(prompt)
+            assert new_home is not None and new_home != victim
+            h2 = fleet.submit(prompt, sp)
+            assert h2.replica == new_home
+            assert np.array_equal(
+                h2.result(timeout=120),
+                _standalone(model, params, prompt, sp,
+                            jax.random.PRNGKey(h2.request_id)))
+        drained = _events(log, "replica_drained")
+        assert len(drained) == 1 and drained[0]["rank"] == victim
+        assert any(r["action"] == "drain" and r["replica"] == victim
+                   for r in _events(log, "fleet_scale"))
+
+    def test_drain_last_live_replica_refused(self):
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        fleet = FleetRouter(model, params, FleetConfig(
+            n_replicas=1, engine=EngineConfig(n_slots=1,
+                                              max_len=MAX_LEN)))
+        with pytest.raises(ValueError, match="last live"):
+            fleet.drain_replica(0)
+
+
+# ---------------------------------------------------------------------------
+# failure isolation: the fleet-kill headline
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaFailure:
+    def test_kill_isolates_and_health_recovers(self, tmp_path):
+        """Killing one replica fails ONLY its in-flight requests —
+        typed ReplicaFailed, replica + request attributed, double-
+        resolve safe — while co-resident streams on the survivor
+        complete bit-exact, the shard re-homes, and the fleet
+        HealthMonitor (fed the fleet's own event log) runs
+        degraded → recovered keyed on the victim replica."""
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        log = str(tmp_path / "fleet.jsonl")
+        fleet = FleetRouter(model, params, FleetConfig(
+            n_replicas=2, metrics=MetricsLogger(log),
+            engine=EngineConfig(n_slots=2, max_len=MAX_LEN)))
+        sp = SamplingParams(max_new_tokens=48)
+        pa = np.arange(14) % 61
+        with fleet:
+            victim = fleet.home_of(pa)
+            pb = pa
+            for s in range(1, 400):       # a prompt homed elsewhere
+                pb = (np.arange(14) + s) % 61
+                if fleet.home_of(pb) != victim:
+                    break
+            ha = fleet.submit(pa, sp)
+            hb = fleet.submit(pb, sp)
+            while not ha.tokens:
+                time.sleep(0.005)
+            fleet.kill_replica(victim)
+            with pytest.raises(ReplicaFailed) as ei:
+                ha.result(timeout=60)
+            assert ei.value.replica == victim
+            assert ei.value.request_id == ha.request_id
+            assert ei.value.__cause__ is not None
+            # double-resolve gate across the failover: same typed
+            # failure again, never a second resolution
+            with pytest.raises(ReplicaFailed):
+                ha.result(timeout=1)
+            # only the victim's requests failed: the co-resident
+            # stream completes bit-exact
+            out_b = hb.result(timeout=120)
+            assert np.array_equal(
+                out_b, _standalone(model, params, pb, sp,
+                                   jax.random.PRNGKey(hb.request_id)))
+            # the victim's shard re-homed over the survivor
+            assert fleet.home_of(pa) != victim
+            # relaunch under the SAME id (elastic discipline), then a
+            # fleet snapshot names it live again
+            fleet.revive_replica(victim)
+            h3 = fleet.submit(pa, sp)
+            assert np.array_equal(
+                h3.result(timeout=120),
+                _standalone(model, params, pa, sp,
+                            jax.random.PRNGKey(h3.request_id)))
+            fleet.emit_snapshot()
+            fleet.emit_snapshot()
+        failed = _events(log, "replica_failed")
+        assert len(failed) == 1 and failed[0]["rank"] == victim
+        # the fleet's own log drives the monitor degraded → recovered
+        # with replica attribution
+        records, bad = dpxexport.read_log(log)
+        assert not bad
+        mon = dpxhealth.scan_records(
+            records, dpxhealth.HealthMonitor(
+                dpxhealth.parse_rules("fleet.max_queue_depth<=9999")))
+        trs = [(t["from"], t["to"], t["rule"], t["rank"])
+               for t in mon.transitions]
+        assert ("ok", "degraded", dpxhealth.FAILURE_RULE, victim) in trs
+        assert mon.state == dpxhealth.OK
+        assert trs[-1][1] == dpxhealth.OK
+
+    def test_fleet_log_is_valid_vocabulary(self, tmp_path):
+        """Every fleet event passes the strict dpxtrace vocabulary
+        check (KNOWN_EVENTS registration + rank-attributed failures)."""
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        log = str(tmp_path / "fleet.jsonl")
+        fleet = FleetRouter(model, params, FleetConfig(
+            n_replicas=2, metrics=MetricsLogger(log),
+            engine=EngineConfig(n_slots=1, max_len=MAX_LEN)))
+        with fleet:
+            h = fleet.submit(np.arange(10) % 61,
+                             SamplingParams(max_new_tokens=4))
+            h.result(timeout=120)
+            fleet.kill_replica(1 - h.replica, reason="test")
+            fleet.emit_snapshot()
+        issues = dpxexport.check_log(*dpxexport.read_log(log))
+        assert issues == [], issues
+
+
+# ---------------------------------------------------------------------------
+# SLO-driven elasticity
+# ---------------------------------------------------------------------------
+
+
+class TestAutoscaler:
+    def test_add_on_degraded_drain_on_sustained_ok(self, tmp_path):
+        """A TTFT-p99 breach adds a replica (rule-attributed); a
+        sustained-ok streak drains the youngest back down — the whole
+        loop driven through injected snapshots, engines never started
+        (the policy is what's under test, not the engines)."""
+        model = _lm()
+        params = model.init(jax.random.PRNGKey(0))
+        log = str(tmp_path / "fleet.jsonl")
+        fleet = FleetRouter(model, params, FleetConfig(
+            n_replicas=1, metrics=MetricsLogger(log),
+            engine=EngineConfig(n_slots=1, max_len=MAX_LEN)))
+        scaler = FleetAutoscaler(fleet, AutoscaleConfig(
+            min_replicas=1, max_replicas=2,
+            rules="serve.ttft_ms.p99<=500", drain_after_ok=3))
+        bad = {"serve.ttft_ms": {"p99": 4000.0}}
+        good = {"serve.ttft_ms": {"p99": 20.0}}
+        d = scaler.step(bad)
+        assert d == {"action": "add", "replica": 1,
+                     "rule": "serve.ttft_ms.p99<=500",
+                     "state": dpxhealth.DEGRADED}
+        assert len(fleet._admitting()) == 2
+        assert scaler.step(bad) is None     # already at max
+        drains = []
+        for _ in range(8):
+            d = scaler.step(good)
+            if d:
+                drains.append(d)
+        assert drains == [{"action": "drain", "replica": 1,
+                           "rule": "sustained_ok",
+                           "state": dpxhealth.OK}]
+        assert len(fleet._admitting()) == 1
+        scale = _events(log, "fleet_scale")
+        assert [r["action"] for r in scale] == ["add", "drain"]
+        assert all("rule" in r and "replica" in r for r in scale)
