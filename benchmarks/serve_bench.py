@@ -54,6 +54,18 @@ math), with TTFT p50/p99 gated medians, occupancy/hit-rate/evictions,
 and the token-divergence fraction vs the exact pool; non-smoke runs
 append stage ``serve_kvq``.
 
+The **speculative decoding arm** (serve/spec/, docs/serving.md
+"Speculative decoding") runs the mixed greedy population closed-loop
+through the paged engine with a draft model proposing ``--draft-len``
+tokens per iteration vs the SAME engine non-spec: acceptance rate and
+tokens/iteration are the speculation headline, TPOT p50/p99 ride as
+gated medians, and ``vs_nonspec_tpot_p50_x`` is printed-or-withheld
+per the spread gate. Smoke self-drafts (draft == target) so the gate
+set — accepted streams bit-exact vs ``generate()``, acceptance > 0,
+verify compiles == {draft_len+1: 1}, ``tools/dpxmon.py replay`` rc 0
+over the spec engine's metrics log — is deterministic; non-smoke runs
+use a thin 1-layer draft and append stage ``serve_spec``.
+
 The **fleet arm** (serve/fleet/, docs/serving.md "Multi-replica
 fleet") runs the shared-prefix population through the prefix-affine
 FleetRouter at R=1, 2, 4 replicas on the SAME seeded Poisson arrivals:
@@ -83,7 +95,7 @@ from rotting (tier1.yml).
 Usage: python benchmarks/serve_bench.py [--smoke | --fleet-smoke]
            [--requests N] [--rate R] [--max-new N] [--seed S]
            [--slots N] [--trials N] [--warmup N] [--prefixes K]
-           [--prefix-len N]
+           [--prefix-len N] [--draft-len K]
 """
 
 from __future__ import annotations
@@ -154,16 +166,25 @@ def make_shared_requests(n, vocab, max_new, seed, k_prefixes, prefix_len,
 
 def run_engine(model, params, reqs, n_slots, max_len, rate=None, seed=0,
                paged=False, page_len=None, prefix_share=True,
-               kv_dtype=None):
+               kv_dtype=None, draft_model=None, draft_params=None,
+               draft_len=None, metrics=None, log_every=16):
     """Submit ``reqs`` (closed loop, or Poisson open loop at ``rate``)
-    and aggregate per-request SLO records."""
+    and aggregate per-request SLO records. A non-None ``draft_model``
+    turns on speculative decoding (serve/spec/) and attaches the
+    engine's speculation accounting as ``rep["spec"]``."""
     from distributed_pytorch_tpu.serve import (EngineConfig,
                                                InferenceEngine, aggregate)
     eng = InferenceEngine(model, params,
                           EngineConfig(n_slots=n_slots, max_len=max_len,
                                        paged=paged, page_len=page_len,
                                        prefix_share=prefix_share,
-                                       kv_dtype=kv_dtype))
+                                       kv_dtype=kv_dtype,
+                                       spec_decode=draft_model is not None,
+                                       draft_model=draft_model,
+                                       draft_params=draft_params,
+                                       draft_len=draft_len,
+                                       metrics=metrics,
+                                       log_every=log_every))
     rng = np.random.default_rng(seed)
     handles = []
     t0 = time.monotonic()
@@ -181,6 +202,8 @@ def run_engine(model, params, reqs, n_slots, max_len, rate=None, seed=0,
                              "prefill_compiles", "sample_compiles")}
     if paged:
         rep["pages"] = st["pages"]
+    if draft_model is not None:
+        rep["spec"] = st["spec"]
     return rep, outs
 
 
@@ -944,6 +967,152 @@ def main(argv):
             "token_divergence": round(div, 4),
             "decode_compiles": 1}
 
+    # ---- speculative decoding arm (serve/spec/) ----
+    # the mixed greedy population through the paged engine with a
+    # draft proposing k tokens per iteration vs the SAME engine
+    # non-spec on the SAME closed-loop population: acceptance rate and
+    # tokens/iteration are the speculation headline, TPOT p50/p99 ride
+    # as gated medians, and the TPOT speedup is printed-or-withheld
+    # per the spread gate. Smoke self-drafts (draft == target) so the
+    # wiring/accounting gates are deterministic (acceptance 1.0 by
+    # construction); real runs use a thin 1-layer draft so acceptance
+    # is a measurement, not a tautology.
+    draft_len = flag("--draft-len", 3)
+    if smoke:
+        draft_model, draft_params = model, params
+    else:
+        import jax
+        from distributed_pytorch_tpu import models
+        draft_model = models.TransformerLM(
+            vocab=model.vocab, dim=max(16, model.dim // 4), n_layers=1,
+            n_heads=2, n_kv_heads=1, pos="rope", max_seq=model.max_seq)
+        draft_params = draft_model.init(jax.random.PRNGKey(11))
+    rec_s = pbrecord.make_record("serve_spec_tpot_ms_p50", "ms",
+                                 device="cpu-loopback")
+    rec_s.update({"bench": "serve_spec", "smoke": smoke,
+                  "config": dict(rec["config"], page_len=page_len,
+                                 draft_len=draft_len,
+                                 draft="self" if smoke else "thin-1l"),
+                  "arms": {}})
+    spec_keys = ("tpot_ms_p50", "tpot_ms_p99")
+    first_spec = {}
+
+    def spec_once():
+        rep, souts = run_engine(model, params, mixed, n_slots, max_len,
+                                paged=True, page_len=page_len,
+                                draft_model=draft_model,
+                                draft_params=draft_params,
+                                draft_len=draft_len)
+        first_spec.setdefault("outs", souts)
+        first_spec.setdefault("rep", rep)
+        return rep
+
+    spec_rep, spec_sts = measured_stats(spec_once, spec_keys,
+                                        warmup=warmup, trials=trials,
+                                        absent_as_zero=())
+    rec_s["arms"]["engine_spec_closed"] = spec_rep
+    nonspec_rep, nonspec_sts = measured_stats(
+        lambda: run_engine(model, params, mixed, n_slots, max_len,
+                           paged=True, page_len=page_len)[0],
+        spec_keys, warmup=warmup, trials=trials, absent_as_zero=())
+    rec_s["arms"]["engine_nonspec_closed"] = nonspec_rep
+    for k in spec_keys:
+        rec_s["metrics"][f"serve_spec_{k}"] = pbrecord.make_metric(
+            None, "ms", stats=spec_sts[k], direction="lower")
+        rec_s["metrics"][f"serve_nonspec_{k}"] = pbrecord.make_metric(
+            None, "ms", stats=nonspec_sts[k], direction="lower")
+    sp_st = first_spec["rep"]["spec"]
+    rec_s["acceptance_rate"] = round(sp_st["acceptance_rate"] or 0.0, 4)
+    rec_s["tokens_per_iteration"] = round(
+        sp_st["tokens_per_iteration"] or 0.0, 4)
+    rec_s["metrics"]["serve_spec_acceptance_rate"] = \
+        pbrecord.make_metric(rec_s["acceptance_rate"], "frac")
+    rec_s["metrics"]["serve_spec_tokens_per_iteration"] = \
+        pbrecord.make_metric(rec_s["tokens_per_iteration"], "tokens")
+    rec_s["value"] = round(spec_sts["tpot_ms_p50"].median, 2)
+    rec_s["provenance"] = "measured"
+    rec_s["trusted"] = spec_sts["tpot_ms_p50"].trusted
+    if rec_s["trusted"]:
+        rec_s.pop("untrusted_reason", None)
+    else:
+        rec_s["untrusted_reason"] = \
+            spec_sts["tpot_ms_p50"].untrusted_reason
+    # TPOT is lower-better: > 1 means speculation beats plain decode
+    # on wall-clock cadence, not just on tokens/iteration
+    vs, why = pbstats.gated_ratio(nonspec_sts["tpot_ms_p50"],
+                                  spec_sts["tpot_ms_p50"])
+    if vs is not None:
+        rec_s["vs_nonspec_tpot_p50_x"] = round(vs, 2)
+    else:
+        rec_s["vs_nonspec_tpot_p50_withheld"] = why
+
+    if smoke:
+        # the spec CI gates (tier1.yml): speculation must be invisible
+        # (accepted greedy streams == standalone generate() bit-exact),
+        # must actually accept on this self-draft workload, must keep
+        # the one-verify-program-per-bucket discipline, and the spec
+        # engine's own metrics log (snapshots carrying the serve.spec_*
+        # gauges) must replay clean through tools/dpxmon.py
+        import shutil
+        import tempfile
+
+        import jax
+        import jax.numpy as jnp
+
+        from benchmarks.soak import _run_cli
+        from distributed_pytorch_tpu.models.generate import make_generate_fn
+        from distributed_pytorch_tpu.utils.logging import MetricsLogger
+        problems = []
+        for i in (0, n_req // 2, n_req - 1):
+            prompt, sp_i, key = mixed[i]
+            ref = np.asarray(jax.jit(make_generate_fn(
+                model, sp_i.max_new_tokens, max_len=max_len))(
+                params, jnp.asarray(prompt[None]), key))[0]
+            if not np.array_equal(first_spec["outs"][i], ref):
+                problems.append(f"spec request {i} diverged from "
+                                f"standalone generate()")
+        if not (sp_st["acceptance_rate"] or 0.0) > 0:
+            problems.append(f"acceptance rate "
+                            f"{sp_st['acceptance_rate']} not > 0 "
+                            f"under the self-draft")
+        if sp_st["verify_compiles"] != {draft_len + 1: 1}:
+            problems.append(f"verify compiles "
+                            f"{sp_st['verify_compiles']} != "
+                            f"{{{draft_len + 1}: 1}}")
+        # record-schema gate: the full-size record must land on real
+        # hardware with the speculation fields present and the speedup
+        # ratio either printed or withheld-with-reason — never absent
+        for field in ("acceptance_rate", "tokens_per_iteration"):
+            if field not in rec_s:
+                problems.append(f"spec record missing {field}")
+        if (("vs_nonspec_tpot_p50_x" in rec_s)
+                == ("vs_nonspec_tpot_p50_withheld" in rec_s)):
+            problems.append(
+                "spec record must carry exactly one of "
+                "vs_nonspec_tpot_p50_x / vs_nonspec_tpot_p50_withheld")
+        workdir = tempfile.mkdtemp(prefix="dpx_spec_smoke_")
+        log = os.path.join(workdir, "spec_metrics.jsonl")
+        run_engine(model, params, mixed, n_slots, max_len, paged=True,
+                   page_len=page_len, draft_model=draft_model,
+                   draft_params=draft_params, draft_len=draft_len,
+                   metrics=MetricsLogger(log), log_every=2)
+        rc, out_cli = _run_cli("tools.dpxmon", ["replay", log])
+        if rc != 0:
+            problems.append(f"dpxmon replay over the spec log exited "
+                            f"{rc}: {out_cli.strip()[-200:]}")
+        shutil.rmtree(workdir, ignore_errors=True)
+        if problems:
+            print(json.dumps({"bench": "serve_spec",
+                              "error": "; ".join(problems)}))
+            return 1
+        rec_s["spec_gates"] = {
+            "engine_matches_generate": True,
+            "acceptance_rate": rec_s["acceptance_rate"],
+            "tokens_per_iteration": rec_s["tokens_per_iteration"],
+            "verify_compiles": {str(k): v for k, v
+                                in sp_st["verify_compiles"].items()},
+            "dpxmon_replay_rc": rc}
+
     # ---- multi-replica fleet arm (serve/fleet/) ----
     # the shared-prefix population through the prefix-affine fleet at
     # R=1, 2, 4 replicas on the SAME seeded Poisson arrivals: tokens/s
@@ -1017,6 +1186,12 @@ def main(argv):
         print(f"# WARNING: kvq record failed schema self-validation: "
               f"{'; '.join(issues[:3])}", file=sys.stderr)
     print(json.dumps(rec_q))
+    issues = pbrecord.validate_record(rec_s, strict=False)
+    if issues:
+        rec_s["schema_issues"] = issues
+        print(f"# WARNING: spec record failed schema self-validation: "
+              f"{'; '.join(issues[:3])}", file=sys.stderr)
+    print(json.dumps(rec_s))
     if rec_f is not None:
         issues = pbrecord.validate_record(rec_f, strict=False)
         if issues:
@@ -1033,6 +1208,7 @@ def main(argv):
         pbrecord.append_row(store, "serve_shared", rec)
         pbrecord.append_row(store, "serve_disagg", rec_d)
         pbrecord.append_row(store, "serve_kvq", rec_q)
+        pbrecord.append_row(store, "serve_spec", rec_s)
         if rec_f is not None:
             pbrecord.append_row(store, "serve_fleet", rec_f)
     return 0

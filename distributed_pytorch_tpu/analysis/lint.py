@@ -121,6 +121,7 @@ TYPED_ERRORS: Dict[str, Tuple[str, ...]] = {
                        "deadline_ms"),
     "HandoffCorrupt": ("request_id", "iteration", "engine", "page"),
     "ReplicaFailed": ("request_id", "iteration", "replica"),
+    "SpecDecodeError": ("request_id", "iteration", "stage"),
     "WorkerFailure": ("rank", "exitcode", "op", "kind"),
 }
 

@@ -657,6 +657,259 @@ def prefill_partial_paged(model: TransformerLM, params: Params,
     return logits, new_kp, new_vp, new_ks, new_vs, new_kt, new_vt
 
 
+def spec_verify_slots(model: TransformerLM, params: Params, ks, vs,
+                      lengths, tokens) -> Tuple[jnp.ndarray, list, list]:
+    """Speculative-decoding VERIFY over a contiguous slot pool
+    (``serve/spec/``): score all k+1 candidate positions of every row
+    in ONE batched forward, without writing the pool.
+
+    ``tokens`` (B, S) int32 is per row ``[cur, d_1 .. d_k]`` — the
+    slot's current (last-emitted, not-yet-cached) token followed by its
+    k draft proposals; S = k + 1. Row b's queries run at global
+    positions ``lengths[b] + j`` and attend over [pool row masked to
+    positions < lengths[b] | causal in-register candidate block] — the
+    same [resident | inline] layout as :func:`prefill_partial`, so the
+    position-j logits equal what j sequential :func:`decode_step_slots`
+    calls would produce (to the usual ~1-ulp batching tolerance; greedy
+    token streams are the asserted contract, per PR 3).
+
+    READ-ONLY with respect to the pool: nothing is scattered, so a
+    rejected suffix needs no rewind — acceptance is decided on the host
+    and only the accepted prefix is ever written, by
+    :func:`spec_commit_slots`, from the returned scratch K/V.
+
+    Returns ``(logits (B, S, vocab), sk, sv)`` where sk/sv are
+    per-layer (B, Hkv, S, Dh) f32 EXACT candidate K/V (position j holds
+    the key of ``tokens[:, j]`` at ``lengths + j``)."""
+    b, s = tokens.shape
+    idx = lengths
+    width = ks[0].shape[2]
+    positions = idx[:, None] + jnp.arange(s)[None, :]          # (B, S)
+    x = model.tok.apply(params["tok"], tokens)
+    if getattr(model, "pos", None) is not None:
+        # discarded over-length positions may clip into the learned
+        # table's last row — harmless, their logits are never accepted
+        x = x + model.pos.apply(params["pos"], positions)
+    scale = 1.0 / math.sqrt(model.dim // model.n_heads)
+    prefix_mask = jnp.broadcast_to(
+        (jnp.arange(width)[None, :] < idx[:, None])[:, None, :],
+        (b, s, width))
+    causal = jnp.broadcast_to(
+        jnp.tril(jnp.ones((s, s), dtype=bool))[None], (b, s, s))
+    mask = jnp.concatenate([prefix_mask, causal], axis=2)  # (B,S,W+S)
+
+    sk_out, sv_out = [], []
+    for i, blk in enumerate(model.blocks):
+        p = params["blocks"][i]
+        hq, hk, hv = blk.attn.project_qkv(p["attn"],
+                                          blk.ln1.apply(p["ln1"], x))
+        hq, hk = blk.attn.maybe_rope(hq, hk, positions[:, None, :])
+        sk_out.append(hk.astype(jnp.float32))
+        sv_out.append(hv.astype(jnp.float32))
+        k_all = jnp.concatenate([ks[i].astype(hk.dtype), hk], axis=2)
+        v_all = jnp.concatenate([vs[i].astype(hv.dtype), hv], axis=2)
+        bq, hh, _, dd = hq.shape
+        hkv = k_all.shape[1]
+        hq_g = hq.reshape(bq, hkv, hh // hkv, s, dd)
+        att = jnp.einsum("bngqd,bnkd->bngqk", hq_g, k_all).astype(
+            jnp.float32) * scale
+        att = jnp.where(mask[:, None, None, :, :], att, -jnp.inf)
+        probs = jax.nn.softmax(att, axis=-1).astype(v_all.dtype)
+        o = jnp.einsum("bngqk,bnkd->bngqd", probs, v_all) \
+            .reshape(bq, hh, s, dd)
+        x = x + blk.attn.project_out(p["attn"], o)
+        x = x + blk.mlp(p, x)
+
+    x = model.ln_f.apply(params["ln_f"], x)
+    return model.project_vocab(params, x), sk_out, sv_out
+
+
+def spec_commit_slots(ks, vs, lengths, sk, sv,
+                      commit) -> Tuple[list, list, jnp.ndarray]:
+    """Scatter the ACCEPTED prefix of a verify's scratch K/V into a
+    contiguous slot pool (``serve/spec/`` — the write half
+    :func:`spec_verify_slots` deliberately does not do).
+
+    ``commit`` (B,) int32 is the per-row accepted position count e
+    (0 = the row took no part in this spec iteration): scratch
+    positions ``0 .. e-1`` land at pool positions ``lengths + 0 ..
+    lengths + e - 1`` and the rejected suffix is simply never written —
+    rollback by construction, no rewind. Returns ``(new_ks, new_vs,
+    lengths + commit)``."""
+    s = sk[0].shape[2]
+    width = ks[0].shape[2]
+    new_k, new_v = list(ks), list(vs)
+    for j in range(s):
+        committed = j < commit                              # (B,)
+        wm = ((jnp.arange(width)[None, :] == (lengths + j)[:, None])
+              & committed[:, None])[:, None, :, None]       # (B,1,W,1)
+        for i in range(len(new_k)):
+            kj = sk[i][:, :, j:j + 1, :].astype(new_k[i].dtype)
+            vj = sv[i][:, :, j:j + 1, :].astype(new_v[i].dtype)
+            new_k[i] = jnp.where(wm, kj, new_k[i])
+            new_v[i] = jnp.where(wm, vj, new_v[i])
+    return new_k, new_v, lengths + commit
+
+
+def spec_verify_slots_paged(model: TransformerLM, params: Params,
+                            k_pages, v_pages, tables, lengths, tokens,
+                            *, page_len: int, kv_bits=None,
+                            k_scales=None, v_scales=None,
+                            k_tail=None, v_tail=None
+                            ) -> Tuple[jnp.ndarray, list, list]:
+    """Paged twin of :func:`spec_verify_slots`: batched k+1-position
+    verify over a PAGED slot pool, read-only.
+
+    Resident keys come from a dense page gather over each row's table
+    (the verify runs once per engine iteration over a short candidate
+    block, so the gather is amortized over k+1 scored positions; a
+    blockwise verify kernel is future work — docs/serving.md). In a
+    quantized pool (``kv_bits`` = 8 | 4) the gathered pages are
+    dequantized and each row's PARTIAL current page is overlaid from
+    its exact f32 tail buffer — the pool row for an incomplete page was
+    never written, exactly as in ``paged_decode_attention``.
+
+    Returns ``(logits (B, S, vocab), sk, sv)`` — the same exact-f32
+    scratch contract as the contiguous verify; committing (and, on page
+    completion, quantizing) accepted positions belongs to
+    :func:`spec_commit_slots_paged`."""
+    b, s = tokens.shape
+    idx = lengths
+    width = tables.shape[1] * page_len
+    positions = idx[:, None] + jnp.arange(s)[None, :]          # (B, S)
+    x = model.tok.apply(params["tok"], tokens)
+    if getattr(model, "pos", None) is not None:
+        x = x + model.pos.apply(params["pos"], positions)
+    scale = 1.0 / math.sqrt(model.dim // model.n_heads)
+    prefix_mask = jnp.broadcast_to(
+        (jnp.arange(width)[None, :] < idx[:, None])[:, None, :],
+        (b, s, width))
+    causal = jnp.broadcast_to(
+        jnp.tril(jnp.ones((s, s), dtype=bool))[None], (b, s, s))
+    mask = jnp.concatenate([prefix_mask, causal], axis=2)  # (B,S,W+S)
+    if kv_bits is not None:
+        from ..ops.quant import (dequantize_page_blocks, page_block_map,
+                                 unpack_page_nibbles)
+        h_kv = getattr(model, "n_kv_heads", model.n_heads)
+        dh = model.dim // model.n_heads
+        bmap = page_block_map(h_kv, page_len, dh)
+        # positions on a row's CURRENT (partial) page read the slot's
+        # exact f32 tail buffer; the mask hides everything >= lengths,
+        # so a just-completed page never exposes stale tail values
+        jcol = jnp.arange(width)
+        tail_sel = ((jcol[None, :] // page_len)
+                    == (idx[:, None] // page_len))[:, None, :, None]
+        toff = jcol % page_len                      # static (W,) index
+
+    sk_out, sv_out = [], []
+    for i, blk in enumerate(model.blocks):
+        p = params["blocks"][i]
+        hq, hk, hv = blk.attn.project_qkv(p["attn"],
+                                          blk.ln1.apply(p["ln1"], x))
+        hq, hk = blk.attn.maybe_rope(hq, hk, positions[:, None, :])
+        sk_out.append(hk.astype(jnp.float32))
+        sv_out.append(hv.astype(jnp.float32))
+        if kv_bits is None:
+            gk = _gather_pages(k_pages[i], tables).astype(hk.dtype)
+            gv = _gather_pages(v_pages[i], tables).astype(hv.dtype)
+        else:
+            qk, qv = k_pages[i][tables], v_pages[i][tables]
+            if kv_bits == 4:
+                qk, qv = unpack_page_nibbles(qk), unpack_page_nibbles(qv)
+            dk = dequantize_page_blocks(qk, k_scales[i][tables], bmap)
+            dv = dequantize_page_blocks(qv, v_scales[i][tables], bmap)
+            bb, pp, hh_kv, ll, dd_h = dk.shape
+            gk = dk.transpose(0, 2, 1, 3, 4).reshape(bb, hh_kv,
+                                                     pp * ll, dd_h)
+            gv = dv.transpose(0, 2, 1, 3, 4).reshape(bb, hh_kv,
+                                                     pp * ll, dd_h)
+            gk = jnp.where(tail_sel, k_tail[i][:, :, toff, :], gk) \
+                .astype(hk.dtype)
+            gv = jnp.where(tail_sel, v_tail[i][:, :, toff, :], gv) \
+                .astype(hv.dtype)
+        k_all = jnp.concatenate([gk, hk], axis=2)
+        v_all = jnp.concatenate([gv, hv], axis=2)
+        bq, hh, _, dd = hq.shape
+        hkv = k_all.shape[1]
+        hq_g = hq.reshape(bq, hkv, hh // hkv, s, dd)
+        att = jnp.einsum("bngqd,bnkd->bngqk", hq_g, k_all).astype(
+            jnp.float32) * scale
+        att = jnp.where(mask[:, None, None, :, :], att, -jnp.inf)
+        probs = jax.nn.softmax(att, axis=-1).astype(v_all.dtype)
+        o = jnp.einsum("bngqk,bnkd->bngqd", probs, v_all) \
+            .reshape(bq, hh, s, dd)
+        x = x + blk.attn.project_out(p["attn"], o)
+        x = x + blk.mlp(p, x)
+
+    x = model.ln_f.apply(params["ln_f"], x)
+    return model.project_vocab(params, x), sk_out, sv_out
+
+
+def spec_commit_slots_paged(k_pages, v_pages, tables, lengths, sk, sv,
+                            commit, *, page_len: int, kv_bits=None,
+                            k_scales=None, v_scales=None,
+                            k_tail=None, v_tail=None):
+    """Paged twin of :func:`spec_commit_slots`: scatter each row's
+    accepted scratch prefix into its pages.
+
+    Position ``lengths[b] + j`` lands in page ``tables[b, (lengths[b] +
+    j) // page_len]`` at offset ``(lengths[b] + j) % page_len``;
+    rejected positions (``j >= commit[b]``) route out of bounds and
+    drop, so a page can only ever COMPLETE from accepted tokens. In a
+    quantized pool each accepted position is first written to the
+    slot's exact f32 tail buffer, and whenever a write fills offset
+    ``page_len - 1`` the whole tail is quantized ONCE — from exact
+    values, on the wire block grid — and scattered with its scales,
+    preserving the PR 16 quantize-once discipline token-for-token with
+    the non-speculative decode path. Returns ``(new_k_pages,
+    new_v_pages)`` (+ scales and tails in quant mode); advancing the
+    host ``lengths`` by ``commit`` is the caller's business."""
+    s = sk[0].shape[2]
+    n_pages = k_pages[0].shape[0]
+    n_tables = tables.shape[1]
+    bsz = lengths.shape[0]
+    kp, vp = list(k_pages), list(v_pages)
+    if kv_bits is not None:
+        from ..ops.quant import pack_page_nibbles, quantize_page_blocks
+        ksc, vsc = list(k_scales), list(v_scales)
+        kt, vt = list(k_tail), list(v_tail)
+        n_tail = k_tail[0].shape[0]
+    for j in range(s):
+        committed = j < commit                              # (B,)
+        pos = lengths + j
+        wp = jnp.take_along_axis(
+            tables, jnp.clip(pos // page_len, 0, n_tables - 1)[:, None],
+            axis=1)[:, 0]
+        wo = pos % page_len
+        if kv_bits is None:
+            dest = jnp.where(committed, wp, n_pages)
+            for i in range(len(kp)):
+                kp[i] = kp[i].at[dest, :, wo].set(
+                    sk[i][:, :, j, :].astype(kp[i].dtype), mode="drop")
+                vp[i] = vp[i].at[dest, :, wo].set(
+                    sv[i][:, :, j, :].astype(vp[i].dtype), mode="drop")
+        else:
+            dest_t = jnp.where(committed, jnp.arange(bsz), n_tail)
+            completed = jnp.logical_and(committed, wo == page_len - 1)
+            dest_q = jnp.where(completed, wp, n_pages)
+            for i in range(len(kp)):
+                kt[i] = kt[i].at[dest_t, :, wo].set(
+                    sk[i][:, :, j, :].astype(jnp.float32), mode="drop")
+                vt[i] = vt[i].at[dest_t, :, wo].set(
+                    sv[i][:, :, j, :].astype(jnp.float32), mode="drop")
+                qk, sc_k = quantize_page_blocks(kt[i], kv_bits)
+                qv, sc_v = quantize_page_blocks(vt[i], kv_bits)
+                if kv_bits == 4:
+                    qk, qv = pack_page_nibbles(qk), pack_page_nibbles(qv)
+                kp[i] = kp[i].at[dest_q].set(qk, mode="drop")
+                vp[i] = vp[i].at[dest_q].set(qv, mode="drop")
+                ksc[i] = ksc[i].at[dest_q].set(sc_k, mode="drop")
+                vsc[i] = vsc[i].at[dest_q].set(sc_v, mode="drop")
+    if kv_bits is None:
+        return kp, vp
+    return kp, vp, ksc, vsc, kt, vt
+
+
 def _sample(logits, rng, temperature: float, top_k: Optional[int],
             top_p: Optional[float] = None):
     if temperature == 0.0:

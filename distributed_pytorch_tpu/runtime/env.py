@@ -457,6 +457,22 @@ register("DPX_FLEET_DRAIN_AFTER_OK", "int", 8,
          "sustained-ok drain retires a replica — the scale-in half of "
          "the hysteresis (scale-out reacts on the first degraded "
          "verdict).")
+register("DPX_SPEC_DECODE", "bool", False,
+         "Enable speculative decoding in the serving engines "
+         "(serve/spec/): a draft model proposes DPX_SPEC_DRAFT_LEN "
+         "tokens per iteration, one batched verify program scores "
+         "them, only accepted tokens commit. Requires "
+         "EngineConfig(draft_model=, draft_params=); greedy requests "
+         "only (docs/serving.md \"Speculative decoding\").")
+register("DPX_SPEC_DRAFT_LEN", "int", 4,
+         "Draft tokens proposed per speculative iteration (k); the "
+         "verify program scores k+1 positions and emits between 1 and "
+         "k+1 tokens. One verify compile per distinct k.")
+register("DPX_SERVE_TENANT_MAX_INFLIGHT", "int", 0,
+         "Per-tenant inflight-request quota of the serving front door "
+         "(0 = unlimited): a tenant at its cap gets a synchronous "
+         "typed AdmissionRejected(reason=\"tenant_quota\") with "
+         "tenant attribution instead of queueing.")
 
 # -- torch front door / benches --------------------------------------------
 register("DPX_WEIGHT_UPDATE", "str", "replicated",

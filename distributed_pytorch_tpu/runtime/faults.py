@@ -57,7 +57,15 @@ everything):
   kill-the-prefill-engine chaos case in tests/test_serve_disagg.py;
   under the cross-process transport the hooks run inside real rank
   processes, so ``kill@op=handoff_send`` hard-kills the prefill
-  process at the frame boundary).
+  process at the frame boundary). Speculative decoding
+  (``serve/spec/``) fires ``op=draft_propose`` entering the draft
+  proposal loop and ``op=spec_verify`` before the batched verify
+  program — ``flaky@op=spec_verify`` fails ONLY the speculating
+  victims as a typed ``SpecDecodeError`` (the verify never wrote the
+  pool, so co-resident non-spec streams stay bit-exact), while
+  ``delay@op=spec_verify,ms=...`` stalls the verify so a victim's
+  ``deadline_ms`` SLO trips at the next sweep (the chaos case in
+  tests/test_serve_spec.py).
 - ``call``    — the Nth (1-based) invocation of that op in this process.
 - ``step``    — the training step; specs *without* ``op`` fire from
   :func:`on_step` (train loops call it once per step); specs *with*
@@ -152,7 +160,7 @@ COMM_OPS = ("init",
             "reduce", "gather", "broadcast", "barrier",
             "ckpt", "ckpt_commit", "ckpt_commit_window", "serve_step",
             "page_admit", "page_evict", "handoff_send", "handoff_recv",
-            "fleet_submit")
+            "fleet_submit", "draft_propose", "spec_verify")
 
 _extra_ops: set = set()
 

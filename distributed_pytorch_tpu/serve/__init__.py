@@ -19,11 +19,12 @@ from .fleet import (FleetAutoscaler, FleetConfig, FleetHandle,  # noqa: F401
 from .metrics import aggregate, percentile, request_record  # noqa: F401
 from .pages import PagedSlotPool, PagePool, PrefixIndex  # noqa: F401
 from .scheduler import AdmissionScheduler  # noqa: F401
+from .spec import SpecConfig, SpecState  # noqa: F401
 from .types import (AdmissionRejected, EngineStopped,  # noqa: F401
                     HandoffCorrupt, HandoffError, HandoffTimeout,
                     PagePoolExhausted, PrefillEngineDied, Request,
                     RequestDeadlineExceeded, RequestHandle,
-                    SamplingParams, ServeError)
+                    SamplingParams, ServeError, SpecDecodeError)
 
 __all__ = [
     "AdmissionRejected", "AdmissionScheduler", "CompileCounts",
@@ -33,5 +34,6 @@ __all__ = [
     "InferenceEngine", "PagePool", "PagePoolExhausted", "PagedSlotPool",
     "PrefillEngineDied", "PrefixIndex", "ReplicaFailed", "Request",
     "RequestDeadlineExceeded", "RequestHandle", "SamplingParams",
-    "ServeError", "SlotPool", "aggregate", "percentile", "request_record",
+    "ServeError", "SlotPool", "SpecConfig", "SpecDecodeError",
+    "SpecState", "aggregate", "percentile", "request_record",
 ]

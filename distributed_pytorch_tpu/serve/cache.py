@@ -33,7 +33,8 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
-from ..models.generate import decode_step_slots, prefill_partial
+from ..models.generate import (decode_step_slots, prefill_partial,
+                               spec_commit_slots, spec_verify_slots)
 
 
 @dataclass
@@ -45,9 +46,17 @@ class CompileCounts:
     decode: int = 0
     prefill: Dict[int, int] = field(default_factory=dict)  # bucket -> n
     sample: int = 0
+    verify: Dict[int, int] = field(default_factory=dict)   # k+1 -> n
+    commit: Dict[int, int] = field(default_factory=dict)   # k+1 -> n
 
     def bump_prefill(self, bucket: int) -> None:
         self.prefill[bucket] = self.prefill.get(bucket, 0) + 1
+
+    def bump_verify(self, s: int) -> None:
+        self.verify[s] = self.verify.get(s, 0) + 1
+
+    def bump_commit(self, s: int) -> None:
+        self.commit[s] = self.commit.get(s, 0) + 1
 
 
 class SlotPool:
@@ -109,7 +118,45 @@ class SlotPool:
         lengths = lengths.at[slot].set(true_len)
         return logits, ks, vs, lengths
 
+    def _verify(self, params, ks, vs, lengths, tokens):
+        # trace-time only; shapes bake s = k+1, so one compile (and one
+        # counter bump) per draft-length bucket falls out of jit
+        self.compiles.bump_verify(tokens.shape[1])
+        return spec_verify_slots(self.model, params, ks, vs, lengths,
+                                 tokens)
+
+    def _commit(self, ks, vs, lengths, sk, sv, commit):
+        self.compiles.bump_commit(sk[0].shape[2])   # trace-time only
+        return spec_commit_slots(ks, vs, lengths, sk, sv, commit)
+
     # -- host front ends ---------------------------------------------------
+
+    def spec_verify(self, params, tokens):
+        """Score all rows' k+1 candidate tokens ((n_slots, k+1) int32)
+        in one batched forward WITHOUT touching the pool — no donation:
+        acceptance is decided on the host afterwards and only then does
+        :meth:`spec_commit` write (the rejected suffix simply never
+        lands). Returns (logits (n_slots, k+1, vocab), sk, sv) with
+        sk/sv the per-layer f32 candidate K/V scratch."""
+        fn = getattr(self, "_verify_fn", None)
+        if fn is None:
+            fn = self._verify_fn = jax.jit(self._verify)
+            # NOTE deliberately NOT donated (the pool survives verify)
+        return fn(params, self.ks, self.vs, self.lengths, tokens)
+
+    def spec_commit(self, sk, sv, commit) -> None:
+        """Write each row's accepted prefix (``commit`` (n_slots,)
+        int32, 0 = row not speculating) from the verify scratch and
+        advance lengths by ``commit``."""
+        fn = getattr(self, "_commit_fn", None)
+        if fn is None:
+            # the verify scratch (sk/sv) stays undonated: its (B, Hkv,
+            # k+1, Dh) layout can never alias the (B, Hkv, W, Dh) pool
+            # outputs, so donating it only buys an XLA warning
+            fn = self._commit_fn = jax.jit(
+                self._commit, donate_argnums=(0, 1, 2))
+        self.ks, self.vs, self.lengths = fn(
+            self.ks, self.vs, self.lengths, sk, sv, commit)
 
     def admit(self, params, tokens_padded, true_len: int, slot: int):
         """Prefill ``tokens_padded`` (1, bucket) into ``slot``; returns

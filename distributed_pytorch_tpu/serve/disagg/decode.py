@@ -36,8 +36,9 @@ import numpy as np
 from ...models.generate import _sample
 from ...runtime import faults
 from ..pages import PagedSlotPool
+from ..spec import SpecState, accept_greedy
 from ..types import (HandoffCorrupt, PagePoolExhausted, Request,
-                     RequestDeadlineExceeded)
+                     RequestDeadlineExceeded, SpecDecodeError)
 from . import frames
 from .transport import TransportSevered
 
@@ -52,7 +53,7 @@ class DecodeEngine:
 
     def __init__(self, model, params, router, transport, *,
                  n_slots: int, max_len: int, page_len: int, n_pages: int,
-                 kv_dtype: str = "f32"):
+                 kv_dtype: str = "f32", spec=None, buckets=None):
         self.model = model
         self.params = params
         self.router = router
@@ -63,6 +64,19 @@ class DecodeEngine:
         self.pool = PagedSlotPool(model, n_slots, max_len,
                                   page_len=page_len, n_pages=n_pages,
                                   prefix_share=False, kv_dtype=kv_dtype)
+        # speculative decoding (serve/spec/): the draft loop lives HERE
+        # — this engine owns token cadence, so this is where k-token
+        # iterations pay off. ``spec`` is a resolved SpecConfig (the
+        # router builds it); the draft prefills from the request's
+        # prompt at frame adoption, using ``buckets``.
+        self._spec: Optional[SpecState] = None
+        self._spec_buckets = tuple(buckets) if buckets else ()
+        if spec is not None:
+            self._spec = SpecState(spec, n_slots, max_len)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_iters = 0
+        self.spec_tokens = 0
         self.iterations = 0
         self.tokens_emitted = 0
         self._samplers: Dict[tuple, callable] = {}
@@ -223,13 +237,24 @@ class DecodeEngine:
             req.slot = slot
             req.stage = "decode"
             self._running[slot] = req
+            if self._spec is not None and req.params.temperature == 0.0:
+                # the draft reruns the whole prompt locally — its
+                # prefill is cheap by construction (that's what makes
+                # it a draft) and avoids a second handoff stream
+                self._spec.admit(req.prompt, slot, self._spec_buckets)
             # token 0: the frame's exact logits + rngs[0] — the same
             # split-schedule position generate() samples first
             tok = self._sample_for(req, np.asarray(frame.logits)[None])
             self._emit(req, tok)
 
     def _decode_all(self) -> None:
-        for slot in sorted(self._running):
+        spec_slots: List[int] = []
+        if self._spec is not None:
+            spec_slots = [s for s in sorted(self._running)
+                          if self._spec.active[s]]
+        nonspec = [s for s in sorted(self._running)
+                   if s not in set(spec_slots)]
+        for slot in list(nonspec):
             req = self._running[slot]
             try:
                 self.pool.ensure_decode_capacity(slot)
@@ -242,17 +267,107 @@ class DecodeEngine:
                     request_id=req.request_id,
                     iteration=self.iterations),
                     outcome="no_free_pages")
-        if not self._running:
+                nonspec.remove(slot)
+        if nonspec:
+            active = np.zeros(self.n_slots, bool)
+            active[nonspec] = True
+            logits = self.pool.decode(self.params,
+                                      np.asarray(self._cur_tokens),
+                                      np.asarray(active))
+            for slot in nonspec:
+                req = self._running[slot]
+                tok = self._sample_for(req, logits[slot:slot + 1])
+                self._emit(req, tok)
+        spec_slots = [s for s in spec_slots if s in self._running]
+        if spec_slots:
+            self._spec_step(spec_slots)
+
+    def _spec_fail(self, slots: List[int], cause: Exception,
+                   stage: str) -> None:
+        for slot in slots:
+            req = self._running.get(slot)
+            if req is None:
+                continue
+            exc = SpecDecodeError(
+                f"request {req.request_id}: speculative {stage} failed "
+                f"after {len(req.out_tokens)} tokens: {cause!r}",
+                stage=stage, request_id=req.request_id,
+                iteration=self.iterations)
+            exc.__cause__ = cause
+            self.fail_resident(req, exc, outcome="spec_decode")
+
+    def _spec_step(self, spec_slots: List[int]) -> None:
+        """One speculative iteration — the decode-side twin of
+        ``InferenceEngine._spec_step`` (serve/engine.py): propose k,
+        ONE batched verify, commit only the accepted prefix; failures
+        are contained to the speculating victims through the router's
+        single finish path."""
+        spec = self._spec
+        k = spec.cfg.draft_len
+        try:
+            faults.on_comm_op("draft_propose")
+            drafts = spec.propose(spec_slots,
+                                  self._cur_tokens[spec_slots])
+        except Exception as e:  # noqa: BLE001 — victim containment
+            self._spec_fail(spec_slots, e, "propose")
             return
-        active = np.zeros(self.n_slots, bool)
-        active[list(self._running)] = True
-        logits = self.pool.decode(self.params,
-                                  np.asarray(self._cur_tokens),
-                                  np.asarray(active))
-        for slot in sorted(self._running):
+        tokens = np.zeros((self.n_slots, k + 1), np.int32)
+        tokens[spec_slots, 0] = self._cur_tokens[spec_slots]
+        tokens[spec_slots, 1:] = drafts
+        try:
+            faults.on_comm_op("spec_verify")
+            logits, sk, sv = self.pool.spec_verify(self.params, tokens)
+            logits_np = np.asarray(logits)
+        except Exception as e:  # noqa: BLE001 — victim containment
+            self._spec_fail(spec_slots, e, "verify")
+            return
+        commit = np.zeros(self.n_slots, np.int32)
+        emits: Dict[int, List[int]] = {}
+        for i, slot in enumerate(spec_slots):
             req = self._running[slot]
-            tok = self._sample_for(req, logits[slot:slot + 1])
-            self._emit(req, tok)
+            sp = req.params
+            out, e = accept_greedy(
+                drafts[i], logits_np[slot],
+                sp.max_new_tokens - len(req.out_tokens), sp.eos_token)
+            req.spec_proposed += k
+            req.spec_accepted += e - 1
+            self.spec_proposed += k
+            self.spec_accepted += e - 1
+            self.spec_iters += 1
+            commit[slot] = e
+            emits[slot] = out
+        for slot in list(emits):
+            req = self._running[slot]
+            try:
+                self.pool.ensure_spec_capacity(slot, int(commit[slot]))
+            except PagePoolExhausted as e:
+                n_acc = int(commit[slot])
+                commit[slot] = 0
+                del emits[slot]
+                self.fail_resident(req, PagePoolExhausted(
+                    f"request {req.request_id}: decode page pool "
+                    f"exhausted committing {n_acc} accepted token(s) "
+                    f"after {len(req.out_tokens)} tokens ({e.needed} "
+                    f"page(s) needed, {e.free_pages} free)",
+                    needed=e.needed, free_pages=e.free_pages,
+                    request_id=req.request_id,
+                    iteration=self.iterations),
+                    outcome="no_free_pages")
+        try:
+            self.pool.spec_commit(sk, sv, commit)
+        except Exception as e:  # noqa: BLE001 — victim containment
+            self._spec_fail(list(emits), e, "commit")
+            return
+        alive = [s for s in emits if s in self._running]
+        spec.rollback(alive, commit[alive])
+        if alive:
+            self.spec_tokens += int(commit[alive].sum())
+        for slot in alive:
+            req = self._running[slot]
+            for tok in emits[slot]:
+                self._emit(req, tok)
+                if req.done:
+                    break
 
     # -- per-request mechanics (mirror serve/engine.py) --------------------
 
@@ -292,6 +407,8 @@ class DecodeEngine:
     def _free_slot(self, req: Request) -> None:
         if req.slot is not None:
             self.pool.release(req.slot)
+            if self._spec is not None:
+                self._spec.release(req.slot)
             self._running.pop(req.slot, None)
             self._free.append(req.slot)
             req.slot = None
@@ -313,11 +430,29 @@ class DecodeEngine:
 
     def stats(self) -> dict:
         c = self.pool.compiles
-        return {"iterations": self.iterations,
-                "tokens_emitted": self.tokens_emitted,
-                "active_slots": len(self._running),
-                "pending_handoffs": len(self._pending),
-                "decode_compiles": c.decode,
-                "sample_compiles": c.sample,
-                "prefill_compiles": dict(c.prefill),   # must stay {}
-                "pages": self.pool.page_stats()}
+        out = {"iterations": self.iterations,
+               "tokens_emitted": self.tokens_emitted,
+               "active_slots": len(self._running),
+               "pending_handoffs": len(self._pending),
+               "decode_compiles": c.decode,
+               "sample_compiles": c.sample,
+               "prefill_compiles": dict(c.prefill),   # must stay {}
+               "pages": self.pool.page_stats()}
+        if self._spec is not None:
+            out["spec"] = {
+                "draft_len": self._spec.cfg.draft_len,
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "acceptance_rate": (self.spec_accepted
+                                    / self.spec_proposed
+                                    if self.spec_proposed else 0.0),
+                "tokens_per_iteration": (self.spec_tokens
+                                         / self.spec_iters
+                                         if self.spec_iters else 0.0),
+                "spec_tokens": self.spec_tokens,
+                "verify_compiles": dict(c.verify),
+                "commit_compiles": dict(c.commit),
+                "draft_decode_compiles":
+                    self._spec.pool.compiles.decode,
+            }
+        return out

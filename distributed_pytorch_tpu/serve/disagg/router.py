@@ -31,7 +31,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -44,6 +44,7 @@ from ...utils.logging import MetricsLogger
 from ..engine import _default_buckets
 from ..metrics import emit_request_trace, request_record
 from ..scheduler import AdmissionScheduler
+from ..spec import SpecConfig
 from ..types import (FAILED, FINISHED, AdmissionRejected, EngineStopped,
                      HandoffCorrupt, HandoffTimeout, PrefillEngineDied,
                      Request, RequestDeadlineExceeded, RequestHandle,
@@ -85,6 +86,15 @@ class DisaggConfig:
     # pool adopts them verbatim — no dequant→requant double hop
     # (docs/serving.md "Quantized resident pool").
     kv_dtype: Optional[str] = None
+    # speculative decoding on the DECODE side (serve/spec/;
+    # docs/serving.md "Speculative decoding"): same semantics as the
+    # monolithic EngineConfig — the draft loop lives in the
+    # DecodeEngine, which owns token cadence. None spec_decode /
+    # draft_len default from DPX_SPEC_DECODE / DPX_SPEC_DRAFT_LEN.
+    spec_decode: Optional[bool] = None
+    draft_model: Any = None
+    draft_params: Any = None
+    draft_len: Optional[int] = None
 
 
 class DisaggEngine:
@@ -167,10 +177,29 @@ class DisaggEngine:
             model, params, self, self.transport, buckets=self.buckets,
             page_len=page_len, n_pages=prefill_pages,
             prefix_share=bool(share), bits=bits, kv_dtype=kv_dtype)
+        spec_on = (cfg.spec_decode if cfg.spec_decode is not None
+                   else dpxenv.get("DPX_SPEC_DECODE"))
+        spec = None
+        if spec_on:
+            if cfg.draft_model is None or cfg.draft_params is None:
+                raise ValueError(
+                    "spec_decode=True requires draft_model and "
+                    "draft_params (DisaggConfig) — there is nothing "
+                    "to propose with")
+            draft_len = (cfg.draft_len if cfg.draft_len is not None
+                         else dpxenv.get("DPX_SPEC_DRAFT_LEN"))
+            spec = SpecConfig(draft_model=cfg.draft_model,
+                              draft_params=cfg.draft_params,
+                              draft_len=int(draft_len))
         self.decode = DecodeEngine(
             model, params, self, self.transport, n_slots=cfg.n_slots,
             max_len=cfg.max_len, page_len=page_len, n_pages=n_pages,
-            kv_dtype=kv_dtype)
+            kv_dtype=kv_dtype, spec=spec, buckets=self.buckets)
+        # per-tenant admission quota (DPX_SERVE_TENANT_MAX_INFLIGHT;
+        # 0 = unlimited): inflight counts move under _lock, released
+        # in the one exactly-once completion path (_resolve)
+        self._tenant_max = int(dpxenv.get("DPX_SERVE_TENANT_MAX_INFLIGHT"))
+        self._tenant_inflight: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._handoff: Dict[int, Request] = {}   # sent, not yet adopted
         self._requests: Dict[int, Request] = {}  # all in-flight
@@ -185,12 +214,13 @@ class DisaggEngine:
     # -- front door --------------------------------------------------------
 
     def submit(self, prompt, params: Optional[SamplingParams] = None, *,
-               rng=None, on_token=None) -> RequestHandle:
+               rng=None, on_token=None,
+               tenant: Optional[str] = None) -> RequestHandle:
         """Enqueue one request; same contract as
         ``InferenceEngine.submit`` (synchronous typed
         ``AdmissionRejected`` when it can never be served, bounded
         queue, per-request PRNG split schedule identical to
-        ``generate()``)."""
+        ``generate()``, per-tenant inflight quota via ``tenant``)."""
         sp = params or SamplingParams()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         with self._lock:
@@ -208,7 +238,8 @@ class DisaggEngine:
                       deadline_t=(now + sp.deadline_ms / 1e3
                                   if sp.deadline_ms is not None
                                   else None),
-                      on_token=on_token, stage="prefill_queue",
+                      on_token=on_token, tenant=tenant,
+                      stage="prefill_queue",
                       trace_id=dpxtrace.new_trace_id())
         req.handle = RequestHandle(req)
         with self._lock:
@@ -223,8 +254,22 @@ class DisaggEngine:
                     request_id=rid)
                 exc.__cause__ = self._prefill_dead_cause
                 raise exc
+            if (tenant is not None and self._tenant_max > 0
+                    and self._tenant_inflight.get(tenant, 0)
+                    >= self._tenant_max):
+                dpxmon.inc("serve.rejected")
+                dpxmon.inc(f"serve.rejected.tenant.{tenant}")
+                raise AdmissionRejected(
+                    f"request {rid}: tenant {tenant!r} already has "
+                    f"{self._tenant_inflight[tenant]} inflight "
+                    f"request(s) (DPX_SERVE_TENANT_MAX_INFLIGHT="
+                    f"{self._tenant_max})", reason="tenant_quota",
+                    tenant=tenant, request_id=rid)
             self.scheduler.submit(req)   # may raise AdmissionRejected
             self._requests[rid] = req
+            if tenant is not None:
+                self._tenant_inflight[tenant] = \
+                    self._tenant_inflight.get(tenant, 0) + 1
         self.prefill.wake()
         return req.handle
 
@@ -292,6 +337,15 @@ class DisaggEngine:
                 return False
             self._requests.pop(req.request_id, None)
             self._handoff.pop(req.request_id, None)
+            if req.tenant is not None:
+                # the tenant's inflight credit returns at ANY terminal
+                # transition — this gate is the one place both paths
+                # (retire and typed failure) funnel through exactly once
+                n = self._tenant_inflight.get(req.tenant, 0)
+                if n <= 1:
+                    self._tenant_inflight.pop(req.tenant, None)
+                else:
+                    self._tenant_inflight[req.tenant] = n - 1
             return True
 
     def finish_ok(self, req: Request) -> None:
@@ -308,8 +362,14 @@ class DisaggEngine:
         dpxmon.inc("serve.completed")
         if rec["ttft_ms"] is not None:
             dpxmon.observe("serve.ttft_ms", rec["ttft_ms"])
+            if req.tenant is not None:
+                dpxmon.observe(f"serve.ttft_ms.tenant.{req.tenant}",
+                               rec["ttft_ms"])
         if rec["tpot_ms"] is not None:
             dpxmon.observe("serve.tpot_ms", rec["tpot_ms"])
+            if req.tenant is not None:
+                dpxmon.observe(f"serve.tpot_ms.tenant.{req.tenant}",
+                               rec["tpot_ms"])
         if self.metrics is not None:
             self.metrics.event("serve_request", **rec)
         emit_request_trace(req, "ok")
@@ -503,5 +563,13 @@ class DisaggEngine:
         dpxmon.set_gauge("serve.handoff_bytes_sent", int(
             self.transport.stats.summary()
             .get("handoff_send", {}).get("bytes", 0)))
+        if self.decode.spec_proposed:
+            dpxmon.set_gauge(
+                "serve.spec_acceptance_rate",
+                self.decode.spec_accepted / self.decode.spec_proposed)
+            dpxmon.set_gauge(
+                "serve.spec_tokens_per_iteration",
+                self.decode.spec_tokens / self.decode.spec_iters
+                if self.decode.spec_iters else 0.0)
         dpxmon.emit_snapshot(path=self.metrics.path, step=iteration,
                              source="serve_disagg_engine")

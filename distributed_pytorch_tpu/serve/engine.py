@@ -47,9 +47,11 @@ from .cache import SlotPool
 from .metrics import emit_request_trace, request_record
 from .pages import PagedSlotPool
 from .scheduler import AdmissionScheduler
+from .spec import SpecConfig, SpecState, accept_greedy
 from .types import (FAILED, FINISHED, QUEUED, RUNNING, AdmissionRejected,
                     EngineStopped, PagePoolExhausted, Request,
-                    RequestDeadlineExceeded, RequestHandle, SamplingParams)
+                    RequestDeadlineExceeded, RequestHandle, SamplingParams,
+                    SpecDecodeError)
 
 
 def _default_buckets(cap: int) -> Tuple[int, ...]:
@@ -95,6 +97,18 @@ class EngineConfig:
     # env-driven one is ignored (the env var sizes paged fleets without
     # breaking non-paged engines in the same process).
     kv_dtype: Optional[str] = None
+    # speculative decoding (serve/spec/; docs/serving.md "Speculative
+    # decoding"): a draft model proposes draft_len tokens per
+    # iteration, one batched verify program scores them, only accepted
+    # tokens commit. None spec_decode/draft_len default from the typed
+    # env registry (DPX_SPEC_DECODE / DPX_SPEC_DRAFT_LEN); enabling
+    # spec without a draft model+params raises at construction. Only
+    # greedy (temperature 0) requests speculate; others share the same
+    # batch non-speculatively.
+    spec_decode: Optional[bool] = None
+    draft_model: Any = None
+    draft_params: Any = None
+    draft_len: Optional[int] = None
     # reshard-free admit (docs/front_door.md): the params handed to the
     # engine must ALREADY carry these shardings — typically a train
     # step's ``out_shardings["params"]`` (parallel.handoff_shardings).
@@ -171,6 +185,36 @@ class InferenceEngine:
                     "quantized storage mode")
             self.pool = SlotPool(model, cfg.n_slots, cfg.max_len,
                                  window=self.window)
+        spec_on = (cfg.spec_decode if cfg.spec_decode is not None
+                   else dpxenv.get("DPX_SPEC_DECODE"))
+        self._spec: Optional[SpecState] = None
+        if spec_on:
+            if self.window is not None:
+                raise ValueError(
+                    "spec_decode does not support sliding-window "
+                    "models — the batched verify attends the full "
+                    "resident prefix (serve/spec/)")
+            if cfg.draft_model is None or cfg.draft_params is None:
+                raise ValueError(
+                    "spec_decode=True requires draft_model and "
+                    "draft_params (EngineConfig) — there is nothing "
+                    "to propose with")
+            draft_len = (cfg.draft_len if cfg.draft_len is not None
+                         else dpxenv.get("DPX_SPEC_DRAFT_LEN"))
+            self._spec = SpecState(
+                SpecConfig(draft_model=cfg.draft_model,
+                           draft_params=cfg.draft_params,
+                           draft_len=int(draft_len)),
+                cfg.n_slots, cfg.max_len)
+        # cumulative speculation accounting (gauges + bench record)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_iters = 0      # spec row-iterations
+        self._spec_tokens = 0     # tokens emitted via spec commits
+        # per-tenant admission quota (DPX_SERVE_TENANT_MAX_INFLIGHT;
+        # 0 = unlimited): inflight counts move under _cond
+        self._tenant_max = int(dpxenv.get("DPX_SERVE_TENANT_MAX_INFLIGHT"))
+        self._tenant_inflight: Dict[str, int] = {}
         self.metrics = cfg.metrics
         self._scheduler = AdmissionScheduler(cfg.max_queue)
         self._samplers: Dict[tuple, callable] = {}
@@ -190,15 +234,19 @@ class InferenceEngine:
     # -- front door --------------------------------------------------------
 
     def submit(self, prompt, params: Optional[SamplingParams] = None, *,
-               rng=None, on_token=None) -> RequestHandle:
+               rng=None, on_token=None,
+               tenant: Optional[str] = None) -> RequestHandle:
         """Enqueue one request; returns immediately with a handle.
 
         ``prompt``: (S,) int token ids. ``rng``: the request's PRNG key
         (defaults to ``PRNGKey(request_id)``) — the engine consumes it
         with exactly ``generate()``'s split schedule, so the same key
-        reproduces the same stream standalone. Raises a typed
+        reproduces the same stream standalone. ``tenant`` attributes
+        the request for quota (``DPX_SERVE_TENANT_MAX_INFLIGHT``) and
+        per-tenant latency histograms. Raises a typed
         :class:`AdmissionRejected` synchronously when the request can
-        never be served (or the bounded queue is full)."""
+        never be served (or the bounded queue / the tenant's inflight
+        quota is full)."""
         sp = params or SamplingParams()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         with self._cond:
@@ -222,7 +270,7 @@ class InferenceEngine:
                       submit_t=now,
                       deadline_t=(now + sp.deadline_ms / 1e3
                                   if sp.deadline_ms is not None else None),
-                      on_token=on_token,
+                      on_token=on_token, tenant=tenant,
                       trace_id=dpxtrace.new_trace_id())
         req.handle = RequestHandle(req)
         # enqueue under the same lock the stop flag lives behind: a
@@ -233,11 +281,25 @@ class InferenceEngine:
             if self._stop:
                 raise EngineStopped("engine is shut down",
                                     request_id=rid)
+            if (tenant is not None and self._tenant_max > 0
+                    and self._tenant_inflight.get(tenant, 0)
+                    >= self._tenant_max):
+                dpxmon.inc("serve.rejected")
+                dpxmon.inc(f"serve.rejected.tenant.{tenant}")
+                raise AdmissionRejected(
+                    f"request {rid}: tenant {tenant!r} already has "
+                    f"{self._tenant_inflight[tenant]} inflight "
+                    f"request(s) (DPX_SERVE_TENANT_MAX_INFLIGHT="
+                    f"{self._tenant_max})", reason="tenant_quota",
+                    tenant=tenant, request_id=rid)
             try:
                 self._scheduler.submit(req)  # may raise AdmissionRejected
             except AdmissionRejected:
                 dpxmon.inc("serve.rejected")
                 raise
+            if tenant is not None:
+                self._tenant_inflight[tenant] = \
+                    self._tenant_inflight.get(tenant, 0) + 1
             self._cond.notify_all()
         return req.handle
 
@@ -335,9 +397,25 @@ class InferenceEngine:
                "prefill_compiles": dict(c.prefill),
                "sample_compiles": c.sample,
                "buckets": self.buckets,
-               "paged": self._paged}
+               "paged": self._paged,
+               "spec_decode": self._spec is not None}
         if self._paged:
             out["pages"] = self.pool.page_stats()
+        if self._spec is not None:
+            out["spec"] = {
+                "draft_len": self._spec.cfg.draft_len,
+                "proposed": self._spec_proposed,
+                "accepted": self._spec_accepted,
+                "acceptance_rate": (
+                    self._spec_accepted / self._spec_proposed
+                    if self._spec_proposed else None),
+                "tokens_per_iteration": (
+                    self._spec_tokens / self._spec_iters
+                    if self._spec_iters else None),
+                "spec_tokens": self._spec_tokens,
+                "verify_compiles": dict(c.verify),
+                "commit_compiles": dict(c.commit),
+                "draft_decode_compiles": self._spec.pool.compiles.decode}
         return out
 
     # -- engine loop -------------------------------------------------------
@@ -404,6 +482,11 @@ class InferenceEngine:
             dpxmon.set_gauge("serve.kv_pool_bytes", ps["kv_pool_bytes"])
             dpxmon.set_gauge("serve.bytes_per_resident_token",
                              ps["bytes_per_resident_token"])
+        if self._spec is not None and self._spec_proposed:
+            dpxmon.set_gauge("serve.spec_acceptance_rate",
+                             self._spec_accepted / self._spec_proposed)
+            dpxmon.set_gauge("serve.spec_tokens_per_iteration",
+                             self._spec_tokens / max(self._spec_iters, 1))
         dpxmon.emit_snapshot(path=self.metrics.path,
                              step=self._iteration,
                              source="serve_engine")
@@ -487,18 +570,32 @@ class InferenceEngine:
                 padded[0, :s] = req.prompt
                 logits = self.pool.admit(self.params, jnp.asarray(padded),
                                          s, slot)
+            if self._spec is not None and req.params.temperature == 0.0:
+                # greedy requests speculate: prefill the draft's own
+                # slot too (a prompt no draft bucket fits just runs
+                # non-speculative — mixed batches are first-class)
+                self._spec.admit(req.prompt, slot, self.buckets)
             req.admit_t = time.monotonic()
             req.admit_iteration = self._iteration
             tok = self._sample_for(req, logits)
             self._emit(req, tok)
 
     def _decode_all(self) -> None:
+        spec_slots: List[int] = []
+        if self._spec is not None:
+            spec_slots = [s for s in sorted(self._running)
+                          if self._spec.active[s]]
+        nonspec = [s for s in sorted(self._running)
+                   if s not in set(spec_slots)]
         if self._paged:
             # grow page tables at page boundaries BEFORE the decode
             # write; an exhausted pool fails the victim request typed
             # (request + iteration attributed) and frees its pages —
-            # co-resident slots decode on, untouched
-            for slot in sorted(self._running):
+            # co-resident slots decode on, untouched. Spec rows don't
+            # take part: their pages grow AFTER acceptance is known
+            # (ensure_spec_capacity), so rejected drafts never demand
+            # a page
+            for slot in list(nonspec):
                 req = self._running[slot]
                 try:
                     self.pool.ensure_decode_capacity(slot)
@@ -512,17 +609,133 @@ class InferenceEngine:
                         request_id=req.request_id,
                         iteration=self._iteration),
                         outcome="no_free_pages")
-            if not self._running:
-                return
-        active = np.zeros(self.config.n_slots, bool)
-        active[list(self._running)] = True
-        logits = self.pool.decode(self.params,
-                                  jnp.asarray(self._cur_tokens),
-                                  jnp.asarray(active))
-        for slot in sorted(self._running):
+                    nonspec.remove(slot)
+        if nonspec:
+            active = np.zeros(self.config.n_slots, bool)
+            active[nonspec] = True
+            logits = self.pool.decode(self.params,
+                                      jnp.asarray(self._cur_tokens),
+                                      jnp.asarray(active))
+            for slot in nonspec:
+                req = self._running[slot]
+                tok = self._sample_for(req, logits[slot:slot + 1])
+                self._emit(req, tok)
+        spec_slots = [s for s in spec_slots if s in self._running]
+        if spec_slots:
+            self._spec_step(spec_slots)
+
+    def _spec_fail(self, slots: List[int], cause: Exception,
+                   stage: str) -> None:
+        """Fail the speculating victims of a propose/verify/commit
+        fault, typed and stage-attributed; non-spec co-residents are
+        untouched (the target pool was not written for this iteration,
+        so their streams stay bit-exact)."""
+        for slot in slots:
+            req = self._running.get(slot)
+            if req is None:
+                continue
+            exc = SpecDecodeError(
+                f"request {req.request_id}: speculative {stage} failed "
+                f"after {len(req.out_tokens)} tokens: {cause!r}",
+                stage=stage, request_id=req.request_id,
+                iteration=self._iteration)
+            exc.__cause__ = cause
+            self._fail(req, exc, outcome="spec_decode")
+
+    def _spec_step(self, spec_slots: List[int]) -> None:
+        """One speculative iteration for the speculating slots: draft
+        proposes k tokens each, ONE batched verify program scores all
+        k+1 positions, the longest matching prefix (+ the free bonus
+        token) is emitted, and only accepted positions commit — the
+        rejected suffix was never written anywhere, so rollback is pure
+        host bookkeeping (the draft's length rewind)."""
+        spec = self._spec
+        k = spec.cfg.draft_len
+        tracing = dpxtrace.enabled()
+        try:
+            faults.on_comm_op("draft_propose")
+            t0 = time.monotonic()
+            drafts = spec.propose(spec_slots,
+                                  self._cur_tokens[spec_slots])
+            t1 = time.monotonic()
+        except Exception as e:  # noqa: BLE001 — victim containment
+            self._spec_fail(spec_slots, e, "propose")
+            return
+        tokens = np.zeros((self.config.n_slots, k + 1), np.int32)
+        tokens[spec_slots, 0] = self._cur_tokens[spec_slots]
+        tokens[spec_slots, 1:] = drafts
+        try:
+            faults.on_comm_op("spec_verify")
+            t2 = time.monotonic()
+            logits, sk, sv = self.pool.spec_verify(self.params, tokens)
+            logits_np = np.asarray(logits)
+            t3 = time.monotonic()
+        except Exception as e:  # noqa: BLE001 — victim containment
+            self._spec_fail(spec_slots, e, "verify")
+            return
+        if tracing:
+            w = dpxtrace.wall_from_mono
+            for slot in spec_slots:
+                req = self._running[slot]
+                dpxtrace.emit_span("serve.spec.propose", w(t0), w(t1),
+                                   trace_id=req.trace_id,
+                                   request_id=req.request_id)
+                dpxtrace.emit_span("serve.spec.verify", w(t2), w(t3),
+                                   trace_id=req.trace_id,
+                                   request_id=req.request_id,
+                                   draft_len=k)
+        commit = np.zeros(self.config.n_slots, np.int32)
+        emits: Dict[int, List[int]] = {}
+        for i, slot in enumerate(spec_slots):
             req = self._running[slot]
-            tok = self._sample_for(req, logits[slot:slot + 1])
-            self._emit(req, tok)
+            sp = req.params
+            out, e = accept_greedy(
+                drafts[i], logits_np[slot],
+                sp.max_new_tokens - len(req.out_tokens), sp.eos_token)
+            req.spec_proposed += k
+            req.spec_accepted += e - 1
+            self._spec_proposed += k
+            self._spec_accepted += e - 1
+            self._spec_iters += 1
+            commit[slot] = e
+            emits[slot] = out
+        if self._paged:
+            # accepted counts are known — only NOW may pages be
+            # demanded; exhaustion fails THAT victim typed (its commit
+            # zeroes, nothing of its iteration lands)
+            for slot in list(emits):
+                req = self._running[slot]
+                try:
+                    self.pool.ensure_spec_capacity(slot,
+                                                   int(commit[slot]))
+                except PagePoolExhausted as e:
+                    n_acc = int(commit[slot])
+                    commit[slot] = 0
+                    del emits[slot]
+                    self._fail(req, PagePoolExhausted(
+                        f"request {req.request_id}: page pool exhausted "
+                        f"committing {n_acc} accepted "
+                        f"token(s) after {len(req.out_tokens)} tokens "
+                        f"({e.needed} page(s) needed, {e.free_pages} "
+                        f"free)", needed=e.needed,
+                        free_pages=e.free_pages,
+                        request_id=req.request_id,
+                        iteration=self._iteration),
+                        outcome="no_free_pages")
+        try:
+            self.pool.spec_commit(sk, sv, commit)
+        except Exception as e:  # noqa: BLE001 — victim containment
+            self._spec_fail(list(emits), e, "commit")
+            return
+        alive = [s for s in emits if s in self._running]
+        spec.rollback(alive, commit[alive])
+        self._spec_tokens += int(commit[alive].sum()) if alive else 0
+        for slot in alive:
+            req = self._running[slot]
+            for tok in emits[slot]:
+                self._emit(req, tok)
+                if req.done:
+                    break
 
     def _sample_for(self, req: Request, logits) -> int:
         fn = self._samplers.get(req.params.sampler_key)
@@ -566,6 +779,10 @@ class InferenceEngine:
             # length zeroes so the blockwise decode's max(lengths) trip
             # count stops charging for a request that no longer exists.
             self.pool.release(req.slot)
+            if self._spec is not None:
+                # draft state exits through the same funnel — retire,
+                # typed failure, crash drain alike (serve/spec/)
+                self._spec.release(req.slot)
             self._running.pop(req.slot, None)
             self._free.append(req.slot)
             req.slot = None
@@ -583,8 +800,15 @@ class InferenceEngine:
         dpxmon.inc("serve.completed")
         if rec["ttft_ms"] is not None:
             dpxmon.observe("serve.ttft_ms", rec["ttft_ms"])
+            if req.tenant is not None:
+                dpxmon.observe(f"serve.ttft_ms.tenant.{req.tenant}",
+                               rec["ttft_ms"])
         if rec["tpot_ms"] is not None:
             dpxmon.observe("serve.tpot_ms", rec["tpot_ms"])
+            if req.tenant is not None:
+                dpxmon.observe(f"serve.tpot_ms.tenant.{req.tenant}",
+                               rec["tpot_ms"])
+        self._tenant_release(req)
         if self.metrics is not None:
             self.metrics.event("serve_request", **rec)
         emit_request_trace(req, "ok")
@@ -598,6 +822,7 @@ class InferenceEngine:
         self._failed += 1
         rec = request_record(req, outcome)
         req.handle.metrics = rec
+        self._tenant_release(req)
         dpxmon.inc("serve.failed")
         dpxmon.inc(f"serve.outcome.{outcome}")
         if self.metrics is not None:
@@ -608,6 +833,18 @@ class InferenceEngine:
             # timeline with the typed error (obs/trace.py, best-effort)
             dpxtrace.on_typed_failure(exc)
         req.handle.future.set_exception(exc)
+
+    def _tenant_release(self, req: Request) -> None:
+        """Give the tenant its inflight credit back at ANY terminal
+        transition (retire or typed failure, queued or running)."""
+        if req.tenant is None:
+            return
+        with self._cond:
+            n = self._tenant_inflight.get(req.tenant, 0)
+            if n <= 1:
+                self._tenant_inflight.pop(req.tenant, None)
+            else:
+                self._tenant_inflight[req.tenant] = n - 1
 
     def _drain_on_stop(self) -> None:
         cause = f" (engine loop crashed: {self._crash!r})" \

@@ -190,9 +190,11 @@ class FleetRouter:
     # -- front door ---------------------------------------------------------
 
     def submit(self, prompt, params: Optional[SamplingParams] = None, *,
-               rng=None, on_token=None) -> FleetHandle:
+               rng=None, on_token=None,
+               tenant: Optional[str] = None) -> FleetHandle:
         """Route one request; returns immediately with a
-        :class:`FleetHandle` (same streaming contract as the engine's).
+        :class:`FleetHandle` (same streaming contract as the engine's,
+        ``tenant`` passed through for the per-replica inflight quota).
         Raises ``AdmissionRejected`` synchronously — with
         ``reason="fleet_exhausted"`` when EVERY replica refused."""
         sp = params or SamplingParams()
@@ -231,7 +233,8 @@ class FleetRouter:
                 continue
             try:
                 inner = rep.engine.submit(prompt, sp, rng=rng,
-                                          on_token=on_token)
+                                          on_token=on_token,
+                                          tenant=tenant)
             except AdmissionRejected as e:
                 if e.reason in _SPILL_REASONS:
                     last_reject = e       # capacity — walk the fleet

@@ -54,7 +54,15 @@ def request_record(req: Request, outcome: str) -> Dict:
            # cold): resident full pages reused at admission and the
            # prefill tokens that reuse skipped
            "prefix_hit_pages": req.prefix_hit_pages,
-           "prefill_tokens_saved": req.prefill_tokens_saved}
+           "prefill_tokens_saved": req.prefill_tokens_saved,
+           # multi-tenant attribution (None = untenanted)
+           "tenant": req.tenant,
+           # speculative decoding (serve/spec/; 0/0 when the request
+           # never speculated): drafted tokens offered to verify and
+           # how many were accepted — the free bonus token counts in
+           # neither, so accepted/proposed is pure draft quality
+           "spec_proposed": req.spec_proposed,
+           "spec_accepted": req.spec_accepted}
     if req.handoff_send_t is not None:
         # the disagg TTFT decomposition (None spans = the request
         # failed before reaching that stage)
@@ -156,6 +164,16 @@ def aggregate(records: List[Dict], wall_s: Optional[float] = None) -> Dict:
                                   if prompt_toks else None)
         out["prefix_hit_pages"] = sum(r.get("prefix_hit_pages") or 0
                                       for r in ok)
+    proposed = sum(r.get("spec_proposed") or 0 for r in ok)
+    if proposed:
+        # speculative-decoding fleet view (serve/spec/):
+        # acceptance_rate is accepted drafts / proposed drafts; the
+        # effective tokens-per-iteration the bench reports comes from
+        # engine stats (per-iteration accounting, not per-request)
+        accepted = sum(r.get("spec_accepted") or 0 for r in ok)
+        out["spec_proposed"] = proposed
+        out["spec_accepted"] = accepted
+        out["spec_acceptance_rate"] = round(accepted / proposed, 4)
     hand = [r["handoff_ms"] for r in ok
             if r.get("handoff_ms") is not None]
     if hand:

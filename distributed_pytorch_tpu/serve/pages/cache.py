@@ -35,7 +35,9 @@ import numpy as np
 
 from ...comm import wire
 from ...models.generate import (decode_step_slots_paged,
-                                prefill_partial_paged)
+                                prefill_partial_paged,
+                                spec_commit_slots_paged,
+                                spec_verify_slots_paged)
 from ...runtime import faults
 from ..cache import CompileCounts
 from ..types import AdmissionRejected
@@ -144,6 +146,44 @@ class PagedSlotPool:
         return decode_step_slots_paged(self.model, params, k_pages,
                                        v_pages, tables, lengths, tokens,
                                        active, page_len=self.page_len,
+                                       kv_bits=self.quant_bits,
+                                       k_scales=k_scales,
+                                       v_scales=v_scales,
+                                       k_tail=k_tail, v_tail=v_tail)
+
+    def _verify(self, params, k_pages, v_pages, tables, lengths,
+                tokens):
+        # trace-time only; one compile per draft-length bucket (the
+        # candidate width s = k+1 is baked into the tokens shape)
+        self.compiles.bump_verify(tokens.shape[1])
+        return spec_verify_slots_paged(self.model, params, k_pages,
+                                       v_pages, tables, lengths, tokens,
+                                       page_len=self.page_len)
+
+    def _verify_q(self, params, k_pages, v_pages, k_scales, v_scales,
+                  k_tail, v_tail, tables, lengths, tokens):
+        self.compiles.bump_verify(tokens.shape[1])  # trace-time only
+        return spec_verify_slots_paged(self.model, params, k_pages,
+                                       v_pages, tables, lengths, tokens,
+                                       page_len=self.page_len,
+                                       kv_bits=self.quant_bits,
+                                       k_scales=k_scales,
+                                       v_scales=v_scales,
+                                       k_tail=k_tail, v_tail=v_tail)
+
+    def _commit(self, k_pages, v_pages, tables, lengths, sk, sv,
+                commit):
+        self.compiles.bump_commit(sk[0].shape[2])   # trace-time only
+        return spec_commit_slots_paged(k_pages, v_pages, tables,
+                                       lengths, sk, sv, commit,
+                                       page_len=self.page_len)
+
+    def _commit_q(self, k_pages, v_pages, k_scales, v_scales, k_tail,
+                  v_tail, tables, lengths, sk, sv, commit):
+        self.compiles.bump_commit(sk[0].shape[2])   # trace-time only
+        return spec_commit_slots_paged(k_pages, v_pages, tables,
+                                       lengths, sk, sv, commit,
+                                       page_len=self.page_len,
                                        kv_bits=self.quant_bits,
                                        k_scales=k_scales,
                                        v_scales=v_scales,
@@ -308,6 +348,78 @@ class PagedSlotPool:
                 jnp.asarray(tokens), jnp.asarray(active))
         self.lengths[np.asarray(active)] += 1
         return logits
+
+    def ensure_spec_capacity(self, slot: int, n_new: int) -> None:
+        """Grow ``slot``'s page table so the next ``n_new`` committed
+        positions all have pages — the multi-token twin of
+        :meth:`ensure_decode_capacity`, called AFTER acceptance is
+        known so only accepted tokens ever demand pages. All-or-nothing
+        (:meth:`_alloc`): on :class:`PagePoolExhausted` no slot state
+        changed, and the engine fails ONLY that request typed."""
+        if n_new <= 0:
+            return
+        last = int(self.lengths[slot]) + n_new - 1
+        need = last // self.page_len + 1
+        row = self.owned[slot]
+        missing = need - len(row)
+        if missing <= 0:
+            return
+        pids = self._alloc(missing)    # all-or-nothing; may raise
+        for pid in pids:
+            self.tables[slot, len(row)] = pid
+            row.append(pid)
+
+    def spec_verify(self, params, tokens: np.ndarray):
+        """Score all rows' k+1 candidate tokens ((n_slots, k+1) int32)
+        in one batched forward WITHOUT touching the pool — no donation:
+        acceptance is decided on the host, then :meth:`spec_commit`
+        writes the accepted prefix (so rejection at any point, page
+        boundary included, never quantizes a partial page). Returns
+        (logits (n_slots, k+1, vocab), sk, sv) with sk/sv per-layer
+        exact-f32 candidate K/V scratch."""
+        fn = getattr(self, "_verify_fn", None)
+        if fn is None:
+            # NOTE deliberately NOT donated (the pool survives verify)
+            fn = self._verify_fn = jax.jit(
+                self._verify if self.quant_bits is None
+                else self._verify_q)
+        if self.quant_bits is None:
+            return fn(params, self.k_pages, self.v_pages,
+                      jnp.asarray(self.tables), jnp.asarray(self.lengths),
+                      jnp.asarray(tokens))
+        return fn(params, self.k_pages, self.v_pages, self.k_scales,
+                  self.v_scales, self.k_tail, self.v_tail,
+                  jnp.asarray(self.tables), jnp.asarray(self.lengths),
+                  jnp.asarray(tokens))
+
+    def spec_commit(self, sk, sv, commit: np.ndarray) -> None:
+        """Scatter each row's accepted scratch prefix (``commit``
+        (n_slots,) int32, 0 = row not speculating) into its pages and
+        advance the host lengths. In a quantized pool accepted
+        positions land in the exact f32 tail buffers and a page
+        quantizes exactly ONCE, when an accepted token completes it —
+        rejected suffixes were never written anywhere, so the PR 16
+        quantize-once discipline is preserved by construction."""
+        fn = getattr(self, "_commit_fn", None)
+        if fn is None:
+            if self.quant_bits is None:
+                fn = jax.jit(self._commit, donate_argnums=(0, 1))
+            else:
+                fn = jax.jit(self._commit_q,
+                             donate_argnums=(0, 1, 2, 3, 4, 5))
+            self._commit_fn = fn
+        if self.quant_bits is None:
+            self.k_pages, self.v_pages = fn(
+                self.k_pages, self.v_pages, jnp.asarray(self.tables),
+                jnp.asarray(self.lengths), sk, sv, jnp.asarray(commit))
+        else:
+            (self.k_pages, self.v_pages, self.k_scales, self.v_scales,
+             self.k_tail, self.v_tail) = fn(
+                self.k_pages, self.v_pages, self.k_scales,
+                self.v_scales, self.k_tail, self.v_tail,
+                jnp.asarray(self.tables), jnp.asarray(self.lengths),
+                sk, sv, jnp.asarray(commit))
+        self.lengths += np.asarray(commit, np.int32)
 
     def extract(self, slot: int) -> Tuple[int, List[np.ndarray],
                                           List[np.ndarray]]:

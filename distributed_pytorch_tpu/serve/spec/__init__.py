@@ -1,0 +1,16 @@
+"""Speculative decoding for the serving engines (docs/serving.md
+"Speculative decoding").
+
+A small draft model proposes ``draft_len`` tokens per engine iteration;
+the target scores all k+1 candidate positions in ONE jitted batched
+verify program (read-only over the KV pool), the longest matching
+prefix plus the free bonus token is emitted, and only ACCEPTED
+positions are ever committed — rollback is simply not-writing, which is
+what keeps the greedy accepted stream bit-exact to ``generate()`` and
+the quantized pool's quantize-once discipline intact at every
+``kv_dtype``.
+"""
+
+from .state import SpecConfig, SpecState, accept_greedy
+
+__all__ = ["SpecConfig", "SpecState", "accept_greedy"]

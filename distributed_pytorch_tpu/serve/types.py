@@ -62,9 +62,13 @@ class AdmissionRejected(ServeError):
     prompt+max_new that cannot fit the slot cache. Raised
     synchronously from ``submit`` with ``reason`` set."""
 
-    def __init__(self, msg: str, *, reason: str = "rejected", **kw):
+    def __init__(self, msg: str, *, reason: str = "rejected",
+                 tenant: Optional[str] = None, **kw):
         super().__init__(msg, **kw)
         self.reason = reason
+        #: which tenant's quota refused it (``reason="tenant_quota"``
+        #: only) — attribution for multi-tenant dashboards
+        self.tenant = tenant
 
 
 class RequestDeadlineExceeded(ServeError):
@@ -131,6 +135,23 @@ class HandoffCorrupt(HandoffError):
         self.page = page
 
 
+class SpecDecodeError(ServeError):
+    """A speculative-decoding step (``serve/spec/``) failed for THIS
+    request: the draft proposal loop, the batched verify program, or
+    the accepted-prefix commit raised. ``stage`` attributes which —
+    ``"propose"`` / ``"verify"`` / ``"commit"`` — so an operator can
+    tell a diverging/broken draft model from a verify-side fault
+    (chaos-injected or real) at a glance. Containment mirrors the
+    paged-growth contract: only the speculating victim fails; the
+    target pool was not yet written for the iteration (verify is
+    read-only, rollback is simply not-committing), so co-resident
+    non-spec streams keep producing bit-exact tokens."""
+
+    def __init__(self, msg: str, *, stage: str = "verify", **kw):
+        super().__init__(msg, **kw)
+        self.stage = stage
+
+
 class PagePoolExhausted(ServeError):
     """The paged KV pool (``serve/pages/``) could not supply a page:
     every page is either free-list-empty or held by a live reader
@@ -194,6 +215,17 @@ class Request:
     #: coarse lifecycle location for the disagg router's failure
     #: attribution: "prefill_queue" | "prefill" | "handoff" | "decode"
     stage: Optional[str] = None
+    #: multi-tenant attribution (None = untenanted): checked against
+    #: ``DPX_SERVE_TENANT_MAX_INFLIGHT`` at submit, dimensioned onto
+    #: the TTFT/TPOT histograms at retirement
+    tenant: Optional[str] = None
+    #: speculative decoding accounting (serve/spec/): drafted tokens
+    #: offered to verify, and how many of them were accepted (the +1
+    #: bonus token verify emits for free is counted in NEITHER —
+    #: acceptance_rate = accepted/proposed is a pure draft-quality
+    #: measure). 0/0 for non-spec requests.
+    spec_proposed: int = 0
+    spec_accepted: int = 0
     #: dpxtrace lineage (obs/trace.py): ONE trace id assigned at submit
     #: that every lifecycle span carries — across the monolithic engine
     #: thread AND across the disagg prefill→handoff→decode split, so a
