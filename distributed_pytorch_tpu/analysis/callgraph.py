@@ -1,0 +1,177 @@
+"""Package-wide call graph with per-function collective effect signatures.
+
+The interprocedural half of dpxverify (analysis/spmd.py): dpxlint's
+DPX001 walks ONE module's defs to ask "is a collective reachable from
+this thread target?"; the SPMD rules need the same question answered
+across the whole package ("does this helper, three modules away, issue
+a barrier?"). This module builds that graph once per run:
+
+* every ``def`` in every package module, keyed by bare name — same
+  merged-resolution approximation as DPX001 (collisions merge; merged
+  resolution only ever ADDS coverage), with same-module definitions
+  preferred over package-wide ones;
+* ``effect(rel, name)`` — the ordered sequence of collective op names a
+  function can issue (directly or through same-package callees), the
+  *collective effect signature*. Memoized, cycle-safe (a recursive
+  cycle contributes its already-accumulated prefix and stops).
+
+Collective vocabulary is dpxlint's ``COLLECTIVE_NAMES`` (which is the
+schedule verifier's — one vocabulary across all three legs).
+
+Everything here is stdlib-only AST work: the jax-free CI lint job runs
+it in milliseconds.
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .lint import COLLECTIVE_NAMES, _call_name
+
+
+def iter_scope(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``root`` without descending into nested function/class
+    defs — the statements that execute when THIS body runs. (A nested
+    ``def`` only contributes effects where it is *called*.)"""
+    stack: List[ast.AST] = [root]
+    while stack:
+        node = stack.pop()
+        if node is not root and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class CallGraph:
+    """Collective effect signatures over a set of parsed modules.
+
+    ``modules`` maps repo-relative path -> parsed ``ast.Module``; only
+    package modules belong here (the rules are package-scoped).
+    """
+
+    def __init__(self, modules: Dict[str, ast.Module]):
+        # (rel, bare name) -> defs in that module; name -> defs anywhere
+        self.local_defs: Dict[Tuple[str, str], List[ast.AST]] = \
+            collections.defaultdict(list)
+        self.global_defs: Dict[str, List[ast.AST]] = \
+            collections.defaultdict(list)
+        self._def_module: Dict[int, str] = {}
+        for rel, tree in modules.items():
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    self.local_defs[(rel, node.name)].append(node)
+                    self.global_defs[node.name].append(node)
+                    self._def_module[id(node)] = rel
+        self._effect_cache: Dict[int, Tuple[str, ...]] = {}
+        # id(stmt) -> sites: the SPMD rules query overlapping blocks of
+        # the same statements (per rule, per enclosing scope); id keys
+        # are stable because the graph owns every module tree
+        self._sites_cache: Dict[int, List[Tuple[str, ast.Call]]] = {}
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve(self, rel: str, name: str) -> List[ast.AST]:
+        """Definitions a bare call name may bind to: same-module defs
+        win (they shadow); otherwise every same-named def in the
+        package (the DPX001 merge)."""
+        local = self.local_defs.get((rel, name))
+        if local:
+            return local
+        return self.global_defs.get(name, [])
+
+    # -- effect signatures -------------------------------------------------
+
+    def effect(self, rel: str, name: str) -> Tuple[str, ...]:
+        """Ordered collective ops callable ``name`` (resolved from
+        module ``rel``) can issue, deduped order-preservingly across
+        multiple same-named defs."""
+        out: List[str] = []
+        seen: Set[str] = set()
+        for node in self.resolve(rel, name):
+            for op in self._node_effect(node, set()):
+                if op not in seen:
+                    seen.add(op)
+                    out.append(op)
+        return tuple(out)
+
+    def _node_effect(self, fn_node: ast.AST, visiting: Set[int]
+                     ) -> Tuple[str, ...]:
+        key = id(fn_node)
+        cached = self._effect_cache.get(key)
+        if cached is not None:
+            return cached
+        if key in visiting:
+            return ()   # cycle: the caller already owns this frame
+        visiting.add(key)
+        rel = self._def_module.get(key, "")
+        ops: List[str] = []
+        for sub in iter_scope(fn_node):
+            if not isinstance(sub, ast.Call):
+                continue
+            callee = _call_name(sub)
+            if callee is None:
+                continue
+            if callee in COLLECTIVE_NAMES:
+                ops.append(callee)
+            else:
+                for target in self.resolve(rel, callee):
+                    if target is not fn_node:
+                        ops.extend(self._node_effect(target, visiting))
+        visiting.discard(key)
+        sig = tuple(ops)
+        self._effect_cache[key] = sig
+        return sig
+
+    # -- per-statement collective sites ------------------------------------
+
+    def collective_sites(self, root: ast.AST, rel: str
+                         ) -> List[Tuple[str, ast.Call]]:
+        """Every collective a statement subtree can issue, attributed
+        to the call node IN THIS SUBTREE: a direct collective call
+        yields itself; a call to a package function with a non-empty
+        effect signature yields one entry per op of that signature,
+        all attributed to the call site (the flaggable line)."""
+        cached = self._sites_cache.get(id(root))
+        if cached is not None:
+            return cached
+        out: List[Tuple[str, ast.Call]] = []
+        for node in iter_scope(root):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _call_name(node)
+            if callee is None:
+                continue
+            if callee in COLLECTIVE_NAMES:
+                out.append((callee, node))
+            elif self.resolve(rel, callee):
+                for op in self.effect(rel, callee):
+                    out.append((op, node))
+        out.sort(key=lambda e: (e[1].lineno, e[1].col_offset))
+        self._sites_cache[id(root)] = out
+        return out
+
+    def always_raises(self, rel: str, name: str) -> bool:
+        """True when every resolved def of ``name`` definitely raises
+        (its body cannot fall through): the ``_reraise``-style helper
+        an except handler may delegate to."""
+        defs = self.resolve(rel, name)
+        return bool(defs) and all(
+            isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and _terminates_by_raise(d.body) for d in defs)
+
+
+def _terminates_by_raise(body: List[ast.stmt]) -> bool:
+    if not body:
+        return False
+    last = body[-1]
+    if isinstance(last, ast.Raise):
+        return True
+    if isinstance(last, ast.If) and last.orelse:
+        return (_terminates_by_raise(last.body)
+                and _terminates_by_raise(last.orelse))
+    return False

@@ -104,6 +104,7 @@ TYPED_ERRORS: Dict[str, Tuple[str, ...]] = {
     "CommTimeout": ("op", "rank", "peer", "deadline_ms"),
     "CommCorrupt": ("op", "rank", "peer"),
     "CommRetryExhausted": ("op", "rank", "peer", "attempts"),
+    "CollectiveMismatch": ("op", "rank", "peer", "seq"),
     "CkptError": ("step", "rank", "shard"),
     "CkptCorrupt": ("step", "rank", "shard"),
     "CkptIncomplete": ("step", "rank", "shard"),
@@ -620,6 +621,34 @@ def _call_name(call: ast.Call) -> Optional[str]:
     if isinstance(fn, ast.Attribute):
         return fn.attr
     return None
+
+
+# ---------------------------------------------------------------------------
+# output formats (shared by tools/dpxlint.py and tools/dpxverify.py)
+# ---------------------------------------------------------------------------
+
+FORMATS = ("text", "json", "github")
+
+
+def _gh_escape(s: str) -> str:
+    # the workflow-command property/message escaping GitHub documents
+    return (s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A"))
+
+
+def format_findings(findings: Sequence["Finding"], fmt: str = "text") -> str:
+    """Render findings as ``text`` (the classic path:line lines),
+    ``json`` (machine-readable list of finding dicts), or ``github``
+    (``::error`` workflow annotations that surface inline on PRs)."""
+    if fmt == "json":
+        return json.dumps(
+            [{"rule": f.rule, "path": f.path, "line": f.line,
+              "message": f.message, "line_text": f.line_text}
+             for f in findings], indent=1, sort_keys=True)
+    if fmt == "github":
+        return "\n".join(
+            f"::error file={f.path},line={f.line},"
+            f"title={f.rule}::{_gh_escape(f.message)}" for f in findings)
+    return "\n".join(str(f) for f in findings)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,450 @@
+"""dpxverify — SPMD collective-order rules (DPX009-011).
+
+dpxlint (analysis/lint.py) checks local AST shapes; these rules reason
+about *cross-rank control flow*: every rank must issue the same
+collective sequence, or the job hangs for a full ``DPX_COMM_TIMEOUT_MS``
+with no attribution. Built on the package call graph
+(analysis/callgraph.py) so a collective three helpers deep still counts.
+
+* **DPX009** — a collective reachable on only one side of a
+  rank-divergent branch (``if rank == 0``, ``is_primary()``,
+  ``self.is_leader`` ...). Compares the collective effect multiset of
+  the two arms; a guard clause (``if rank != 0: return``) is compared
+  against the remainder of its enclosing block (the implicit else
+  path). Flagged at the one-sided collective's call site.
+* **DPX010** — an early-exit path that skips the second of a paired
+  collective sequence: a rank-dependent conditional ``return`` lexically
+  between a function's first and last collective site, or an ``except``
+  handler that swallows (or returns past) an exception raised around a
+  collective — the failing rank silently drops out of the sequence
+  while its peers block. Handlers that definitely re-raise (a bare
+  ``raise``, an always-raising helper like ``HierRing._reraise``, or
+  ``os._exit``) are exempt.
+* **DPX011** — a lock held across a collective (``with self._lock:``
+  around a barrier, or ``.acquire()`` ... collective ... ``.release()``)
+  — the distributed lock-order deadlock: rank A holds the lock inside
+  the collective while rank B needs it to reach the same collective.
+
+Suppression and baselines are dpxlint's, unchanged: append
+``# dpxlint: disable=DPXnnn <reason>`` to the offending line (or the
+line above); the committed baseline is
+``analysis/dpxverify_baseline.json``. Like dpxlint, a syntax error in
+any scanned file is DPX000. Rules are scoped to the package
+(``distributed_pytorch_tpu/``) — tests legitimately stage divergence.
+
+Approximations (deliberate, FP-biased-against): bare-name call
+resolution merges same-named defs package-wide (same as DPX001);
+multiset comparison counts both arms of nested *data*-dependent
+branches; rank-dependence is syntactic (an identifier from
+``RANK_IDENTIFIERS`` appearing in the branch test).
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from . import lint as _lint
+from .callgraph import CallGraph, iter_scope
+from .lint import Finding, _call_name
+
+RULES = ("DPX009", "DPX010", "DPX011")
+
+#: Terminal identifiers whose appearance in an ``if`` test marks the
+#: branch rank-divergent: different ranks can take different arms.
+RANK_IDENTIFIERS = {
+    "rank", "local_rank", "global_rank", "node_rank", "host_rank",
+    "get_rank", "process_index", "is_primary", "is_main", "is_master",
+    "is_main_process", "is_leader", "is_coordinator",
+}
+
+#: Terminal identifiers of a context/acquire target treated as a lock.
+_LOCK_HINTS = ("lock", "mutex")
+
+DEFAULT_BASELINE = os.path.join("distributed_pytorch_tpu", "analysis",
+                                "dpxverify_baseline.json")
+
+#: The fault-injection layer exists to CREATE collective divergence
+#: (its ``diverge`` action issues a one-sided barrier on the matched
+#: rank — that is the tested behavior, not a bug), and every fault
+#: hook (``on_comm_op``/``on_serve_iteration``/``_mark``) reaches it.
+#: Excluded from both the call graph and the per-file rules, mirroring
+#: dpxlint's deadline-layer exemption for runtime/native.py.
+EXEMPT_FILES = {
+    "distributed_pytorch_tpu/runtime/faults.py",
+}
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def is_rank_dependent(test: ast.AST) -> bool:
+    """True when the branch test mentions a rank-ish identifier — the
+    syntactic marker that different ranks may take different arms."""
+    for node in ast.walk(test):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name is not None and name.lower() in RANK_IDENTIFIERS:
+            return True
+    return False
+
+
+def _terminates(body: Sequence[ast.stmt]) -> bool:
+    """The block cannot fall through to the statement after it."""
+    if not body:
+        return False
+    last = body[-1]
+    if isinstance(last, (ast.Return, ast.Raise, ast.Continue, ast.Break)):
+        return True
+    if isinstance(last, ast.If) and last.orelse:
+        return _terminates(last.body) and _terminates(last.orelse)
+    return False
+
+
+def _child_blocks(stmt: ast.stmt) -> Iterator[List[ast.stmt]]:
+    """Statement blocks nested inside ``stmt`` (never into defs)."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    for field in ("body", "orelse", "finalbody"):
+        block = getattr(stmt, field, None)
+        if block:
+            yield block
+    for handler in getattr(stmt, "handlers", ()) or ():
+        if handler.body:
+            yield handler.body
+
+
+class _SpmdChecker(_lint._FileChecker):
+    """Per-file SPMD rule pass; inherits dpxlint's suppression +
+    emission machinery so ``# dpxlint: disable=DPX009`` works."""
+
+    def __init__(self, path: str, rel: str, source: str,
+                 tree: ast.Module, graph: CallGraph):
+        super().__init__(path, rel, source)
+        self.tree = tree
+        self.graph = graph
+        self._scope_list: "List[ast.AST] | None" = None
+
+    # -- shared helpers ----------------------------------------------------
+
+    def _sites(self, stmts: Sequence[ast.AST]
+               ) -> List[Tuple[str, ast.Call]]:
+        out: List[Tuple[str, ast.Call]] = []
+        for stmt in stmts:
+            out.extend(self.graph.collective_sites(stmt, self.rel))
+        return out
+
+    def _scopes(self) -> Iterator[ast.AST]:
+        # walked once, replayed per rule (all three iterate it)
+        if self._scope_list is None:
+            self._scope_list = [self.tree] + [
+                node for node in ast.walk(self.tree)
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        return iter(self._scope_list)
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        if not self._in_package():
+            return self.findings   # DPX000 handled by verify_paths
+        self._check_divergent_branches()    # DPX009
+        self._check_early_exits()           # DPX010
+        self._check_locked_collectives()    # DPX011
+        return self.findings
+
+    # -- DPX009 ------------------------------------------------------------
+
+    def _check_divergent_branches(self) -> None:
+        for scope in self._scopes():
+            body = scope.body if hasattr(scope, "body") else []
+            self._walk_block_for_ifs(list(body))
+
+    def _walk_block_for_ifs(self, block: List[ast.stmt]) -> None:
+        for i, stmt in enumerate(block):
+            if isinstance(stmt, ast.If) and is_rank_dependent(stmt.test):
+                self._compare_arms(stmt, block[i + 1:])
+            for child in _child_blocks(stmt):
+                self._walk_block_for_ifs(child)
+
+    def _compare_arms(self, node: ast.If, rest: List[ast.stmt]) -> None:
+        body_sites = self._sites(node.body)
+        if node.orelse:
+            else_sites = self._sites(node.orelse)
+        elif _terminates(node.body):
+            # guard clause: the taken arm exits here, the implicit else
+            # continues through the rest of the enclosing block — THOSE
+            # are the collectives the guarded ranks skip
+            else_sites = self._sites(rest)
+        else:
+            else_sites = []   # both paths rejoin; body ops are one-sided
+        body_ops = collections.Counter(op for op, _ in body_sites)
+        else_ops = collections.Counter(op for op, _ in else_sites)
+        if body_ops == else_ops:
+            return
+        for op in sorted((body_ops - else_ops) | (else_ops - body_ops)):
+            heavier = (body_sites if body_ops[op] > else_ops[op]
+                       else else_sites)
+            site = next(n for o, n in heavier if o == op)
+            self._emit(
+                "DPX009", site,
+                f"collective {op!r} reachable on only one side of the "
+                f"rank-divergent branch at line {node.lineno} — every "
+                "rank must issue the same collective sequence, or peers "
+                "hang until DPX_COMM_TIMEOUT_MS")
+
+    # -- DPX010 ------------------------------------------------------------
+
+    def _check_early_exits(self) -> None:
+        for scope in self._scopes():
+            if not isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            sites = self._sites(scope.body)
+            if len(sites) < 2:
+                continue
+            first = sites[0][1].lineno
+            last = sites[-1][1].lineno
+            if first >= last:
+                continue
+            site_ids = {id(n) for _, n in sites}
+            self._flag_rank_dep_returns(scope.body, first, last,
+                                        site_ids, in_rank_dep=False)
+            self._flag_swallowing_handlers(scope.body, sites)
+
+    def _flag_rank_dep_returns(self, block: List[ast.stmt], first: int,
+                               last: int, site_ids: set,
+                               in_rank_dep: bool) -> None:
+        for stmt in block:
+            if isinstance(stmt, ast.Return):
+                if (in_rank_dep and first < stmt.lineno < last
+                        and not any(id(sub) in site_ids
+                                    for sub in ast.walk(stmt))):
+                    self._emit(
+                        "DPX010", stmt,
+                        "rank-dependent early return between paired "
+                        "collectives (first at line "
+                        f"{first}, last at line {last}) — the returning "
+                        "rank drops out of the sequence while peers "
+                        "block in the later collective")
+                continue
+            if isinstance(stmt, ast.If):
+                rank_dep = in_rank_dep or is_rank_dependent(stmt.test)
+                self._flag_rank_dep_returns(stmt.body, first, last,
+                                            site_ids, rank_dep)
+                self._flag_rank_dep_returns(stmt.orelse, first, last,
+                                            site_ids, rank_dep)
+                continue
+            for child in _child_blocks(stmt):
+                self._flag_rank_dep_returns(child, first, last,
+                                            site_ids, in_rank_dep)
+
+    def _handler_reraises(self, handler: ast.ExceptHandler) -> bool:
+        if _terminates_by_raise_or_exit(handler.body, self.graph,
+                                        self.rel):
+            return True
+        # a bare `raise` anywhere in the handler body counts: the
+        # common `log(); raise` and conditional-reraise shapes
+        for node in iter_scope_block(handler.body):
+            if isinstance(node, ast.Raise):
+                return True
+        return False
+
+    def _flag_swallowing_handlers(
+            self, block: List[ast.stmt],
+            sites: List[Tuple[str, ast.Call]]) -> None:
+        for stmt in block:
+            if isinstance(stmt, ast.Try):
+                try_sites = self._sites(stmt.body)
+                after = any(n.lineno > stmt.lineno
+                            and not (stmt.body[0].lineno <= n.lineno
+                                     <= _block_end(stmt))
+                            for _, n in sites)
+                for handler in stmt.handlers:
+                    if self._handler_reraises(handler):
+                        continue
+                    if try_sites:
+                        ops = sorted({op for op, _ in try_sites})
+                        self._emit(
+                            "DPX010", handler,
+                            f"except path swallows a failure around "
+                            f"collective(s) {', '.join(ops)} issued in "
+                            "the try body — the failing rank skips the "
+                            "op its peers complete; re-raise (or "
+                            "abort the comm) instead")
+                    elif after and _terminates(handler.body):
+                        self._emit(
+                            "DPX010", handler,
+                            "except path returns past later "
+                            "collective(s) in this function — the "
+                            "exiting rank drops out of the sequence "
+                            "while peers block")
+            for child in _child_blocks(stmt):
+                self._flag_swallowing_handlers(child, sites)
+
+    # -- DPX011 ------------------------------------------------------------
+
+    def _check_locked_collectives(self) -> None:
+        for scope in self._scopes():
+            body = scope.body if hasattr(scope, "body") else []
+            self._walk_block_for_locks(list(body))
+
+    def _looks_like_lock(self, expr: ast.AST) -> bool:
+        name = _terminal_name(expr)
+        if name is None:
+            return False
+        low = name.lower()
+        return any(h in low for h in _LOCK_HINTS)
+
+    def _walk_block_for_locks(self, block: List[ast.stmt]) -> None:
+        acquired_at: Dict[str, int] = {}
+        for stmt in block:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                lockish = [item.context_expr for item in stmt.items
+                           if self._looks_like_lock(item.context_expr)]
+                if lockish:
+                    seen_ops = set()
+                    for op, site in self._sites(stmt.body):
+                        if op in seen_ops:
+                            continue
+                        seen_ops.add(op)
+                        self._emit(
+                            "DPX011", site,
+                            f"collective {op!r} issued while holding "
+                            f"{_src_of(lockish[0])!r} (with-block at "
+                            f"line {stmt.lineno}) — a rank blocked in "
+                            "the collective holds the lock a peer "
+                            "needs to reach it (distributed lock-order "
+                            "deadlock)")
+            # explicit acquire()/release() bracketing in the same block
+            call = _expr_call(stmt)
+            if call is not None and isinstance(call.func, ast.Attribute):
+                base = _src_of(call.func.value)
+                if (call.func.attr == "acquire"
+                        and self._looks_like_lock(call.func.value)):
+                    acquired_at[base] = stmt.lineno
+                elif call.func.attr == "release":
+                    acquired_at.pop(base, None)
+            if acquired_at:
+                held = next(iter(acquired_at))
+                seen_ops = set()
+                for op, site in self._sites([stmt]):
+                    if op in seen_ops:
+                        continue
+                    seen_ops.add(op)
+                    self._emit(
+                        "DPX011", site,
+                        f"collective {op!r} issued between "
+                        f"{held}.acquire() (line {acquired_at[held]}) "
+                        "and its release() — a rank blocked in the "
+                        "collective holds the lock a peer needs to "
+                        "reach it")
+            for child in _child_blocks(stmt):
+                self._walk_block_for_locks(child)
+
+
+def _expr_call(stmt: ast.stmt) -> Optional[ast.Call]:
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        return stmt.value
+    return None
+
+
+def _src_of(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return _terminal_name(node) or "<expr>"
+
+
+def _block_end(stmt: ast.stmt) -> int:
+    return getattr(stmt, "end_lineno", stmt.lineno) or stmt.lineno
+
+
+def iter_scope_block(block: Sequence[ast.stmt]) -> Iterator[ast.AST]:
+    for stmt in block:
+        yield from iter_scope(stmt)
+
+
+def _terminates_by_raise_or_exit(body: Sequence[ast.stmt],
+                                 graph: CallGraph, rel: str) -> bool:
+    """The block definitely ends by raising (or hard-exiting): its last
+    statement is a ``raise``, an if/else whose arms both do, a call to
+    an always-raising package helper (``_reraise`` style), or
+    ``os._exit``/``sys.exit``."""
+    if not body:
+        return False
+    last = body[-1]
+    if isinstance(last, ast.Raise):
+        return True
+    if isinstance(last, ast.If) and last.orelse:
+        return (_terminates_by_raise_or_exit(last.body, graph, rel)
+                and _terminates_by_raise_or_exit(last.orelse, graph, rel))
+    call = _expr_call(last)
+    if call is not None:
+        name = _call_name(call)
+        if name in ("_exit", "exit", "abort"):
+            return True
+        if name and graph.always_raises(rel, name):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# repo walk — mirrors lint.lint_paths, plus the one-shot call graph
+# ---------------------------------------------------------------------------
+
+def verify_paths(paths: Optional[Sequence[str]] = None,
+                 root: Optional[str] = None) -> List[Finding]:
+    root = root or _lint.repo_root()
+    files: List[str] = []
+    if not paths:
+        files = list(_lint.iter_py_files(root))
+    else:
+        for p in paths:
+            p = p if os.path.isabs(p) else os.path.join(root, p)
+            if os.path.isdir(p):
+                files.extend(_lint.iter_py_files(p))
+            else:
+                files.append(p)
+
+    out: List[Finding] = []
+    parsed: List[Tuple[str, str, str, ast.Module]] = []
+    modules: Dict[str, ast.Module] = {}
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            out.append(Finding(
+                rule="DPX000", path=rel, line=e.lineno or 1,
+                message=f"syntax error: {e.msg}", line_text=""))
+            continue
+        parsed.append((path, rel, source, tree))
+        if (rel.startswith(_lint._PACKAGE_DIR + "/")
+                and rel not in EXEMPT_FILES):
+            modules[rel] = tree
+
+    graph = CallGraph(modules)
+    for path, rel, source, tree in parsed:
+        if rel in EXEMPT_FILES:
+            continue
+        out.extend(_SpmdChecker(path, rel, source, tree, graph).run())
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out

@@ -50,6 +50,9 @@ import jax.numpy as jnp
 
 from ..runtime import context
 from . import host_backend
+from .sanitizer import CollectiveMismatch  # noqa: F401  (re-export:
+# under DPX_COMM_SANITIZE=1 every front-door collective may raise it
+# on cross-rank divergence — comm/sanitizer.py, docs/analysis.md)
 
 _VALID_OPS = ("sum", "avg", "max", "min")
 

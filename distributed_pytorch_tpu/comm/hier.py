@@ -149,6 +149,11 @@ class HierRing:
         self.comm.schedule.record(
             op, dtype="float32", size=int(n),
             extra=f"q{bits},L={self.local_world}")
+        # sanitize the LOGICAL hier op on the parent group (every
+        # global rank enters it) — the sub-group legs each carry their
+        # own comm's sanitizer
+        if self.comm._sanitizer is not None:
+            self.comm._sanitizer.check(op, dtype="float32", size=int(n))
 
     def _global_peer(self, e: CommError, scope: str) -> int:
         """Translate a sub-group CommError's blamed peer into a GLOBAL

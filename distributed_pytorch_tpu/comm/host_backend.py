@@ -47,6 +47,9 @@ from ..runtime import env as _env
 from ..runtime.native import (CommCorrupt, CommError,  # noqa: F401
                               CommPeerDied, CommTimeout)
 from . import wire as _wire
+from .sanitizer import CollectiveMismatch  # noqa: F401  (re-export:
+# the DPX_COMM_SANITIZE divergence error surfaces through this module
+# like every other typed comm failure)
 
 #: Wire formats a lossy-tolerant collective accepts. ``quant`` is the
 #: historical opt-in (width from the typed ``DPX_WIRE_WIDTH`` knob,

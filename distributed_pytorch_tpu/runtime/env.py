@@ -247,6 +247,13 @@ register("DPX_NATIVE_LIB", "str", None,
          "the default build — how the CI sanitizer jobs point the whole "
          "test suite at an ASan/UBSan/TSan-instrumented native library "
          "(docs/analysis.md).")
+register("DPX_COMM_SANITIZE", "bool", False,
+         "Arm the runtime collective sanitizer: every host-group "
+         "collective first exchanges a fixed-size fingerprint (seq no, "
+         "op, dtype, nbytes, call site) and a cross-rank divergence "
+         "raises a typed `CollectiveMismatch` naming both ranks and "
+         "ops within one exchange — instead of hanging for a full "
+         "`DPX_COMM_TIMEOUT_MS` (comm/sanitizer.py, docs/analysis.md).")
 register("DPX_SCHEDULE_WINDOW", "int", 64,
          "How many recent per-rank collective records the runtime "
          "schedule verifier keeps for divergence reports (0 disables "

@@ -308,6 +308,14 @@ class HostComm:
         self.master_addr = master_addr
         self.base_port = base_port
         self._hier_ring = None   # comm.hier.hier_ring() cache
+        # dpxverify's dynamic half (comm/sanitizer.py): armed, every
+        # collective first exchanges a fingerprint and a divergence is
+        # a typed CollectiveMismatch within one exchange; unarmed, the
+        # whole feature is the `is None` test in _pre_op
+        self._sanitizer = None
+        if _envreg.get("DPX_COMM_SANITIZE"):
+            from ..comm.sanitizer import CollectiveSanitizer
+            self._sanitizer = CollectiveSanitizer(self)
         _faults.register_comm(self)
 
     def close(self):
@@ -339,9 +347,12 @@ class HostComm:
         """Per-op entry hook: fault injection first (an injected
         divergent collective must land in the schedule at ITS issue
         point), then the schedule recorder folds this op's signature
-        into the rolling digest."""
+        into the rolling digest; the sanitizer exchange runs LAST so a
+        diverging op is already in the flushed window when it raises."""
         self._faults.on_comm_op(op, rank=self.rank, comm=self)
         self.schedule.record(op, dtype=dtype, size=size, extra=extra)
+        if self._sanitizer is not None:
+            self._sanitizer.check(op, dtype=dtype, size=size)
 
     def _check(self, rc: int, what: str):
         if rc == 0:

@@ -126,6 +126,7 @@ class DecodeEngine:
                 else:
                     try:
                         self._drain_transport(idle=True)
+                    # dpxlint: disable=DPX010 crash drain aborts the transport — the broadcast peer observes peer-closed, not a hang
                     except Exception as e:  # noqa: BLE001
                         self.router.on_decode_crash(e)
                         return
@@ -144,6 +145,7 @@ class DecodeEngine:
                 if self._running:
                     self._decode_all()
                 self.router.periodic_metrics(self.iterations)
+            # dpxlint: disable=DPX010 crash drain aborts the transport — the broadcast peer observes peer-closed, not a hang
             except Exception as e:  # noqa: BLE001 — a decode-loop
                 # crash must fail every resident future typed, with the
                 # cause chained, then stop serving (mirrors the

@@ -110,9 +110,11 @@ class PrefillEngine:
                     self._prefill_one(req)
                 finally:
                     self._active = None
+            # dpxlint: disable=DPX010 prefill death is fail-fast by design: decode's deadline-bounded recv observes severance typed, not a hang
             except TransportSevered as e:
                 self.router.on_prefill_dead(e)
                 return
+            # dpxlint: disable=DPX010 prefill death is fail-fast by design: decode's deadline-bounded recv observes severance typed, not a hang
             except Exception as e:  # noqa: BLE001 — a prefill-loop
                 # crash (XLA error, codec bug) fails ONLY prefill-side
                 # requests, typed; the decode loop keeps serving

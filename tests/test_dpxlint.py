@@ -494,6 +494,63 @@ def test_cli_reports_deliberately_broken_fixture(tmp_path, capsys):
     assert _rules(findings) == ["DPX002"]
 
 
+def test_cli_exit_2_on_unparseable_file(tmp_path, capsys):
+    """DPX000 contract regression: a file that fails to PARSE was not
+    linted, so the CLI must exit 2 — not pretend the file is clean."""
+    from tools.dpxlint import main
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    assert main([str(broken)]) == 2
+    err = capsys.readouterr().err
+    assert "DPX000" in err and "syntax error" in err
+
+
+def test_cli_write_baseline_exit_2_on_unparseable_file(tmp_path, capsys):
+    """The subtler half of the DPX000 contract: --write-baseline over an
+    unparseable file must ALSO exit 2 — accepting a baseline that
+    silently excludes an unlinted file would launder its findings."""
+    from tools.dpxlint import main
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    bl = tmp_path / "bl.json"
+    assert main(["--write-baseline", "--baseline", str(bl),
+                 str(broken)]) == 2
+    assert "DPX000" in capsys.readouterr().err
+    # the baseline itself is still written (without the unparsed file)
+    assert json.load(open(bl)) == []
+
+
+def test_cli_format_json(tmp_path, capsys):
+    from tools.dpxlint import main
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    assert main(["--format", "json", str(broken)]) == 2
+    out = capsys.readouterr().out
+    entries = json.loads(out)
+    assert len(entries) == 1
+    e = entries[0]
+    assert e["rule"] == "DPX000" and e["line"] == 1
+    assert {"rule", "path", "line", "message", "line_text"} <= set(e)
+
+
+def test_cli_format_github_annotations(tmp_path, capsys):
+    from tools.dpxlint import main
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    assert main(["--format", "github", str(broken)]) == 2
+    out = capsys.readouterr().out
+    assert out.startswith("::error file=")
+    assert ",line=1,title=DPX000::" in out
+
+
+def test_format_findings_escapes_github_message():
+    f = lint.Finding(rule="DPX999", path="a.py", line=3,
+                     message="bad%thing\nsecond line", line_text="x")
+    out = lint.format_findings([f], "github")
+    assert out == "::error file=a.py,line=3,title=DPX999::bad%25thing%0Asecond line"
+    assert "\n" not in out  # one annotation per line, newlines escaped
+
+
 def test_env_docs_current():
     """docs/env_vars.md is generated from the registry and committed;
     drift fails tier-1 (regenerate with `python -m tools.gen_env_docs`)."""

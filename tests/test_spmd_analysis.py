@@ -1,0 +1,509 @@
+"""dpxverify tests (ISSUE 20): the SPMD collective-order rules
+(DPX009-011) on minimal bad/good fixtures, the interprocedural call
+graph, the repo-clean gate, and the runtime collective sanitizer — an
+injected skipped-collective divergence at world 4 must raise a typed
+``CollectiveMismatch`` within one fingerprint exchange, not one
+``DPX_COMM_TIMEOUT_MS`` deadline."""
+
+import multiprocessing as mp
+import os
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_pytorch_tpu.analysis import spmd
+from distributed_pytorch_tpu.analysis.lint import (apply_baseline,
+                                                   load_baseline,
+                                                   save_baseline)
+from distributed_pytorch_tpu.comm.sanitizer import (RECORD_SIZE,
+                                                    CollectiveMismatch,
+                                                    CollectiveSanitizer)
+from distributed_pytorch_tpu.runtime import faults
+from distributed_pytorch_tpu.runtime.multiprocess import launch_multiprocess
+from distributed_pytorch_tpu.runtime.native import CommError, HostComm
+from distributed_pytorch_tpu.runtime.watchdog import WorkerFailure
+
+TIMEOUT_MS = 60_000  # deliberately HUGE: the sanitizer must beat it
+
+
+def _verify_snippet(tmp_path, source, rel="distributed_pytorch_tpu/mod.py"):
+    """Verify one fixture file at a package-relative path (the SPMD
+    rules are package-scoped; tests/ stage divergence legitimately)."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return spmd.verify_paths(None, root=str(tmp_path))
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# DPX009 — collective on one side of a rank-divergent branch
+# ---------------------------------------------------------------------------
+
+
+class TestDPX009:
+    def test_one_sided_collective_flagged_at_site(self, tmp_path):
+        bad = """
+            def step(comm, rank):
+                if rank == 0:
+                    comm.barrier()
+                comm.allreduce(x)
+        """
+        findings = _verify_snippet(tmp_path, bad)
+        assert _rules(findings) == ["DPX009"]
+        assert findings[0].line == 4          # the barrier call itself
+        assert "barrier" in findings[0].message
+
+    def test_guard_clause_implicit_else(self, tmp_path):
+        # `if rank != 0: return` then barrier: only rank 0 barriers
+        bad = """
+            def save(comm, rank):
+                if rank != 0:
+                    return
+                comm.barrier()
+        """
+        findings = _verify_snippet(tmp_path, bad)
+        assert _rules(findings) == ["DPX009"]
+        assert findings[0].line == 5
+
+    def test_is_primary_spelling(self, tmp_path):
+        bad = """
+            def commit(comm):
+                if is_primary():
+                    comm.barrier()
+        """
+        assert _rules(_verify_snippet(tmp_path, bad)) == ["DPX009"]
+
+    def test_balanced_arms_clean(self, tmp_path):
+        good = """
+            def step(comm, rank):
+                if rank == 0:
+                    comm.barrier()
+                else:
+                    comm.barrier()
+        """
+        assert _verify_snippet(tmp_path, good) == []
+
+    def test_data_dependent_branch_clean(self, tmp_path):
+        good = """
+            def step(comm, loss):
+                if loss > 10.0:
+                    log(loss)
+                comm.barrier()
+        """
+        assert _verify_snippet(tmp_path, good) == []
+
+    def test_interprocedural_effect(self, tmp_path):
+        # the collective hides one call deep; flagged at the CALL site
+        bad = """
+            def _sync(comm):
+                comm.barrier()
+
+            def step(comm, rank):
+                if rank == 0:
+                    _sync(comm)
+        """
+        findings = _verify_snippet(tmp_path, bad)
+        assert _rules(findings) == ["DPX009"]
+        assert findings[0].line == 7
+        assert "barrier" in findings[0].message
+
+    def test_cross_module_effect(self, tmp_path):
+        (tmp_path / "distributed_pytorch_tpu").mkdir(parents=True,
+                                                     exist_ok=True)
+        (tmp_path / "distributed_pytorch_tpu" / "helpers.py").write_text(
+            textwrap.dedent("""
+                def flush_world(comm):
+                    comm.barrier()
+            """))
+        bad = """
+            def step(comm, rank):
+                if rank == 0:
+                    flush_world(comm)
+        """
+        findings = _verify_snippet(tmp_path, bad)
+        assert _rules(findings) == ["DPX009"]
+
+    def test_suppression_marker(self, tmp_path):
+        waived = """
+            def step(comm, rank):
+                if rank == 0:
+                    # dpxlint: disable=DPX009 rooted save, peers wait at the outer barrier
+                    comm.barrier()
+        """
+        assert _verify_snippet(tmp_path, waived) == []
+
+
+# ---------------------------------------------------------------------------
+# DPX010 — early exit skipping the second of a paired sequence
+# ---------------------------------------------------------------------------
+
+
+class TestDPX010:
+    def test_rank_dependent_early_return(self, tmp_path):
+        bad = """
+            def train(comm, rank, bad):
+                comm.barrier()
+                if rank == 0 and bad:
+                    return None
+                comm.allreduce(x)
+        """
+        findings = _verify_snippet(tmp_path, bad)
+        assert "DPX010" in _rules(findings)
+        ret = next(f for f in findings if f.rule == "DPX010")
+        assert ret.line == 5                  # the return statement
+
+    def test_swallowing_except_around_collective(self, tmp_path):
+        bad = """
+            def sync(comm):
+                comm.barrier()
+                try:
+                    work()
+                    comm.allreduce(x)
+                except Exception:
+                    log()
+        """
+        findings = _verify_snippet(tmp_path, bad)
+        assert _rules(findings) == ["DPX010"]
+        assert findings[0].line == 7          # the except handler
+        assert "allreduce" in findings[0].message
+
+    def test_reraising_handler_clean(self, tmp_path):
+        good = """
+            def sync(comm):
+                comm.barrier()
+                try:
+                    comm.allreduce(x)
+                except Exception:
+                    log()
+                    raise
+        """
+        assert _verify_snippet(tmp_path, good) == []
+
+    def test_always_raising_helper_clean(self, tmp_path):
+        # the HierRing._reraise shape: the handler delegates to a local
+        # helper that definitely raises
+        good = """
+            def _reraise(op, e):
+                if op == "x":
+                    raise ValueError(op)
+                raise RuntimeError(op)
+
+            def sync(comm):
+                comm.barrier()
+                try:
+                    comm.allreduce(x)
+                except Exception as e:
+                    _reraise("allreduce", e)
+        """
+        assert _verify_snippet(tmp_path, good) == []
+
+    def test_unconditional_return_clean(self, tmp_path):
+        # a rank-INDEPENDENT early return is symmetric — every rank
+        # takes it or none does
+        good = """
+            def step(comm, n):
+                comm.barrier()
+                if n == 0:
+                    return None
+                comm.allreduce(x)
+        """
+        assert _verify_snippet(tmp_path, good) == []
+
+
+# ---------------------------------------------------------------------------
+# DPX011 — lock held across a collective
+# ---------------------------------------------------------------------------
+
+
+class TestDPX011:
+    def test_with_lock_around_collective(self, tmp_path):
+        bad = """
+            class A:
+                def flush(self, comm):
+                    with self._lock:
+                        comm.barrier()
+        """
+        findings = _verify_snippet(tmp_path, bad)
+        assert _rules(findings) == ["DPX011"]
+        assert findings[0].line == 5
+        assert "self._lock" in findings[0].message
+
+    def test_acquire_release_bracketing(self, tmp_path):
+        bad = """
+            def flush(comm, lock):
+                lock.acquire()
+                comm.barrier()
+                lock.release()
+        """
+        findings = _verify_snippet(tmp_path, bad)
+        assert _rules(findings) == ["DPX011"]
+        assert findings[0].line == 4
+
+    def test_lock_released_before_collective_clean(self, tmp_path):
+        good = """
+            def flush(comm, self):
+                with self._lock:
+                    n = compute()
+                comm.barrier()
+        """
+        assert _verify_snippet(tmp_path, good) == []
+
+    def test_non_lock_context_clean(self, tmp_path):
+        good = """
+            def save(comm, path):
+                with open(path) as f:
+                    f.read()
+                comm.barrier()
+        """
+        assert _verify_snippet(tmp_path, good) == []
+
+
+# ---------------------------------------------------------------------------
+# repo gate + baseline machinery
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_clean_under_committed_baseline():
+    """THE acceptance gate: `python -m tools.dpxverify` exits 0 on this
+    repo — zero findings outside the committed baseline (which is
+    EMPTY: the one deliberate divergence source, runtime/faults.py, is
+    exempted in analysis/spmd.py with its reason)."""
+    from tools.dpxverify import main
+    assert main([]) == 0
+
+
+def test_faults_layer_is_exempt_not_baselined():
+    # the exemption is explicit and reasoned in analysis/spmd.py — a
+    # rename would silently re-expose 20+ cascaded findings
+    assert "distributed_pytorch_tpu/runtime/faults.py" in spmd.EXEMPT_FILES
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(os.path.join(
+        root, "distributed_pytorch_tpu", "runtime", "faults.py"))
+
+
+def test_baseline_absorbs_spmd_findings(tmp_path):
+    bad = """
+        def step(comm, rank):
+            if rank == 0:
+                comm.barrier()
+    """
+    findings = _verify_snippet(tmp_path, bad)
+    assert len(findings) == 1
+    bl = tmp_path / "baseline.json"
+    save_baseline(str(bl), findings)
+    assert apply_baseline(findings, load_baseline(str(bl))) == []
+
+
+def test_cli_format_json_and_exit2_on_unparseable(tmp_path, capsys):
+    """dpxverify carries dpxlint's CLI contract: exit 2 on DPX000, and
+    --format json/github for machine consumers (CI annotations)."""
+    import json
+
+    from tools.dpxverify import main
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    assert main(["--format", "json", str(broken)]) == 2
+    entries = json.loads(capsys.readouterr().out)
+    assert [e["rule"] for e in entries] == ["DPX000"]
+    assert main(["--format", "github", str(broken)]) == 2
+    out = capsys.readouterr().out
+    assert out.startswith("::error file=") and "title=DPX000::" in out
+
+
+def test_dpx000_syntax_error_reported(tmp_path):
+    path = tmp_path / "distributed_pytorch_tpu" / "broken.py"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("def f(:\n")
+    findings = spmd.verify_paths(None, root=str(tmp_path))
+    assert _rules(findings) == ["DPX000"]
+
+
+# ---------------------------------------------------------------------------
+# sanitizer: wire format + unarmed structural guarantees
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_roundtrip():
+    class _FakeComm:
+        world = 1
+        rank = 0
+
+    s = CollectiveSanitizer(_FakeComm())
+    s._seq = 41
+    rec = s._pack("allreduce", "float32", 2048, "train.py:123")
+    assert len(rec) == RECORD_SIZE == 88
+    got = s._unpack(rec)
+    assert got["op"] == "allreduce" and got["dtype"] == "float32"
+    assert got["seq"] == 41 and got["nbytes"] == 2048
+    assert got["site"] == "train.py:123"
+
+
+def test_world1_check_short_circuits():
+    class _FakeComm:
+        world = 1
+        rank = 0
+        # no _lib/_h: touching the native layer would AttributeError
+
+    CollectiveSanitizer(_FakeComm()).check("allreduce", "float32", 8)
+
+
+def test_unarmed_comm_has_no_sanitizer_and_no_overhead(monkeypatch):
+    """DPX_COMM_SANITIZE unset: the feature is one `is None` test in
+    _pre_op — structurally zero extra work, bounded by a loose wall
+    clock (plumbing check, not a benchmark)."""
+    monkeypatch.delenv("DPX_COMM_SANITIZE", raising=False)
+    from distributed_pytorch_tpu.runtime.launcher import find_free_port
+    comm = HostComm("127.0.0.1", find_free_port(), rank=0, world=1)
+    try:
+        assert comm._sanitizer is None
+        t0 = time.perf_counter()
+        for _ in range(300):
+            comm.barrier()
+        assert time.perf_counter() - t0 < 2.0
+    finally:
+        comm.close()
+
+
+def test_armed_world1_comm_builds_sanitizer(monkeypatch):
+    monkeypatch.setenv("DPX_COMM_SANITIZE", "1")
+    from distributed_pytorch_tpu.runtime.launcher import find_free_port
+    comm = HostComm("127.0.0.1", find_free_port(), rank=0, world=1)
+    try:
+        assert isinstance(comm._sanitizer, CollectiveSanitizer)
+        comm.barrier()   # world-1 check short-circuits; still green
+    finally:
+        comm.close()
+
+
+def test_collective_mismatch_is_typed_comm_error():
+    e = CollectiveMismatch("divergence", op="allreduce", rank=1, peer=2,
+                           seq=3, peer_op="barrier",
+                           call_site="a.py:1", peer_call_site="b.py:2")
+    assert isinstance(e, CommError)
+    assert (e.op, e.rank, e.peer, e.seq) == ("allreduce", 1, 2, 3)
+    assert e.peer_op == "barrier"
+
+
+# ---------------------------------------------------------------------------
+# sanitizer: world-4 multiprocess legs (the CI sanitizer smoke: -k world4)
+# ---------------------------------------------------------------------------
+
+
+def _report_mismatch(q, rank, fn):
+    t0 = time.monotonic()
+    try:
+        fn()
+    except CommError as e:
+        q.put((rank, type(e).__name__, e.op, e.peer,
+               getattr(e, "seq", None), str(e),
+               time.monotonic() - t0))
+        q.close()
+        q.join_thread()
+        raise
+    q.put((rank, None, None, None, None, "", time.monotonic() - t0))
+
+
+def _san_diverge_worker(rank, world, q):
+    """Two clean sanitized allreduces; entering the third, rank 2's
+    injected ``diverge`` issues a barrier where ranks 0,1,3 issue
+    allreduce #3 — the sanitizer's fingerprint exchange must convert
+    the would-be 60s timeout hang into an immediate typed
+    CollectiveMismatch on EVERY rank."""
+    import numpy as np
+    import distributed_pytorch_tpu as dist
+
+    dist.init_process_group(rank, world)
+    for _ in range(2):
+        dist.all_reduce(np.ones(512, np.float32))
+    _report_mismatch(
+        q, rank, lambda: dist.all_reduce(np.ones(512, np.float32)))
+
+
+def test_sanitizer_catches_divergence_world4(monkeypatch):
+    """Acceptance (ISSUE 20): with DPX_COMM_SANITIZE=1 an injected
+    skipped-collective divergence at world 4 raises a typed
+    CollectiveMismatch naming both ranks, ops, the seq no and call
+    sites — within ONE fingerprint exchange, far under the (deliberately
+    huge) 60s DPX_COMM_TIMEOUT_MS deadline."""
+    monkeypatch.setenv("DPX_COMM_SANITIZE", "1")
+    monkeypatch.setenv(faults.FAULT_ENV,
+                       "diverge@op=allreduce,call=3,rank=2")
+    monkeypatch.setenv("DPX_COMM_TIMEOUT_MS", str(TIMEOUT_MS))
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+
+    result = {}
+
+    def run():
+        try:
+            launch_multiprocess(_san_diverge_worker, 4, q)
+        except BaseException as e:  # noqa: BLE001
+            result["exc"] = e
+
+    t = threading.Thread(target=run, name="test-sanitize-run", daemon=True)
+    t.start()
+    t.join(timeout=120)
+    assert not t.is_alive(), "sanitized diverge run hung"
+    assert isinstance(result.get("exc"), WorkerFailure)
+
+    reports = {}
+    while len(reports) < 4:
+        rank, kind, op, peer, seq, msg, elapsed = q.get(timeout=10)
+        reports[rank] = (kind, op, peer, seq, msg, elapsed)
+    for rank, (kind, op, peer, seq, msg, elapsed) in reports.items():
+        assert kind == "CollectiveMismatch", (rank, kind, msg)
+        # ONE exchange, not one deadline: seconds, nowhere near 60s
+        assert elapsed < 20.0, (rank, elapsed)
+        assert seq == 3, (rank, seq)
+        assert ".py:" in msg                  # call sites named
+        assert "rank" in msg and "seq 3" in msg
+    # a healthy rank names the diverging peer's op (the barrier nobody
+    # else issued) and the peer rank; the victim names the reverse
+    kind, op, peer, seq, msg, _ = reports[0]
+    assert op == "allreduce" and peer == 2
+    assert "'barrier'" in msg and "rank 2" in msg
+    kind2, op2, peer2, _, msg2, _ = reports[2]
+    assert op2 == "barrier" and "'allreduce'" in msg2
+
+
+def _san_clean_worker(rank, world, q):
+    """Sanitize a mixed collective schedule — every fingerprint
+    exchange must agree and the run must exit green."""
+    import numpy as np
+    import distributed_pytorch_tpu as dist
+
+    dist.init_process_group(rank, world)
+    dist.all_reduce(np.ones(256, np.float32))
+    dist.barrier()
+    dist.broadcast(np.arange(8, dtype=np.float32))
+    dist.all_gather(np.full(4, rank, np.float32))
+    dist.all_reduce(np.ones(16, np.float64))
+    q.put((rank, "ok"))
+    q.close()
+    q.join_thread()
+
+
+def test_sanitizer_clean_run_world4(monkeypatch):
+    """The CI smoke's green half: DPX_COMM_SANITIZE=1 over a world-4
+    mixed-op run — zero mismatch findings, clean exit."""
+    monkeypatch.setenv("DPX_COMM_SANITIZE", "1")
+    monkeypatch.delenv(faults.FAULT_ENV, raising=False)
+    monkeypatch.setenv("DPX_COMM_TIMEOUT_MS", str(TIMEOUT_MS))
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    launch_multiprocess(_san_clean_worker, 4, q)
+    reports = {}
+    while len(reports) < 4:
+        rank, status = q.get(timeout=10)
+        reports[rank] = status
+    assert all(s == "ok" for s in reports.values())

@@ -1,20 +1,21 @@
-"""dpxlint CLI — run the repo invariant lint (analysis/lint.py).
+"""dpxverify CLI — run the SPMD collective-order rules (analysis/spmd.py).
 
 Usage::
 
-    python -m tools.dpxlint                  # lint repo, baseline applied
-    python -m tools.dpxlint --no-baseline    # every finding, raw
-    python -m tools.dpxlint --write-baseline # accept current findings
-    python -m tools.dpxlint path/ other.py   # restrict to paths
+    python -m tools.dpxverify                  # verify repo, baseline applied
+    python -m tools.dpxverify --no-baseline    # every finding, raw
+    python -m tools.dpxverify --write-baseline # accept current findings
+    python -m tools.dpxverify --format github  # PR-inline annotations
+    python -m tools.dpxverify path/ other.py   # restrict to paths
 
 Exit code 0 = clean (no findings outside the committed baseline),
-1 = new findings, 2 = a linted file failed to parse. CI runs
-``python -m tools.dpxlint --baseline`` as the fast lint job
-(.github/workflows/tier1.yml); the rule catalog is docs/analysis.md.
+1 = new findings, 2 = a scanned file failed to parse. Same contract as
+tools/dpxlint.py; CI runs ``python -m tools.dpxverify --baseline`` in
+the no-install lint job (.github/workflows/tier1.yml). Rule catalog
+(DPX009-011) is docs/analysis.md.
 
-This module deliberately avoids importing jax (or any package module
-with heavy imports): the lint must run in a bare CI job in
-milliseconds. ``analysis.lint`` imports only stdlib + the env registry.
+Like dpxlint, this module must run jax-free: analysis.spmd imports only
+stdlib + analysis.lint (stdlib + obs.export, also stdlib).
 """
 
 from __future__ import annotations
@@ -24,9 +25,9 @@ import os
 import sys
 
 
-def _load_lint():
-    """Import analysis.lint WITHOUT executing the package __init__ (which
-    pulls jax): fabricate lightweight parent packages so the module's
+def _load_spmd():
+    """Import analysis.spmd WITHOUT executing the package __init__ (which
+    pulls jax): fabricate a lightweight parent package so the module's
     relative imports resolve against the source tree. setdefault keeps
     an already-imported real package (in-process test use) intact."""
     import importlib
@@ -39,17 +40,18 @@ def _load_lint():
         pkg.__path__ = [os.path.join(root, "distributed_pytorch_tpu")]
         sys.modules["distributed_pytorch_tpu"] = pkg
     return importlib.import_module(
-        "distributed_pytorch_tpu.analysis.lint")
+        "distributed_pytorch_tpu.analysis.spmd")
 
 
 def main(argv=None) -> int:
-    lint = _load_lint()
+    spmd = _load_spmd()
+    lint = spmd._lint
 
-    ap = argparse.ArgumentParser(prog="dpxlint", description=__doc__)
+    ap = argparse.ArgumentParser(prog="dpxverify", description=__doc__)
     ap.add_argument("paths", nargs="*",
-                    help="files/dirs to lint (default: repo root)")
-    ap.add_argument("--baseline", nargs="?", const=lint.DEFAULT_BASELINE,
-                    default=lint.DEFAULT_BASELINE, metavar="FILE",
+                    help="files/dirs to verify (default: repo root)")
+    ap.add_argument("--baseline", nargs="?", const=spmd.DEFAULT_BASELINE,
+                    default=spmd.DEFAULT_BASELINE, metavar="FILE",
                     help="baseline file (default: committed baseline)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="report every finding, ignoring the baseline")
@@ -60,7 +62,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     root = lint.repo_root()
-    findings = lint.lint_paths(args.paths or None, root=root)
+    findings = spmd.verify_paths(args.paths or None, root=root)
 
     parse_failures = [f for f in findings if f.rule == "DPX000"]
     findings = [f for f in findings if f.rule != "DPX000"]
@@ -69,11 +71,8 @@ def main(argv=None) -> int:
                      else os.path.join(root, args.baseline))
     if args.write_baseline:
         lint.save_baseline(baseline_path, findings)
-        print(f"dpxlint: wrote {len(findings)} finding(s) to "
+        print(f"dpxverify: wrote {len(findings)} finding(s) to "
               f"{os.path.relpath(baseline_path, root)}")
-        # a file that failed to PARSE was not linted: accepting a
-        # baseline over it would silently drop its findings — same
-        # exit-2 contract as the report path
         if parse_failures:
             for f in parse_failures:
                 print(str(f), file=sys.stderr)
@@ -96,12 +95,12 @@ def main(argv=None) -> int:
     if parse_failures:
         return 2
     if findings:
-        print(f"dpxlint: {len(findings)} new finding(s) — fix, add "
+        print(f"dpxverify: {len(findings)} new finding(s) — fix, add "
               "'# dpxlint: disable=DPXnnn <reason>', or re-baseline "
               "(docs/analysis.md)", file=sys.stderr)
         return 1
     if args.format == "text":
-        print("dpxlint: clean")
+        print("dpxverify: clean")
     return 0
 
 

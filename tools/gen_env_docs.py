@@ -14,10 +14,32 @@ import sys
 DOC_PATH = os.path.join("docs", "env_vars.md")
 
 
-def main(argv=None) -> int:
+def _load_env():
+    """Import runtime.env WITHOUT executing the package __init__s
+    (runtime/__init__ pulls jax): fabricate BOTH lightweight parents so
+    the CI drift-gate step runs in the no-install lint job. setdefault
+    keeps already-imported real packages (in-process test use) intact;
+    env.py itself has no relative imports."""
+    import importlib
+    import types
+
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, root)
-    from distributed_pytorch_tpu.runtime import env
+    pkg_dir = os.path.join(root, "distributed_pytorch_tpu")
+    for name, path in (("distributed_pytorch_tpu", pkg_dir),
+                       ("distributed_pytorch_tpu.runtime",
+                        os.path.join(pkg_dir, "runtime"))):
+        if name not in sys.modules:
+            mod = types.ModuleType(name)
+            mod.__path__ = [path]
+            sys.modules[name] = mod
+    return importlib.import_module(
+        "distributed_pytorch_tpu.runtime.env")
+
+
+def main(argv=None) -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = _load_env()
 
     ap = argparse.ArgumentParser(prog="gen_env_docs", description=__doc__)
     ap.add_argument("--check", action="store_true",
